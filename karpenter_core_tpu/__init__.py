@@ -1,0 +1,21 @@
+"""karpenter_core_tpu — a TPU-native cluster-provisioning framework.
+
+Re-designs the capabilities of karpenter-core (reference: /root/reference,
+pure Go, sigs.k8s.io/karpenter) around a batched JAX/TPU scheduling core:
+
+- ``kube``        : k8s-shaped object model + in-memory API server fake
+- ``apis``        : NodePool / NodeClaim data model (ref pkg/apis/v1beta1)
+- ``scheduling``  : requirement algebra, taints, ports, volumes
+                    (ref pkg/scheduling)
+- ``cloudprovider``: provider SPI + fake (ref pkg/cloudprovider)
+- ``state``       : cluster state cache (ref pkg/controllers/state)
+- ``scheduler``   : greedy CPU oracle scheduler
+                    (ref pkg/controllers/provisioning/scheduling)
+- ``solver``      : the TPU path — tensorized constraints, vmapped
+                    bin-packing, consolidation repack (no Go analogue;
+                    replaces the greedy hot loop)
+- ``provisioning``/``disruption``/``lifecycle``: controllers
+- ``operator``    : composition root, options, metrics, events
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from . import labels
+from .nodepool import NodePool, NodePoolSpec, NodeClaimTemplateSpec, Disruption, Budget
+from .nodeclaim import NodeClaim, NodeClaimSpec, NodeClaimStatus

@@ -1,0 +1,114 @@
+"""Well-known labels, annotations and domains (ref pkg/apis/v1beta1/labels.go)."""
+
+from __future__ import annotations
+
+GROUP = "karpenter.sh"
+COMPATIBILITY_GROUP = "compatibility.karpenter.sh"
+
+# kubernetes well-known label keys (k8s.io/api/core/v1 constants)
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+LABEL_TOPOLOGY_REGION = "topology.kubernetes.io/region"
+LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+LABEL_ARCH = "kubernetes.io/arch"
+LABEL_OS = "kubernetes.io/os"
+LABEL_WINDOWS_BUILD = "node.kubernetes.io/windows-build"
+LABEL_FAILURE_DOMAIN_BETA_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_FAILURE_DOMAIN_BETA_REGION = "failure-domain.beta.kubernetes.io/region"
+LABEL_INSTANCE_TYPE_BETA = "beta.kubernetes.io/instance-type"
+
+ARCHITECTURE_AMD64 = "amd64"
+ARCHITECTURE_ARM64 = "arm64"
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+# karpenter domains/labels (labels.go:36-41)
+NODEPOOL_LABEL_KEY = f"{GROUP}/nodepool"
+NODE_INITIALIZED_LABEL_KEY = f"{GROUP}/initialized"
+NODE_REGISTERED_LABEL_KEY = f"{GROUP}/registered"
+CAPACITY_TYPE_LABEL_KEY = f"{GROUP}/capacity-type"
+
+# annotations (labels.go:44-49)
+DO_NOT_DISRUPT_ANNOTATION_KEY = f"{GROUP}/do-not-disrupt"
+MANAGED_BY_ANNOTATION_KEY = f"{GROUP}/managed-by"
+NODEPOOL_HASH_ANNOTATION_KEY = f"{GROUP}/nodepool-hash"
+
+# v1alpha5 compat (ref pkg/apis/v1alpha5/labels.go, used at
+# disruption/consolidation.go:98)
+DO_NOT_CONSOLIDATE_ANNOTATION_KEY = "karpenter.sh/do-not-consolidate"
+DO_NOT_EVICT_ANNOTATION_KEY = "karpenter.sh/do-not-evict"
+
+# finalizers (labels.go:52-54)
+TERMINATION_FINALIZER = f"{GROUP}/termination"
+
+# taints
+DISRUPTION_TAINT_KEY = f"{GROUP}/disruption"
+DISRUPTION_NO_SCHEDULE_VALUE = "disrupting"
+REGISTRATION_TAINT_KEY = f"{GROUP}/registered"  # karpenter.sh/registered:NoExecute until registered
+
+# node lifecycle taints kubelet applies (ref pkg/scheduling/taints.go:28-32)
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_EXTERNAL_CLOUD_PROVIDER = "node.cloudprovider.kubernetes.io/uninitialized"
+
+RESTRICTED_LABEL_DOMAINS = frozenset({"kubernetes.io", "k8s.io", GROUP})
+
+LABEL_DOMAIN_EXCEPTIONS = frozenset(
+    {"kops.k8s.io", "node.kubernetes.io", "node-restriction.kubernetes.io"}
+)
+
+WELL_KNOWN_LABELS = frozenset(
+    {
+        NODEPOOL_LABEL_KEY,
+        LABEL_TOPOLOGY_ZONE,
+        LABEL_TOPOLOGY_REGION,
+        LABEL_INSTANCE_TYPE,
+        LABEL_ARCH,
+        LABEL_OS,
+        CAPACITY_TYPE_LABEL_KEY,
+        LABEL_WINDOWS_BUILD,
+    }
+)
+
+RESTRICTED_LABELS = frozenset({LABEL_HOSTNAME})
+
+# aliased → canonical label keys (labels.go:94-100)
+NORMALIZED_LABELS = {
+    LABEL_FAILURE_DOMAIN_BETA_ZONE: LABEL_TOPOLOGY_ZONE,
+    "beta.kubernetes.io/arch": LABEL_ARCH,
+    "beta.kubernetes.io/os": LABEL_OS,
+    LABEL_INSTANCE_TYPE_BETA: LABEL_INSTANCE_TYPE,
+    LABEL_FAILURE_DOMAIN_BETA_REGION: LABEL_TOPOLOGY_REGION,
+}
+
+
+def get_label_domain(key: str) -> str:
+    if "/" in key:
+        return key.split("/", 1)[0]
+    return ""
+
+
+def is_restricted_node_label(key: str) -> bool:
+    """True if karpenter must not inject this label onto nodes (labels.go:117-133)."""
+    if key in WELL_KNOWN_LABELS:
+        return True
+    domain = get_label_domain(key)
+    for exc in LABEL_DOMAIN_EXCEPTIONS:
+        if domain.endswith(exc):
+            return False
+    for restricted in RESTRICTED_LABEL_DOMAINS:
+        if domain.endswith(restricted):
+            return True
+    return key in RESTRICTED_LABELS
+
+
+def is_restricted_label(key: str) -> str | None:
+    """Returns an error message if the label may not be used (labels.go:104-112)."""
+    if key in WELL_KNOWN_LABELS:
+        return None
+    if is_restricted_node_label(key):
+        return (
+            f"label {key} is restricted; specify a well known label "
+            f"or a custom label that does not use a restricted domain"
+        )
+    return None

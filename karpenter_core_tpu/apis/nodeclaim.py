@@ -1,0 +1,107 @@
+"""NodeClaim API type (ref pkg/apis/v1beta1/nodeclaim.go, nodeclaim_status.go)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..kube.objects import (
+    Condition,
+    KubeObject,
+    NodeSelectorRequirement,
+    ResourceList,
+    Taint,
+)
+
+# status condition types (nodeclaim_status.go:60-66)
+COND_LAUNCHED = "Launched"
+COND_REGISTERED = "Registered"
+COND_INITIALIZED = "Initialized"
+COND_EMPTY = "Empty"
+COND_DRIFTED = "Drifted"
+COND_EXPIRED = "Expired"
+
+
+@dataclass
+class NodeClassReference:
+    """Provider-specific config reference (nodeclaim.go:134-144)."""
+
+    name: str = ""
+    kind: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class KubeletConfiguration:
+    """Kubelet args for provisioned nodes (nodeclaim.go:70-131); the subset
+    that affects scheduling math (maxPods / reserved resources)."""
+
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    system_reserved: ResourceList = field(default_factory=dict)
+    kube_reserved: ResourceList = field(default_factory=dict)
+    eviction_hard: Dict[str, str] = field(default_factory=dict)
+    eviction_soft: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeClaimResources:
+    requests: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class NodeClaimSpec:
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    requirements: List[NodeSelectorRequirement] = field(default_factory=list)
+    resources: NodeClaimResources = field(default_factory=NodeClaimResources)
+    kubelet: Optional[KubeletConfiguration] = None
+    node_class_ref: Optional[NodeClassReference] = None
+
+
+@dataclass
+class NodeClaimStatus:
+    node_name: str = ""
+    provider_id: str = ""
+    image_id: str = ""
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    conditions: List[Condition] = field(default_factory=list)
+
+
+@dataclass
+class NodeClaim(KubeObject):
+    spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)
+    status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+    # -- condition helpers (apis.ConditionType machinery in the reference) --
+
+    def get_condition(self, cond_type: str) -> Optional[Condition]:
+        for c in self.status.conditions:
+            if c.type == cond_type:
+                return c
+        return None
+
+    def status_condition_is_true(self, cond_type: str) -> bool:
+        c = self.get_condition(cond_type)
+        return c is not None and c.status == "True"
+
+    def set_condition(self, cond_type: str, status: str = "True", reason: str = "", message: str = "") -> None:
+        existing = self.get_condition(cond_type)
+        if existing is not None:
+            if existing.status != status:
+                existing.last_transition_time = time.time()
+            existing.status = status
+            existing.reason = reason
+            existing.message = message
+        else:
+            self.status.conditions.append(
+                Condition(type=cond_type, status=status, reason=reason, message=message)
+            )
+
+    def clear_condition(self, cond_type: str) -> None:
+        self.status.conditions = [c for c in self.status.conditions if c.type != cond_type]

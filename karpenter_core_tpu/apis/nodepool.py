@@ -1,0 +1,116 @@
+"""NodePool API type (ref pkg/apis/v1beta1/nodepool.go)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..kube.objects import (
+    KubeObject,
+    NodeSelectorRequirement,
+    ResourceList,
+    Taint,
+)
+from .nodeclaim import KubeletConfiguration, NodeClassReference
+
+CONSOLIDATION_POLICY_WHEN_EMPTY = "WhenEmpty"
+CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED = "WhenUnderutilized"
+
+
+@dataclass
+class Budget:
+    """Disruption budget (nodepool.go:97-118): at most ``nodes`` (count or
+    percent string like "10%") may be disrupting at once while active."""
+
+    nodes: str = "10%"
+    schedule: Optional[str] = None  # crontab; None = always active
+    duration: Optional[float] = None  # seconds the budget is active per crontab hit
+
+
+@dataclass
+class Disruption:
+    """NodePool disruption policy (nodepool.go:59-92)."""
+
+    consolidate_after: Optional[float] = None  # seconds; None = immediately eligible
+    consolidation_policy: str = CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+    expire_after: Optional[float] = None  # seconds; None = Never
+    budgets: List[Budget] = field(default_factory=list)
+
+
+@dataclass
+class NodeClaimTemplateObjectMeta:
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeClaimTemplateSpec:
+    """Template stamped onto NodeClaims (nodepool.go:143-147)."""
+
+    metadata: NodeClaimTemplateObjectMeta = field(default_factory=NodeClaimTemplateObjectMeta)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    requirements: List[NodeSelectorRequirement] = field(default_factory=list)
+    kubelet: Optional[KubeletConfiguration] = None
+    node_class_ref: Optional[NodeClassReference] = None
+
+
+@dataclass
+class NodePoolSpec:
+    template: NodeClaimTemplateSpec = field(default_factory=NodeClaimTemplateSpec)
+    disruption: Disruption = field(default_factory=Disruption)
+    limits: ResourceList = field(default_factory=dict)  # nodepool.go:127
+    weight: Optional[int] = None  # 1-100, higher = tried first (nodepool.go:56)
+
+
+@dataclass
+class NodePoolStatus:
+    resources: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class NodePool(KubeObject):
+    spec: NodePoolSpec = field(default_factory=NodePoolSpec)
+    status: NodePoolStatus = field(default_factory=NodePoolStatus)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+    def static_hash(self) -> str:
+        """Hash of the disruption-relevant static spec fields (nodepool.go:179,
+        `hash:"ignore"` on requirements/resources/budgets). Used by the hash
+        controller and drift detection."""
+        t = self.spec.template
+        payload = {
+            "labels": sorted(t.metadata.labels.items()),
+            "annotations": sorted(t.metadata.annotations.items()),
+            "taints": sorted((x.key, x.value, x.effect) for x in t.taints),
+            "startup_taints": sorted((x.key, x.value, x.effect) for x in t.startup_taints),
+            "kubelet": _kubelet_repr(t.kubelet),
+            "node_class_ref": (
+                (t.node_class_ref.name, t.node_class_ref.kind, t.node_class_ref.api_version)
+                if t.node_class_ref
+                else None
+            ),
+        }
+        return hashlib.sha256(json.dumps(payload, sort_keys=True, default=str).encode()).hexdigest()[:16]
+
+
+def _kubelet_repr(k: Optional[KubeletConfiguration]):
+    if k is None:
+        return None
+    return (
+        k.max_pods,
+        k.pods_per_core,
+        sorted(k.system_reserved.items()),
+        sorted(k.kube_reserved.items()),
+        sorted(k.eviction_hard.items()),
+        sorted(k.eviction_soft.items()),
+    )
+
+
+def order_by_weight(nodepools: List[NodePool]) -> List[NodePool]:
+    """Highest weight first, ties by name (nodepool.go:197 OrderByWeight)."""
+    return sorted(nodepools, key=lambda np: (-(np.spec.weight or 0), np.name))

@@ -1,0 +1,10 @@
+from .types import (
+    CloudProvider,
+    InstanceType,
+    InstanceTypeOverhead,
+    Offering,
+    NodeClaimNotFoundError,
+    InsufficientCapacityError,
+    NodeClassNotReadyError,
+    order_by_price,
+)

@@ -1,0 +1,282 @@
+"""Recording fake cloud provider + synthetic instance-type catalogs
+(ref pkg/cloudprovider/fake/cloudprovider.go, instancetype.go).
+
+Used by tests AND by the benchmark data generator — the synthetic
+catalogs mirror the reference's so the performance grids are comparable.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import math
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import NodeClaim
+from ..apis.nodepool import NodePool
+from ..kube.objects import OP_DOES_NOT_EXIST, OP_IN, ResourceList
+from ..kube.quantity import NANO, parse_quantity
+from ..scheduling import Requirement, Requirements, resources
+from ..scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    node_selector_requirements,
+)
+from .types import (
+    CloudProvider,
+    InstanceType,
+    InstanceTypeOverhead,
+    NodeClaimNotFoundError,
+    Offering,
+    Offerings,
+)
+
+# extra well-known labels the fake registers (fake/instancetype.go:34-47)
+LABEL_INSTANCE_SIZE = "size"
+EXOTIC_INSTANCE_LABEL_KEY = "special"
+INTEGER_INSTANCE_LABEL_KEY = "integer"
+RESOURCE_GPU_VENDOR_A = "fake.com/vendor-a"
+RESOURCE_GPU_VENDOR_B = "fake.com/vendor-b"
+
+FAKE_WELL_KNOWN = ALLOW_UNDEFINED_WELL_KNOWN_LABELS | {
+    LABEL_INSTANCE_SIZE,
+    EXOTIC_INSTANCE_LABEL_KEY,
+    INTEGER_INSTANCE_LABEL_KEY,
+}
+
+
+def price_from_resources(res: ResourceList) -> float:
+    """0.1/cpu + 0.1/GB mem + 1.0/gpu (fake/instancetype.go:177)."""
+    price = 0.0
+    for k, v in res.items():
+        if k == "cpu":
+            price += 0.1 * v / NANO
+        elif k == "memory":
+            price += 0.1 * (v / NANO) / 1e9
+        elif k in (RESOURCE_GPU_VENDOR_A, RESOURCE_GPU_VENDOR_B):
+            price += 1.0
+    return price
+
+
+def new_instance_type(
+    name: str,
+    resources_map: Optional[Dict[str, object]] = None,
+    offerings: Optional[List[Offering]] = None,
+    architecture: str = "amd64",
+    operating_systems: Optional[List[str]] = None,
+) -> InstanceType:
+    """Synthetic instance type with the reference's defaulting
+    (fake/instancetype.go:50 NewInstanceType)."""
+    res: ResourceList = {k: parse_quantity(v) for k, v in (resources_map or {}).items()}
+    res.setdefault("cpu", parse_quantity("4"))
+    res.setdefault("memory", parse_quantity("4Gi"))
+    res.setdefault("pods", parse_quantity("5"))
+    if offerings is None:
+        price = price_from_resources(res)
+        offerings = [
+            Offering("spot", "test-zone-1", price),
+            Offering("spot", "test-zone-2", price),
+            Offering("on-demand", "test-zone-1", price),
+            Offering("on-demand", "test-zone-2", price),
+            Offering("on-demand", "test-zone-3", price),
+        ]
+    operating_systems = operating_systems or ["linux", "windows", "darwin"]
+    available = [o for o in offerings if o.available]
+    cpu_whole = res["cpu"] // NANO
+    reqs = Requirements(
+        Requirement(wk.LABEL_INSTANCE_TYPE, OP_IN, [name]),
+        Requirement(wk.LABEL_ARCH, OP_IN, [architecture]),
+        Requirement(wk.LABEL_OS, OP_IN, operating_systems),
+        Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [o.zone for o in available]),
+        Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [o.capacity_type for o in available]),
+        Requirement(LABEL_INSTANCE_SIZE, OP_DOES_NOT_EXIST),
+        Requirement(EXOTIC_INSTANCE_LABEL_KEY, OP_DOES_NOT_EXIST),
+        Requirement(INTEGER_INSTANCE_LABEL_KEY, OP_IN, [str(cpu_whole)]),
+    )
+    if res["cpu"] > parse_quantity("4") and res["memory"] > parse_quantity("8Gi"):
+        reqs.get_req(LABEL_INSTANCE_SIZE).insert("large")
+        reqs.get_req(EXOTIC_INSTANCE_LABEL_KEY).insert("optional")
+    else:
+        reqs.get_req(LABEL_INSTANCE_SIZE).insert("small")
+    return InstanceType(
+        name=name,
+        requirements=reqs,
+        offerings=Offerings(offerings),
+        capacity=res,
+        overhead=InstanceTypeOverhead(
+            kube_reserved={"cpu": parse_quantity("100m"), "memory": parse_quantity("10Mi")}
+        ),
+    )
+
+
+def instance_types(total: int) -> List[InstanceType]:
+    """n types with incrementing resources: i → (i+1)vcpu, 2(i+1)Gi,
+    10(i+1) pods (fake/instancetype.go:153 InstanceTypes)."""
+    return [
+        new_instance_type(
+            f"fake-it-{i}",
+            {"cpu": str(i + 1), "memory": f"{(i + 1) * 2}Gi", "pods": str((i + 1) * 10)},
+        )
+        for i in range(total)
+    ]
+
+
+def instance_types_assorted() -> List[InstanceType]:
+    """Cross product of cpu×mem×zone×capacity×os×arch
+    (fake/instancetype.go:112 InstanceTypesAssorted)."""
+    out = []
+    for cpu, mem, zone, ct, os_, arch in itertools.product(
+        [1, 2, 4, 8, 16, 32, 64],
+        [1, 2, 4, 8, 16, 32, 64, 128],
+        ["test-zone-1", "test-zone-2", "test-zone-3"],
+        [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND],
+        ["linux", "windows"],
+        [wk.ARCHITECTURE_AMD64, wk.ARCHITECTURE_ARM64],
+    ):
+        res = {"cpu": str(cpu), "memory": f"{mem}Gi"}
+        price = price_from_resources({k: parse_quantity(v) for k, v in res.items()})
+        out.append(
+            new_instance_type(
+                f"{cpu}-cpu-{mem}-mem-{arch}-{os_}-{zone}-{ct}",
+                res,
+                offerings=[Offering(ct, zone, price)],
+                architecture=arch,
+                operating_systems=[os_],
+            )
+        )
+    return out
+
+
+def random_provider_id() -> str:
+    return f"fake:///{uuid.uuid4().hex[:16]}"
+
+
+class FakeCloudProvider(CloudProvider):
+    """Recording fake (fake/cloudprovider.go:42)."""
+
+    def __init__(self) -> None:
+        self.instance_types: List[InstanceType] = []
+        self.instance_types_for_nodepool: Dict[str, List[InstanceType]] = {}
+        self.errors_for_nodepool: Dict[str, Exception] = {}
+        self.create_calls: List[NodeClaim] = []
+        self.delete_calls: List[NodeClaim] = []
+        self.allowed_create_calls: int = 1 << 62
+        self.next_create_err: Optional[Exception] = None
+        self.next_delete_err: Optional[Exception] = None
+        self.created_node_claims: Dict[str, NodeClaim] = {}
+        self.drifted: str = "drifted"
+        self._lock = threading.RLock()
+
+    def reset(self) -> None:
+        self.__init__()
+
+    # -- SPI ----------------------------------------------------------------
+
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        with self._lock:
+            if self.next_create_err is not None:
+                err, self.next_create_err = self.next_create_err, None
+                raise err
+            self.create_calls.append(node_claim)
+            if len(self.create_calls) > self.allowed_create_calls:
+                raise RuntimeError("erroring as number of AllowedCreateCalls has been exceeded")
+            reqs = node_selector_requirements(node_claim.spec.requirements)
+            nodepool_name = node_claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")
+            np = NodePool()
+            np.metadata.name = nodepool_name
+            candidates = [
+                it
+                for it in self.get_instance_types(np)
+                if reqs.compatible(it.requirements, FAKE_WELL_KNOWN) is None
+                and len(it.offerings.requirements(reqs).available()) > 0
+                and resources.fits(node_claim.spec.resources.requests, it.allocatable())
+            ]
+            if not candidates:
+                from .types import InsufficientCapacityError
+
+                raise InsufficientCapacityError(
+                    f"no instance type satisfied requirements for nodeclaim {node_claim.name}"
+                )
+            candidates.sort(
+                key=lambda it: it.offerings.available().requirements(reqs).cheapest().price
+            )
+            instance_type = candidates[0]
+            labels = {}
+            for key, req in instance_type.requirements.items():
+                if req.operator() == OP_IN and len(req.values) == 1:
+                    labels[key] = next(iter(req.values))
+            for o in instance_type.offerings.available():
+                offer_reqs = Requirements(
+                    Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [o.zone]),
+                    Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [o.capacity_type]),
+                )
+                if reqs.compatible(offer_reqs, FAKE_WELL_KNOWN) is None:
+                    labels[wk.LABEL_TOPOLOGY_ZONE] = o.zone
+                    labels[wk.CAPACITY_TYPE_LABEL_KEY] = o.capacity_type
+                    break
+            created = copy.deepcopy(node_claim)
+            created.metadata.labels = {**labels, **node_claim.metadata.labels}
+            created.status.provider_id = random_provider_id()
+            created.status.capacity = {k: v for k, v in instance_type.capacity.items() if v}
+            created.status.allocatable = {k: v for k, v in instance_type.allocatable().items() if v}
+            self.created_node_claims[created.status.provider_id] = created
+            return created
+
+    def get(self, provider_id: str) -> NodeClaim:
+        with self._lock:
+            nc = self.created_node_claims.get(provider_id)
+            if nc is None:
+                raise NodeClaimNotFoundError(f"no nodeclaim exists with provider id {provider_id}")
+            return copy.deepcopy(nc)
+
+    def list(self) -> List[NodeClaim]:
+        with self._lock:
+            return [copy.deepcopy(nc) for nc in self.created_node_claims.values()]
+
+    def delete(self, node_claim: NodeClaim) -> None:
+        with self._lock:
+            if self.next_delete_err is not None:
+                err, self.next_delete_err = self.next_delete_err, None
+                raise err
+            self.delete_calls.append(node_claim)
+            if node_claim.status.provider_id in self.created_node_claims:
+                del self.created_node_claims[node_claim.status.provider_id]
+                return
+            raise NodeClaimNotFoundError(
+                f"no nodeclaim exists with provider id {node_claim.status.provider_id}"
+            )
+
+    def get_instance_types(self, nodepool: Optional[NodePool]) -> List[InstanceType]:
+        if nodepool is not None:
+            if nodepool.name in self.errors_for_nodepool:
+                raise self.errors_for_nodepool[nodepool.name]
+            if nodepool.name in self.instance_types_for_nodepool:
+                return self.instance_types_for_nodepool[nodepool.name]
+        if self.instance_types:
+            return self.instance_types
+        return [
+            new_instance_type("default-instance-type"),
+            new_instance_type("small-instance-type", {"cpu": 2, "memory": "2Gi"}),
+            new_instance_type(
+                "gpu-vendor-instance-type", {RESOURCE_GPU_VENDOR_A: 2}
+            ),
+            new_instance_type(
+                "gpu-vendor-b-instance-type", {RESOURCE_GPU_VENDOR_B: 2}
+            ),
+            new_instance_type(
+                "arm-instance-type",
+                {"cpu": 16, "memory": "128Gi"},
+                architecture="arm64",
+                operating_systems=["ios", "linux", "windows", "darwin"],
+            ),
+            new_instance_type("single-pod-instance-type", {"pods": 1}),
+        ]
+
+    def is_drifted(self, node_claim: NodeClaim) -> str:
+        return self.drifted
+
+    def name(self) -> str:
+        return "fake"

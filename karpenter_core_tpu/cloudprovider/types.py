@@ -1,0 +1,166 @@
+"""Cloud-provider SPI (ref pkg/cloudprovider/types.go).
+
+This is the plugin seam: provider implementations translate NodeClaims
+to real machines. The TPU tensorization layer consumes the
+``InstanceType`` model behind this interface (capacity matrix, offering
+availability/price tensors) without providers knowing about it.
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import NodeClaim
+from ..apis.nodepool import NodePool
+from ..kube.objects import ResourceList
+from ..scheduling import Requirements, resources
+
+
+@dataclass
+class Offering:
+    """Availability of an instance type in a (capacity type, zone), with
+    price (types.go:127)."""
+
+    capacity_type: str
+    zone: str
+    price: float
+    available: bool = True
+
+
+class Offerings(List[Offering]):
+    def get(self, capacity_type: str, zone: str) -> Optional[Offering]:
+        for o in self:
+            if o.capacity_type == capacity_type and o.zone == zone:
+                return o
+        return None
+
+    def available(self) -> "Offerings":
+        return Offerings(o for o in self if o.available)
+
+    def requirements(self, reqs: Requirements) -> "Offerings":
+        """Offerings matching zone/capacity-type requirements (types.go:146)."""
+        return Offerings(
+            o
+            for o in self
+            if (not reqs.has(wk.LABEL_TOPOLOGY_ZONE) or reqs.get_req(wk.LABEL_TOPOLOGY_ZONE).has(o.zone))
+            and (
+                not reqs.has(wk.CAPACITY_TYPE_LABEL_KEY)
+                or reqs.get_req(wk.CAPACITY_TYPE_LABEL_KEY).has(o.capacity_type)
+            )
+        )
+
+    def cheapest(self) -> Optional[Offering]:
+        return min(self, key=lambda o: o.price) if self else None
+
+
+@dataclass
+class InstanceTypeOverhead:
+    kube_reserved: ResourceList = field(default_factory=dict)
+    system_reserved: ResourceList = field(default_factory=dict)
+    eviction_threshold: ResourceList = field(default_factory=dict)
+
+    def total(self) -> ResourceList:
+        return resources.merge(self.kube_reserved, self.system_reserved, self.eviction_threshold)
+
+
+class InstanceType:
+    """A potential node's properties (types.go:83), with memoized
+    allocatable (types.go:104 precompute)."""
+
+    __slots__ = ("name", "requirements", "offerings", "capacity", "overhead", "_allocatable")
+
+    def __init__(
+        self,
+        name: str,
+        requirements: Requirements,
+        offerings: Offerings,
+        capacity: ResourceList,
+        overhead: Optional[InstanceTypeOverhead] = None,
+    ):
+        self.name = name
+        self.requirements = requirements
+        self.offerings = Offerings(offerings)
+        self.capacity = capacity
+        self.overhead = overhead or InstanceTypeOverhead()
+        self._allocatable: Optional[ResourceList] = None
+
+    def allocatable(self) -> ResourceList:
+        if self._allocatable is None:
+            self._allocatable = resources.subtract(self.capacity, self.overhead.total())
+        return dict(self._allocatable)
+
+    def __repr__(self) -> str:
+        return f"InstanceType({self.name})"
+
+
+def order_by_price(instance_types: List[InstanceType], reqs: Requirements) -> List[InstanceType]:
+    """Sort by cheapest available offering matching reqs, ties by name
+    (types.go:62 OrderByPrice)."""
+
+    def key(it: InstanceType):
+        matching = it.offerings.available().requirements(reqs)
+        cheapest = matching.cheapest()
+        return (cheapest.price if cheapest else math.inf, it.name)
+
+    return sorted(instance_types, key=key)
+
+
+# -- typed errors (types.go:169-256) ---------------------------------------
+
+
+class CloudProviderError(Exception):
+    pass
+
+
+class NodeClaimNotFoundError(CloudProviderError):
+    def __str__(self) -> str:
+        return f"nodeclaim not found, {super().__str__()}"
+
+
+class InsufficientCapacityError(CloudProviderError):
+    def __str__(self) -> str:
+        return f"insufficient capacity, {super().__str__()}"
+
+
+class NodeClassNotReadyError(CloudProviderError):
+    def __str__(self) -> str:
+        return f"NodeClassRef not ready, {super().__str__()}"
+
+
+class CloudProvider(abc.ABC):
+    """Provider SPI (types.go:38-58)."""
+
+    @abc.abstractmethod
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        """Launch a machine for the claim; returns a hydrated claim with
+        resolved labels/capacity/provider id."""
+
+    @abc.abstractmethod
+    def delete(self, node_claim: NodeClaim) -> None:
+        """Terminate the machine backing the claim (NodeClaimNotFoundError
+        if already gone)."""
+
+    @abc.abstractmethod
+    def get(self, provider_id: str) -> NodeClaim:
+        """Retrieve a claim by provider id (NodeClaimNotFoundError if absent)."""
+
+    @abc.abstractmethod
+    def list(self) -> List[NodeClaim]:
+        """All machines managed by this provider."""
+
+    @abc.abstractmethod
+    def get_instance_types(self, nodepool: Optional[NodePool]) -> List[InstanceType]:
+        """All instance types (including unavailable offerings)."""
+
+    @abc.abstractmethod
+    def is_drifted(self, node_claim: NodeClaim) -> str:
+        """Non-empty drift reason if the machine no longer matches its
+        provisioning requirements."""
+
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...

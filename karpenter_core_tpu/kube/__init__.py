@@ -1,0 +1,2 @@
+from .quantity import parse_quantity, format_quantity
+from . import objects

@@ -1,0 +1,430 @@
+"""Kubernetes-shaped object model.
+
+The reference operates on ``k8s.io/api/core/v1`` types through
+controller-runtime. We model the subset the framework needs as plain
+dataclasses — pods, nodes, daemonsets, PVCs, PDBs — with the same field
+semantics (owner refs, finalizers, deletion timestamps, conditions) so
+the controllers translate faithfully without a kubernetes dependency.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# metadata
+
+_sequence = itertools.count(1)
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    generation: int = 1
+
+
+@dataclass
+class KubeObject:
+    """Base for all API objects; kind is the class name."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+
+@dataclass
+class Condition:
+    """Status condition (metav1.Condition shape)."""
+
+    type: str = ""
+    status: str = "Unknown"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+
+# ---------------------------------------------------------------------------
+# label selectors (metav1.LabelSelector)
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In | NotIn | Exists | DoesNotExist
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for expr in self.match_expressions:
+            val = labels.get(expr.key)
+            if expr.operator == "In":
+                if val is None or val not in expr.values:
+                    return False
+            elif expr.operator == "NotIn":
+                if val is not None and val in expr.values:
+                    return False
+            elif expr.operator == "Exists":
+                if val is None:
+                    return False
+            elif expr.operator == "DoesNotExist":
+                if val is not None:
+                    return False
+            else:
+                return False
+        return True
+
+    def key(self) -> tuple:
+        """Hashable identity (for TopologyGroup dedup, ref topologygroup.go:142)."""
+        return (
+            tuple(sorted(self.match_labels.items())),
+            tuple(sorted((e.key, e.operator, tuple(sorted(e.values))) for e in self.match_expressions)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# node selection / affinity (v1.NodeSelector et al.)
+
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = OP_IN
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None  # requiredDuringSchedulingIgnoredDuringExecution
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    topology_key: str = ""
+    label_selector: Optional[LabelSelector] = None
+    namespaces: List[str] = field(default_factory=list)
+    namespace_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# ---------------------------------------------------------------------------
+# taints / tolerations (v1.Taint, v1.Toleration; ref pkg/scheduling/taints.go)
+
+EFFECT_NO_SCHEDULE = "NoSchedule"
+EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+EFFECT_NO_EXECUTE = "NoExecute"
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = EFFECT_NO_SCHEDULE
+
+    def match(self, other: "Taint") -> bool:
+        """v1.Taint.MatchTaint: same key and effect."""
+        return self.key == other.key and self.effect == other.effect
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        """v1.Toleration.ToleratesTaint semantics."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        # empty operator defaults to Equal
+        return self.value == taint.value
+
+    def match_toleration(self, other: "Toleration") -> bool:
+        return (
+            self.key == other.key
+            and self.operator == other.operator
+            and self.value == other.value
+            and self.effect == other.effect
+        )
+
+
+# ---------------------------------------------------------------------------
+# topology spread (v1.TopologySpreadConstraint)
+
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# pods
+
+# ResourceList: resource name → integer nanos (see kube.quantity)
+ResourceList = Dict[str, int]
+
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
+
+
+@dataclass
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    requests: ResourceList = field(default_factory=dict)
+    limits: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class Container:
+    name: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    persistent_volume_claim: Optional[str] = None  # claim name
+    ephemeral: bool = False  # generic ephemeral volume → implicit PVC "<pod>-<vol>"
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: ResourceList = field(default_factory=dict)
+    volumes: List[Volume] = field(default_factory=list)
+    priority: Optional[int] = None
+    preemption_policy: str = "PreemptLowerPriority"
+    scheduler_name: str = "default-scheduler"
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = "Unknown"
+    reason: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    conditions: List[PodCondition] = field(default_factory=list)
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod(KubeObject):
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+# ---------------------------------------------------------------------------
+# nodes
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    conditions: List[Condition] = field(default_factory=list)
+    phase: str = ""
+
+
+@dataclass
+class NodeSpec:
+    provider_id: str = ""
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
+class Node(KubeObject):
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+
+# ---------------------------------------------------------------------------
+# workloads & friends (the slices controllers touch)
+
+
+@dataclass
+class DaemonSet(KubeObject):
+    pod_template_spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class PersistentVolumeClaim(KubeObject):
+    storage_class_name: Optional[str] = None
+    volume_name: str = ""  # bound PV name
+
+
+@dataclass
+class PersistentVolume(KubeObject):
+    zones: List[str] = field(default_factory=list)  # from nodeAffinity zone terms
+    driver: str = ""
+
+    def __post_init__(self):
+        self.metadata.namespace = ""
+
+
+@dataclass
+class StorageClass(KubeObject):
+    provisioner: str = ""
+    zones: List[str] = field(default_factory=list)  # allowedTopologies zones
+
+    def __post_init__(self):
+        self.metadata.namespace = ""
+
+
+@dataclass
+class PodDisruptionBudget(KubeObject):
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    min_available: Optional[int] = None  # absolute only (percentages resolved upstream)
+    max_unavailable: Optional[int] = None
+    disruptions_allowed: int = 0
+
+
+@dataclass
+class Namespace(KubeObject):
+    def __post_init__(self):
+        self.metadata.namespace = ""
+
+
+@dataclass
+class Lease(KubeObject):
+    holder: str = ""
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def next_name(prefix: str) -> str:
+    return f"{prefix}-{next(_sequence):05d}"

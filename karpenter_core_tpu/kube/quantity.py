@@ -1,0 +1,114 @@
+"""Kubernetes resource.Quantity parsing and formatting.
+
+The reference manipulates k8s ``resource.Quantity`` values (arbitrary
+precision decimals) throughout its hot paths (ref:
+pkg/utils/resources/resources.go). We canonicalize every quantity to an
+integer count of **nano-units** (1 unit = 1e9 nanos): exact arithmetic
+with plain Python ints, and a single fixed-point format that serializes
+losslessly to the TPU tensorization layer (which rescales per resource).
+"""
+
+from __future__ import annotations
+
+NANO = 10**9
+
+# decimal SI suffixes → multiplier as (numerator, denominator) over base units
+_DECIMAL = {
+    "n": (1, 10**9),
+    "u": (1, 10**6),
+    "m": (1, 10**3),
+    "": (1, 1),
+    "k": (10**3, 1),
+    "M": (10**6, 1),
+    "G": (10**9, 1),
+    "T": (10**12, 1),
+    "P": (10**15, 1),
+    "E": (10**18, 1),
+}
+_BINARY = {
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+}
+
+
+def parse_quantity(value) -> int:
+    """Parse a k8s quantity (str | int | float) into integer nanos.
+
+    ``parse_quantity("100m") == 100_000_000``; ``parse_quantity("1Gi") ==
+    2**30 * 10**9``. Floats are supported for convenience in tests and
+    the fake provider.
+    """
+    if isinstance(value, int):
+        return value * NANO
+    if isinstance(value, float):
+        return round(value * NANO)
+    s = value.strip()
+    if not s:
+        raise ValueError("empty quantity")
+    neg = False
+    if s[0] in "+-":
+        neg = s[0] == "-"
+        s = s[1:]
+    # binary suffix
+    for suf, mult in _BINARY.items():
+        if s.endswith(suf):
+            nanos = _exact(s[: -len(suf)], mult, 1)
+            return -nanos if neg else nanos
+    # scientific notation (k8s allows e.g. "12e6"); require a non-empty
+    # integer exponent so the decimal "E" (exa) suffix doesn't match
+    low = s.lower()
+    if "e" in low:
+        mantissa, _, exp = low.partition("e")
+        if exp and (exp.lstrip("+-").isdigit()):
+            e = int(exp)
+            if e >= 0:
+                nanos = _exact(mantissa, 10**e, 1)
+            else:
+                nanos = _exact(mantissa, 1, 10**-e)
+            return -nanos if neg else nanos
+    # decimal SI suffix
+    suffix = ""
+    if s and s[-1] in "numkMGTPE":
+        suffix = s[-1]
+        s = s[:-1]
+    numer, denom = _DECIMAL[suffix]
+    nanos = _exact(s, numer, denom)
+    return -nanos if neg else nanos
+
+
+def _exact(decimal: str, numer: int, denom: int) -> int:
+    """Exact nanos for ``decimal * numer / denom`` using integer math."""
+    decimal = decimal.strip()
+    if not decimal:
+        return 0
+    if "." in decimal:
+        whole, _, frac = decimal.partition(".")
+        whole_i = int(whole) if whole else 0
+        frac_i = int(frac) if frac else 0
+        scale = 10 ** len(frac)
+        return (whole_i * scale + frac_i) * numer * NANO // (denom * scale)
+    return int(decimal) * numer * NANO // denom
+
+
+def format_quantity(nanos: int) -> str:
+    """Format nanos back into a compact k8s-style quantity string."""
+    if nanos == 0:
+        return "0"
+    neg = "-" if nanos < 0 else ""
+    nanos = abs(nanos)
+    if nanos % NANO == 0:
+        return f"{neg}{nanos // NANO}"
+    if nanos % 10**6 == 0:
+        return f"{neg}{nanos // 10**6}m"
+    if nanos % 10**3 == 0:
+        return f"{neg}{nanos // 10**3}u"
+    return f"{neg}{nanos}n"
+
+
+def to_float(nanos: int) -> float:
+    """Nanos → float base units (for tensorization; may lose precision)."""
+    return nanos / NANO

@@ -1,0 +1,202 @@
+"""Requirement: set algebra over node-selector operators.
+
+Faithful re-expression of the reference's complement-set representation
+(ref pkg/scheduling/requirement.go:33-39): a requirement is either a
+concrete value set (``complement=False``; In / DoesNotExist) or the
+complement of one (``complement=True``; NotIn / Exists / Gt / Lt), with
+optional integer bounds. This is also the semantic contract for the TPU
+mask encoding in ``solver.encode`` — each requirement lowers to a
+boolean mask over a per-key value vocabulary plus an "all other values"
+slot standing in for the complement's unseen values.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, List, Optional, Set
+
+from ..apis import labels as wk
+from ..kube.objects import (
+    NodeSelectorRequirement,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+)
+
+# stands in for the reference's math.MaxInt64 cardinality of complement sets
+INFINITE = 1 << 62
+
+
+class Requirement:
+    """One per-key constraint (requirement.go:33)."""
+
+    __slots__ = ("key", "complement", "values", "greater_than", "less_than")
+
+    def __init__(self, key: str, operator: str, values: Iterable[str] = ()):  # noqa: C901
+        self.key = wk.NORMALIZED_LABELS.get(key, key)
+        values = list(values)
+        self.greater_than: Optional[int] = None
+        self.less_than: Optional[int] = None
+        if operator == OP_IN:
+            self.complement = False
+            self.values: Set[str] = set(values)
+            return
+        self.complement = operator != OP_DOES_NOT_EXIST
+        self.values = set(values) if operator == OP_NOT_IN else set()
+        if operator == OP_GT:
+            self.greater_than = int(values[0])
+        elif operator == OP_LT:
+            self.less_than = int(values[0])
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def _raw(
+        cls,
+        key: str,
+        complement: bool,
+        values: Set[str],
+        greater_than: Optional[int] = None,
+        less_than: Optional[int] = None,
+    ) -> "Requirement":
+        r = cls.__new__(cls)
+        r.key = key
+        r.complement = complement
+        r.values = values
+        r.greater_than = greater_than
+        r.less_than = less_than
+        return r
+
+    # -- algebra (requirement.go:128-161) ----------------------------------
+
+    def intersection(self, other: "Requirement") -> "Requirement":
+        complement = self.complement and other.complement
+        greater_than = _max_opt(self.greater_than, other.greater_than)
+        less_than = _min_opt(self.less_than, other.less_than)
+        if greater_than is not None and less_than is not None and greater_than >= less_than:
+            return Requirement(self.key, OP_DOES_NOT_EXIST)
+
+        if self.complement and other.complement:
+            values = self.values | other.values
+        elif self.complement and not other.complement:
+            values = other.values - self.values
+        elif not self.complement and other.complement:
+            values = self.values - other.values
+        else:
+            values = self.values & other.values
+        values = {v for v in values if _within(v, greater_than, less_than)}
+        if not complement:
+            greater_than, less_than = None, None
+        return Requirement._raw(self.key, complement, values, greater_than, less_than)
+
+    def has(self, value: str) -> bool:
+        """True if the requirement allows the value (requirement.go:182)."""
+        if self.complement:
+            return value not in self.values and _within(value, self.greater_than, self.less_than)
+        return value in self.values and _within(value, self.greater_than, self.less_than)
+
+    def any(self) -> str:
+        """A representative allowed value (requirement.go:163). Random for
+        complement sets, like the reference."""
+        op = self.operator()
+        if op == OP_IN:
+            return next(iter(self.values))
+        if op in (OP_NOT_IN, OP_EXISTS):
+            lo_ = 0 if self.greater_than is None else self.greater_than + 1
+            hi = (1 << 63) - 1 if self.less_than is None else self.less_than
+            return str(random.randrange(lo_, hi))
+        return ""
+
+    def insert(self, *items: str) -> None:
+        self.values.update(items)
+
+    def operator(self) -> str:
+        if self.complement:
+            return OP_NOT_IN if self.values else OP_EXISTS
+        return OP_IN if self.values else OP_DOES_NOT_EXIST
+
+    def len(self) -> int:
+        """Cardinality; complement sets are 'infinite' (requirement.go:210)."""
+        if self.complement:
+            return INFINITE - len(self.values)
+        return len(self.values)
+
+    def min_values(self) -> List[str]:
+        return sorted(self.values)
+
+    def to_node_selector_requirement(self) -> NodeSelectorRequirement:
+        """Round-trip back to the API shape (requirement.go:81)."""
+        if self.greater_than is not None:
+            return NodeSelectorRequirement(self.key, OP_GT, [str(self.greater_than)])
+        if self.less_than is not None:
+            return NodeSelectorRequirement(self.key, OP_LT, [str(self.less_than)])
+        if self.complement:
+            if self.values:
+                return NodeSelectorRequirement(self.key, OP_NOT_IN, sorted(self.values))
+            return NodeSelectorRequirement(self.key, OP_EXISTS, [])
+        if self.values:
+            return NodeSelectorRequirement(self.key, OP_IN, sorted(self.values))
+        return NodeSelectorRequirement(self.key, OP_DOES_NOT_EXIST, [])
+
+    def copy(self) -> "Requirement":
+        return Requirement._raw(self.key, self.complement, set(self.values), self.greater_than, self.less_than)
+
+    def __repr__(self) -> str:
+        op = self.operator()
+        if op in (OP_EXISTS, OP_DOES_NOT_EXIST):
+            s = f"{self.key} {op}"
+        else:
+            vals = sorted(self.values)
+            if len(vals) > 5:
+                vals = vals[:5] + [f"and {len(vals) - 5} others"]
+            s = f"{self.key} {op} {vals}"
+        if self.greater_than is not None:
+            s += f" >{self.greater_than}"
+        if self.less_than is not None:
+            s += f" <{self.less_than}"
+        return s
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Requirement)
+            and self.key == other.key
+            and self.complement == other.complement
+            and self.values == other.values
+            and self.greater_than == other.greater_than
+            and self.less_than == other.less_than
+        )
+
+
+def _within(value: str, greater_than: Optional[int], less_than: Optional[int]) -> bool:
+    """Bounds check; non-integers are invalid when bounds exist
+    (requirement.go:238 withinIntPtrs)."""
+    if greater_than is None and less_than is None:
+        return True
+    try:
+        v = int(value)
+    except ValueError:
+        return False
+    if greater_than is not None and greater_than >= v:
+        return False
+    if less_than is not None and less_than <= v:
+        return False
+    return True
+
+
+def _min_opt(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
+
+
+def _max_opt(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)

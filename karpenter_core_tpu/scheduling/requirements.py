@@ -1,0 +1,161 @@
+"""Requirements: a keyed map of Requirement with karpenter's compatibility
+rules (ref pkg/scheduling/requirements.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional
+
+from ..apis import labels as wk
+from ..kube.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    Pod,
+)
+from .requirement import Requirement
+
+
+class Requirements(Dict[str, Requirement]):
+    """dict[key → Requirement]; Add intersects on duplicate keys
+    (requirements.go:118)."""
+
+    def __init__(self, *requirements: Requirement):
+        super().__init__()
+        self.add(*requirements)
+
+    def add(self, *requirements: Requirement) -> None:
+        for req in requirements:
+            existing = super().get(req.key)
+            if existing is not None:
+                req = req.intersection(existing)
+            self[req.key] = req
+
+    def keys_set(self) -> FrozenSet[str]:
+        return frozenset(self.keys())
+
+    def has(self, key: str) -> bool:
+        return key in self
+
+    def get_req(self, key: str) -> Requirement:
+        """Missing keys behave as Exists (requirements.go:145)."""
+        req = super().get(key)
+        if req is None:
+            return Requirement(key, OP_EXISTS)
+        return req
+
+    def values_list(self) -> List[Requirement]:
+        return list(self.values())
+
+    def copy(self) -> "Requirements":
+        out = Requirements()
+        for k, v in self.items():
+            dict.__setitem__(out, k, v.copy())
+        return out
+
+    # -- compatibility (requirements.go:163-258) ---------------------------
+
+    def compatible(
+        self, incoming: "Requirements", allow_undefined: FrozenSet[str] = frozenset()
+    ) -> Optional[str]:
+        """None if compatible, else an error string.
+
+        Custom labels must intersect, and are denied when undefined on the
+        receiver; labels in ``allow_undefined`` (well-known) must intersect
+        only when defined. Mirrors Compatible + AllowUndefinedWellKnownLabels.
+        """
+        errs = []
+        for key in incoming.keys_set() - allow_undefined:
+            if key in self:
+                continue
+            op = incoming.get_req(key).operator()
+            if op in (OP_NOT_IN, OP_DOES_NOT_EXIST):
+                continue
+            errs.append(f'label "{key}" does not have known values')
+        err = self.intersects(incoming)
+        if err:
+            errs.append(err)
+        return "; ".join(errs) if errs else None
+
+    def intersects(self, incoming: "Requirements") -> Optional[str]:
+        """Error string unless all shared keys have overlapping values
+        (requirements.go:241), with the NotIn/DoesNotExist carve-out."""
+        errs = []
+        for key in self.keys_set() & incoming.keys_set():
+            existing = self.get_req(key)
+            inc = incoming.get_req(key)
+            if existing.intersection(inc).len() == 0:
+                if inc.operator() in (OP_NOT_IN, OP_DOES_NOT_EXIST) and existing.operator() in (
+                    OP_NOT_IN,
+                    OP_DOES_NOT_EXIST,
+                ):
+                    continue
+                errs.append(f"key {key}, {inc!r} not in {existing!r}")
+        return "; ".join(errs) if errs else None
+
+    def labels(self) -> Dict[str, str]:
+        """Representative labels for launching (requirements.go:260)."""
+        out = {}
+        for key, req in self.items():
+            if not wk.is_restricted_node_label(key):
+                value = req.any()
+                if value:
+                    out[key] = value
+        return out
+
+    def __repr__(self) -> str:
+        reqs = [repr(r) for k, r in self.items() if k not in wk.RESTRICTED_LABELS]
+        return ", ".join(sorted(reqs))
+
+
+ALLOW_UNDEFINED_WELL_KNOWN_LABELS = frozenset(wk.WELL_KNOWN_LABELS)
+
+
+def label_requirements(labels: Dict[str, str]) -> Requirements:
+    """Labels → In-requirements (requirements.go:56)."""
+    return Requirements(*(Requirement(k, OP_IN, [v]) for k, v in labels.items()))
+
+
+def node_selector_requirements(reqs) -> Requirements:
+    return Requirements(*(Requirement(r.key, r.operator, r.values) for r in reqs))
+
+
+def _pod_requirements(pod: Pod, include_preferred: bool) -> Requirements:
+    """Pod → requirements: nodeSelector + first required node-affinity term
+    (+ heaviest preference when included). Ref requirements.go:81-101."""
+    requirements = label_requirements(pod.spec.node_selector)
+    aff = pod.spec.affinity
+    if aff is None or aff.node_affinity is None:
+        return requirements
+    na = aff.node_affinity
+    if include_preferred and na.preferred:
+        heaviest = max(na.preferred, key=lambda t: t.weight)
+        requirements.add(
+            *node_selector_requirements(heaviest.preference.match_expressions).values_list()
+        )
+    if na.required is not None and na.required.node_selector_terms:
+        requirements.add(
+            *node_selector_requirements(
+                na.required.node_selector_terms[0].match_expressions
+            ).values_list()
+        )
+    return requirements
+
+
+def pod_requirements(pod: Pod) -> Requirements:
+    """Preferred treated as required; relaxed by the outer loop
+    (requirements.go:65 NewPodRequirements)."""
+    return _pod_requirements(pod, include_preferred=True)
+
+
+def strict_pod_requirements(pod: Pod) -> Requirements:
+    """Only true requirements (requirements.go:70 NewStrictPodRequirements)."""
+    return _pod_requirements(pod, include_preferred=False)
+
+
+def has_preferred_node_affinity(pod: Pod) -> bool:
+    return (
+        pod.spec.affinity is not None
+        and pod.spec.affinity.node_affinity is not None
+        and len(pod.spec.affinity.node_affinity.preferred) > 0
+    )

@@ -1,0 +1,108 @@
+"""Resource-list arithmetic (ref pkg/utils/resources/resources.go).
+
+ResourceLists are plain ``dict[str, int]`` in integer nanos (see
+``kube.quantity``): exact, fast, and trivially serialized to the TPU
+tensorization layer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from ..kube.objects import Container, Pod, ResourceList, RESOURCE_PODS
+from ..kube.quantity import NANO
+
+
+def merge(*lists: ResourceList) -> ResourceList:
+    """Sum resource lists (resources.go:49 Merge)."""
+    result: ResourceList = {}
+    for rl in lists:
+        for name, qty in rl.items():
+            result[name] = result.get(name, 0) + qty
+    return result
+
+
+def subtract(lhs: ResourceList, rhs: ResourceList) -> ResourceList:
+    """lhs - rhs over lhs's keys (resources.go:83 Subtract)."""
+    return {name: qty - rhs.get(name, 0) for name, qty in lhs.items()}
+
+
+def max_resources(*lists: ResourceList) -> ResourceList:
+    """Element-wise max (resources.go:116 MaxResources)."""
+    result: ResourceList = {}
+    for rl in lists:
+        for name, qty in rl.items():
+            if name not in result or qty > result[name]:
+                result[name] = qty
+    return result
+
+
+def fits(candidate: ResourceList, total: ResourceList) -> bool:
+    """candidate ≤ total element-wise; negative totals never fit
+    (resources.go:162 Fits)."""
+    for qty in total.values():
+        if qty < 0:
+            return False
+    for name, qty in candidate.items():
+        if qty > total.get(name, 0):
+            return False
+    return True
+
+
+def merge_limits_into_requests(container: Container) -> ResourceList:
+    """Limits become requests when requests are unset (resources.go:129)."""
+    requests = dict(container.resources.requests)
+    for name, qty in container.resources.limits.items():
+        requests.setdefault(name, qty)
+    return requests
+
+
+def ceiling(pod: Pod) -> ResourceList:
+    """Effective pod requests: sum of containers, max'd with each init
+    container, plus overhead (resources.go:99 Ceiling, requests side)."""
+    requests: ResourceList = {}
+    for c in pod.spec.containers:
+        requests = merge(requests, merge_limits_into_requests(c))
+    for c in pod.spec.init_containers:
+        requests = max_resources(requests, merge_limits_into_requests(c))
+    if pod.spec.overhead:
+        requests = merge(requests, pod.spec.overhead)
+    return requests
+
+
+def limits_ceiling(pod: Pod) -> ResourceList:
+    limits: ResourceList = {}
+    for c in pod.spec.containers:
+        limits = merge(limits, c.resources.limits)
+    for c in pod.spec.init_containers:
+        limits = max_resources(limits, c.resources.limits)
+    return limits
+
+
+def requests_for_pods(*pods: Pod) -> ResourceList:
+    """Total requests incl. an implicit "pods" count (resources.go:27)."""
+    merged = merge(*(ceiling(p) for p in pods))
+    merged[RESOURCE_PODS] = len(pods) * NANO
+    return merged
+
+
+def limits_for_pods(*pods: Pod) -> ResourceList:
+    merged = merge(*(limits_ceiling(p) for p in pods))
+    merged[RESOURCE_PODS] = len(pods) * NANO
+    return merged
+
+
+def cmp(lhs: int, rhs: int) -> int:
+    return (lhs > rhs) - (lhs < rhs)
+
+
+def is_zero(rl: ResourceList) -> bool:
+    return all(v == 0 for v in rl.values())
+
+
+def to_string(rl: ResourceList) -> str:
+    from ..kube.quantity import format_quantity
+
+    if not rl:
+        return "{}"
+    return "{" + ", ".join(f"{k}: {format_quantity(v)}" for k, v in sorted(rl.items())) + "}"

@@ -1,0 +1,41 @@
+"""Taints / tolerations (ref pkg/scheduling/taints.go)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ..apis import labels as wk
+from ..kube.objects import EFFECT_NO_SCHEDULE, Pod, Taint
+
+# taints the kubelet/cloud-provider applies transiently during startup
+# (taints.go:28-32 KnownEphemeralTaints)
+KNOWN_EPHEMERAL_TAINTS = [
+    Taint(key=wk.TAINT_NODE_NOT_READY, effect=EFFECT_NO_SCHEDULE),
+    Taint(key=wk.TAINT_NODE_UNREACHABLE, effect=EFFECT_NO_SCHEDULE),
+    Taint(key=wk.TAINT_EXTERNAL_CLOUD_PROVIDER, value="true", effect=EFFECT_NO_SCHEDULE),
+]
+
+
+class Taints(List[Taint]):
+    """Decorated taint list (taints.go:35)."""
+
+    def tolerates(self, pod: Pod) -> Optional[str]:
+        """None if the pod tolerates every taint, else an error string
+        (taints.go:38)."""
+        errs = []
+        for taint in self:
+            if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+                errs.append(f"did not tolerate {taint.key}={taint.value}:{taint.effect}")
+        return "; ".join(errs) if errs else None
+
+    def merge(self, other: Iterable[Taint]) -> "Taints":
+        """Union keeping self's entries on key+effect conflicts (taints.go:53)."""
+        res = Taints(self)
+        for taint in other:
+            if not any(taint.match(t) for t in res):
+                res.append(taint)
+        return res
+
+
+def tolerates(taints: Iterable[Taint], pod: Pod) -> Optional[str]:
+    return Taints(taints).tolerates(pod)

@@ -1,0 +1,52 @@
+from karpenter_core_tpu.kube.quantity import NANO, format_quantity, parse_quantity
+
+
+def test_plain_integers():
+    assert parse_quantity("1") == NANO
+    assert parse_quantity("100") == 100 * NANO
+    assert parse_quantity(4) == 4 * NANO
+
+
+def test_milli():
+    assert parse_quantity("100m") == 100 * 10**6
+    assert parse_quantity("1500m") == 1500 * 10**6
+
+
+def test_binary_suffixes():
+    assert parse_quantity("1Ki") == 1024 * NANO
+    assert parse_quantity("1Gi") == 2**30 * NANO
+    assert parse_quantity("2Mi") == 2 * 2**20 * NANO
+
+
+def test_decimal_suffixes():
+    assert parse_quantity("1k") == 1000 * NANO
+    assert parse_quantity("1G") == 10**9 * NANO
+
+
+def test_fractional():
+    assert parse_quantity("2.5") == 2_500_000_000
+    assert parse_quantity("0.1") == 100_000_000
+    assert parse_quantity("1.5Gi") == int(1.5 * 2**30 * NANO)
+
+
+def test_scientific():
+    assert parse_quantity("12e6") == 12_000_000 * NANO
+
+
+def test_negative():
+    assert parse_quantity("-1") == -NANO
+    assert parse_quantity("-500m") == -500 * 10**6
+
+
+def test_nano_micro():
+    assert parse_quantity("1n") == 1
+    assert parse_quantity("1u") == 1000
+
+
+def test_format_roundtrip():
+    for s in ["1", "100m", "42", "1500m"]:
+        assert parse_quantity(format_quantity(parse_quantity(s))) == parse_quantity(s)
+
+
+def test_float_input():
+    assert parse_quantity(0.5) == NANO // 2

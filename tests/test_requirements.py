@@ -1,0 +1,270 @@
+"""Requirement algebra tests, modeled on the reference's
+pkg/scheduling/requirement(s)_test.go matrix: pairwise intersection
+across operator classes, Has/Any semantics, Compatible/Intersects rules,
+plus exhaustive small-universe property checks."""
+
+import itertools
+
+import pytest
+
+from karpenter_core_tpu.kube.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    Affinity,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+)
+from karpenter_core_tpu.scheduling import INFINITE, Requirement, Requirements
+from karpenter_core_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    label_requirements,
+    pod_requirements,
+    strict_pod_requirements,
+)
+
+
+def req(op, *values):
+    return Requirement("key", op, list(values))
+
+
+class TestOperators:
+    def test_operator_classification(self):
+        assert req(OP_IN, "a").operator() == OP_IN
+        assert req(OP_NOT_IN, "a").operator() == OP_NOT_IN
+        assert req(OP_EXISTS).operator() == OP_EXISTS
+        assert req(OP_DOES_NOT_EXIST).operator() == OP_DOES_NOT_EXIST
+        # Gt/Lt are Exists-with-bounds (requirement.go:202)
+        assert req(OP_GT, "5").operator() == OP_EXISTS
+        assert req(OP_LT, "5").operator() == OP_EXISTS
+
+    def test_len(self):
+        assert req(OP_IN, "a", "b").len() == 2
+        assert req(OP_DOES_NOT_EXIST).len() == 0
+        assert req(OP_EXISTS).len() == INFINITE
+        assert req(OP_NOT_IN, "a").len() == INFINITE - 1
+
+
+class TestHas:
+    def test_in(self):
+        r = req(OP_IN, "a", "b")
+        assert r.has("a") and r.has("b") and not r.has("c")
+
+    def test_not_in(self):
+        r = req(OP_NOT_IN, "a")
+        assert not r.has("a") and r.has("b")
+
+    def test_exists(self):
+        assert req(OP_EXISTS).has("anything")
+
+    def test_does_not_exist(self):
+        assert not req(OP_DOES_NOT_EXIST).has("anything")
+
+    def test_gt_lt(self):
+        assert req(OP_GT, "5").has("6")
+        assert not req(OP_GT, "5").has("5")
+        assert req(OP_LT, "5").has("4")
+        assert not req(OP_LT, "5").has("5")
+        # non-integer values are invalid under bounds (requirement.go:242)
+        assert not req(OP_GT, "5").has("abc")
+
+
+class TestIntersection:
+    def test_in_in(self):
+        assert req(OP_IN, "a", "b").intersection(req(OP_IN, "b", "c")).values == {"b"}
+
+    def test_in_not_in(self):
+        assert req(OP_IN, "a", "b").intersection(req(OP_NOT_IN, "a")).values == {"b"}
+
+    def test_not_in_not_in(self):
+        r = req(OP_NOT_IN, "a").intersection(req(OP_NOT_IN, "b"))
+        assert r.complement and r.values == {"a", "b"}
+
+    def test_in_exists(self):
+        r = req(OP_IN, "a").intersection(req(OP_EXISTS))
+        assert not r.complement and r.values == {"a"}
+
+    def test_anything_does_not_exist(self):
+        for other in [req(OP_IN, "a"), req(OP_NOT_IN, "a"), req(OP_EXISTS), req(OP_DOES_NOT_EXIST)]:
+            assert other.intersection(req(OP_DOES_NOT_EXIST)).len() == 0
+
+    def test_gt_lt_degenerate(self):
+        # gt >= lt collapses to DoesNotExist (requirement.go:135)
+        r = req(OP_GT, "5").intersection(req(OP_LT, "5"))
+        assert r.operator() == OP_DOES_NOT_EXIST
+        assert r.len() == 0
+
+    def test_in_with_bounds(self):
+        r = req(OP_IN, "1", "5", "9").intersection(req(OP_GT, "2"))
+        assert r.values == {"5", "9"}
+        r2 = r.intersection(req(OP_LT, "9"))
+        assert r2.values == {"5"}
+
+    def test_bounds_preserved_on_complements(self):
+        r = req(OP_GT, "2").intersection(req(OP_LT, "8"))
+        assert r.complement and r.greater_than == 2 and r.less_than == 8
+        assert r.has("5") and not r.has("2") and not r.has("8")
+
+    def test_commutative_on_concrete_sets(self):
+        cases = [
+            req(OP_IN, "a", "b"),
+            req(OP_NOT_IN, "b", "c"),
+            req(OP_EXISTS),
+            req(OP_DOES_NOT_EXIST),
+            req(OP_GT, "3"),
+            req(OP_LT, "7"),
+        ]
+        universe = ["a", "b", "c", "2", "5", "8"]
+        for r1, r2 in itertools.product(cases, cases):
+            lhs, rhs = r1.intersection(r2), r2.intersection(r1)
+            for v in universe:
+                assert lhs.has(v) == rhs.has(v), f"{r1!r} ∩ {r2!r} disagree on {v}"
+
+
+class TestExhaustiveSmallUniverse:
+    """Intersection.has(v) must equal r1.has(v) and r2.has(v) for all ops."""
+
+    UNIVERSE = ["1", "2", "3", "x"]
+
+    def all_reqs(self):
+        vals = self.UNIVERSE
+        out = [Requirement("k", OP_EXISTS), Requirement("k", OP_DOES_NOT_EXIST)]
+        for n in (1, 2):
+            for c in itertools.combinations(vals, n):
+                out.append(Requirement("k", OP_IN, c))
+                out.append(Requirement("k", OP_NOT_IN, c))
+        out.append(Requirement("k", OP_GT, ["1"]))
+        out.append(Requirement("k", OP_LT, ["3"]))
+        return out
+
+    def test_intersection_is_conjunction(self):
+        for r1, r2 in itertools.product(self.all_reqs(), repeat=2):
+            inter = r1.intersection(r2)
+            for v in self.UNIVERSE + ["zz", "0", "99"]:
+                expected = r1.has(v) and r2.has(v)
+                assert inter.has(v) == expected, f"{r1!r} ∩ {r2!r} on {v!r}"
+
+
+class TestRequirements:
+    def test_add_intersects_same_key(self):
+        rs = Requirements(Requirement("k", OP_IN, ["a", "b"]))
+        rs.add(Requirement("k", OP_IN, ["b", "c"]))
+        assert rs.get_req("k").values == {"b"}
+
+    def test_get_missing_is_exists(self):
+        assert Requirements().get_req("zone").operator() == OP_EXISTS
+
+    def test_intersects_overlap(self):
+        a = Requirements(Requirement("k", OP_IN, ["a", "b"]))
+        b = Requirements(Requirement("k", OP_IN, ["b"]))
+        assert a.intersects(b) is None
+
+    def test_intersects_disjoint(self):
+        a = Requirements(Requirement("k", OP_IN, ["a"]))
+        b = Requirements(Requirement("k", OP_IN, ["b"]))
+        assert a.intersects(b) is not None
+
+    def test_intersects_not_in_carveout(self):
+        # both NotIn/DoesNotExist with empty intersection is allowed
+        # (requirements.go:248-251)
+        a = Requirements(Requirement("k", OP_DOES_NOT_EXIST))
+        b = Requirements(Requirement("k", OP_NOT_IN, ["a"]))
+        assert a.intersects(b) is None
+
+    def test_compatible_undefined_custom_label_denied(self):
+        node = Requirements()
+        pod = Requirements(Requirement("custom-label", OP_IN, ["v"]))
+        assert node.compatible(pod) is not None
+
+    def test_compatible_undefined_well_known_allowed(self):
+        node = Requirements()
+        pod = Requirements(Requirement("topology.kubernetes.io/zone", OP_IN, ["z1"]))
+        assert node.compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
+
+    def test_compatible_undefined_not_in_allowed(self):
+        node = Requirements()
+        pod = Requirements(Requirement("custom-label", OP_NOT_IN, ["v"]))
+        assert node.compatible(pod) is None
+
+    def test_normalized_label_keys(self):
+        r = Requirement("beta.kubernetes.io/arch", OP_IN, ["amd64"])
+        assert r.key == "kubernetes.io/arch"
+
+    def test_labels_excludes_restricted(self):
+        rs = Requirements(
+            Requirement("kubernetes.io/hostname", OP_IN, ["h"]),
+            Requirement("app", OP_IN, ["web"]),
+        )
+        labels = rs.labels()
+        assert "kubernetes.io/hostname" not in labels
+        assert labels["app"] == "web"
+
+
+class TestPodRequirements:
+    def make_pod(self):
+        return Pod(
+            spec=PodSpec(
+                node_selector={"disk": "ssd"},
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required=NodeSelector(
+                            node_selector_terms=[
+                                NodeSelectorTerm(
+                                    match_expressions=[
+                                        NodeSelectorRequirement("zone-req", OP_IN, ["z1"])
+                                    ]
+                                ),
+                                NodeSelectorTerm(
+                                    match_expressions=[
+                                        NodeSelectorRequirement("zone-req", OP_IN, ["z2"])
+                                    ]
+                                ),
+                            ]
+                        ),
+                        preferred=[
+                            PreferredSchedulingTerm(
+                                weight=10,
+                                preference=NodeSelectorTerm(
+                                    match_expressions=[
+                                        NodeSelectorRequirement("pref", OP_IN, ["light"])
+                                    ]
+                                ),
+                            ),
+                            PreferredSchedulingTerm(
+                                weight=50,
+                                preference=NodeSelectorTerm(
+                                    match_expressions=[
+                                        NodeSelectorRequirement("pref", OP_IN, ["heavy"])
+                                    ]
+                                ),
+                            ),
+                        ],
+                    )
+                ),
+            )
+        )
+
+    def test_includes_node_selector(self):
+        rs = pod_requirements(self.make_pod())
+        assert rs.get_req("disk").values == {"ssd"}
+
+    def test_first_required_term_only(self):
+        rs = pod_requirements(self.make_pod())
+        assert rs.get_req("zone-req").values == {"z1"}
+
+    def test_heaviest_preference_included(self):
+        rs = pod_requirements(self.make_pod())
+        assert rs.get_req("pref").values == {"heavy"}
+
+    def test_strict_excludes_preferences(self):
+        rs = strict_pod_requirements(self.make_pod())
+        assert not rs.has("pref")
+        assert rs.get_req("zone-req").values == {"z1"}

@@ -1,0 +1,142 @@
+from karpenter_core_tpu.kube.objects import (
+    Container,
+    ContainerPort,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+)
+from karpenter_core_tpu.kube.quantity import NANO, parse_quantity
+from karpenter_core_tpu.scheduling import HostPortUsage, Taints, get_host_ports, resources
+
+
+def make_pod(requests=None, limits=None, init_requests=None, ports=None):
+    containers = [
+        Container(
+            name="main",
+            resources=ResourceRequirements(
+                requests={k: parse_quantity(v) for k, v in (requests or {}).items()},
+                limits={k: parse_quantity(v) for k, v in (limits or {}).items()},
+            ),
+            ports=ports or [],
+        )
+    ]
+    init = []
+    if init_requests:
+        init = [
+            Container(
+                name="init",
+                resources=ResourceRequirements(
+                    requests={k: parse_quantity(v) for k, v in init_requests.items()}
+                ),
+            )
+        ]
+    return Pod(spec=PodSpec(containers=containers, init_containers=init))
+
+
+class TestResources:
+    def test_merge(self):
+        a = {"cpu": 1 * NANO}
+        b = {"cpu": 2 * NANO, "memory": 5}
+        assert resources.merge(a, b) == {"cpu": 3 * NANO, "memory": 5}
+
+    def test_subtract(self):
+        assert resources.subtract({"cpu": 5}, {"cpu": 2, "memory": 7}) == {"cpu": 3}
+
+    def test_fits(self):
+        assert resources.fits({"cpu": 1}, {"cpu": 1})
+        assert not resources.fits({"cpu": 2}, {"cpu": 1})
+        assert resources.fits({}, {"cpu": 1})
+
+    def test_fits_negative_total(self):
+        # negative totals never fit (resources.go:164)
+        assert not resources.fits({}, {"cpu": -1})
+
+    def test_ceiling_init_containers_max(self):
+        pod = make_pod(requests={"cpu": "1"}, init_requests={"cpu": "3"})
+        assert resources.ceiling(pod)["cpu"] == 3 * NANO
+        pod2 = make_pod(requests={"cpu": "4"}, init_requests={"cpu": "3"})
+        assert resources.ceiling(pod2)["cpu"] == 4 * NANO
+
+    def test_limits_merged_into_requests(self):
+        pod = make_pod(limits={"cpu": "2"})
+        assert resources.ceiling(pod)["cpu"] == 2 * NANO
+
+    def test_requests_for_pods_adds_pod_count(self):
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(3)]
+        total = resources.requests_for_pods(*pods)
+        assert total["cpu"] == 3 * NANO
+        assert total["pods"] == 3 * NANO
+
+
+class TestTaints:
+    def test_no_taints_tolerated(self):
+        assert Taints([]).tolerates(Pod()) is None
+
+    def test_untolerated(self):
+        taints = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        assert taints.tolerates(Pod()) is not None
+
+    def test_equal_toleration(self):
+        taints = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        pod = Pod(spec=PodSpec(tolerations=[Toleration(key="team", operator="Equal", value="a")]))
+        assert taints.tolerates(pod) is None
+        pod_bad = Pod(spec=PodSpec(tolerations=[Toleration(key="team", operator="Equal", value="b")]))
+        assert taints.tolerates(pod_bad) is not None
+
+    def test_exists_toleration(self):
+        taints = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        pod = Pod(spec=PodSpec(tolerations=[Toleration(key="team", operator="Exists")]))
+        assert taints.tolerates(pod) is None
+
+    def test_empty_key_exists_tolerates_everything(self):
+        taints = Taints([Taint(key="x", value="y", effect="NoExecute")])
+        pod = Pod(spec=PodSpec(tolerations=[Toleration(operator="Exists")]))
+        assert taints.tolerates(pod) is None
+
+    def test_effect_mismatch(self):
+        taints = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        pod = Pod(
+            spec=PodSpec(
+                tolerations=[Toleration(key="team", operator="Exists", effect="NoExecute")]
+            )
+        )
+        assert taints.tolerates(pod) is not None
+
+    def test_merge_keeps_existing(self):
+        a = Taints([Taint(key="k", value="v1", effect="NoSchedule")])
+        merged = a.merge([Taint(key="k", value="v2", effect="NoSchedule"), Taint(key="j", effect="NoExecute")])
+        assert len(merged) == 2
+        assert merged[0].value == "v1"
+
+
+class TestHostPorts:
+    def test_extract(self):
+        pod = make_pod(ports=[ContainerPort(host_port=8080), ContainerPort(container_port=80)])
+        ports = get_host_ports(pod)
+        assert len(ports) == 1
+        assert ports[0].port == 8080 and ports[0].ip == "0.0.0.0"
+
+    def test_conflict(self):
+        usage = HostPortUsage()
+        p1 = make_pod(ports=[ContainerPort(host_port=8080)])
+        p2 = make_pod(ports=[ContainerPort(host_port=8080)])
+        p1.metadata.name, p2.metadata.name = "p1", "p2"
+        usage.add(p1, get_host_ports(p1))
+        assert usage.conflicts(p2, get_host_ports(p2)) is not None
+
+    def test_different_ips_no_conflict(self):
+        usage = HostPortUsage()
+        p1 = make_pod(ports=[ContainerPort(host_port=8080, host_ip="10.0.0.1")])
+        p2 = make_pod(ports=[ContainerPort(host_port=8080, host_ip="10.0.0.2")])
+        p1.metadata.name, p2.metadata.name = "p1", "p2"
+        usage.add(p1, get_host_ports(p1))
+        assert usage.conflicts(p2, get_host_ports(p2)) is None
+
+    def test_same_pod_no_conflict(self):
+        usage = HostPortUsage()
+        p1 = make_pod(ports=[ContainerPort(host_port=8080)])
+        p1.metadata.name = "p1"
+        usage.add(p1, get_host_ports(p1))
+        assert usage.conflicts(p1, get_host_ports(p1)) is None
