@@ -57,18 +57,19 @@ LABEL_DOMAIN_EXCEPTIONS = frozenset(
     {"kops.k8s.io", "node.kubernetes.io", "node-restriction.kubernetes.io"}
 )
 
-WELL_KNOWN_LABELS = frozenset(
-    {
-        NODEPOOL_LABEL_KEY,
-        LABEL_TOPOLOGY_ZONE,
-        LABEL_TOPOLOGY_REGION,
-        LABEL_INSTANCE_TYPE,
-        LABEL_ARCH,
-        LABEL_OS,
-        CAPACITY_TYPE_LABEL_KEY,
-        LABEL_WINDOWS_BUILD,
-    }
-)
+# mutable: cloud providers may register additional well-known labels at
+# import time (the reference's fake does this in init(),
+# fake/instancetype.go:42-47)
+WELL_KNOWN_LABELS = {
+    NODEPOOL_LABEL_KEY,
+    LABEL_TOPOLOGY_ZONE,
+    LABEL_TOPOLOGY_REGION,
+    LABEL_INSTANCE_TYPE,
+    LABEL_ARCH,
+    LABEL_OS,
+    CAPACITY_TYPE_LABEL_KEY,
+    LABEL_WINDOWS_BUILD,
+}
 
 RESTRICTED_LABELS = frozenset({LABEL_HOSTNAME})
 

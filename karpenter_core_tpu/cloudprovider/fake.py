@@ -41,11 +41,15 @@ INTEGER_INSTANCE_LABEL_KEY = "integer"
 RESOURCE_GPU_VENDOR_A = "fake.com/vendor-a"
 RESOURCE_GPU_VENDOR_B = "fake.com/vendor-b"
 
-FAKE_WELL_KNOWN = ALLOW_UNDEFINED_WELL_KNOWN_LABELS | {
-    LABEL_INSTANCE_SIZE,
-    EXOTIC_INSTANCE_LABEL_KEY,
-    INTEGER_INSTANCE_LABEL_KEY,
-}
+_FAKE_LABELS = {LABEL_INSTANCE_SIZE, EXOTIC_INSTANCE_LABEL_KEY, INTEGER_INSTANCE_LABEL_KEY}
+
+
+def register_fake_well_known_labels() -> None:
+    """Register the fake's extra labels as well-known (the reference does
+    this in a test-package init(), fake/instancetype.go:42-47). Called from
+    the catalog constructors so merely importing this module doesn't change
+    global label semantics."""
+    wk.WELL_KNOWN_LABELS.update(_FAKE_LABELS)
 
 
 def price_from_resources(res: ResourceList) -> float:
@@ -70,6 +74,7 @@ def new_instance_type(
 ) -> InstanceType:
     """Synthetic instance type with the reference's defaulting
     (fake/instancetype.go:50 NewInstanceType)."""
+    register_fake_well_known_labels()
     res: ResourceList = {k: parse_quantity(v) for k, v in (resources_map or {}).items()}
     res.setdefault("cpu", parse_quantity("4"))
     res.setdefault("memory", parse_quantity("4Gi"))
@@ -190,7 +195,7 @@ class FakeCloudProvider(CloudProvider):
             candidates = [
                 it
                 for it in self.get_instance_types(np)
-                if reqs.compatible(it.requirements, FAKE_WELL_KNOWN) is None
+                if reqs.compatible(it.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
                 and len(it.offerings.requirements(reqs).available()) > 0
                 and resources.fits(node_claim.spec.resources.requests, it.allocatable())
             ]
@@ -213,7 +218,7 @@ class FakeCloudProvider(CloudProvider):
                     Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [o.zone]),
                     Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [o.capacity_type]),
                 )
-                if reqs.compatible(offer_reqs, FAKE_WELL_KNOWN) is None:
+                if reqs.compatible(offer_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None:
                     labels[wk.LABEL_TOPOLOGY_ZONE] = o.zone
                     labels[wk.CAPACITY_TYPE_LABEL_KEY] = o.capacity_type
                     break

@@ -1,0 +1,166 @@
+"""In-memory Kubernetes API store.
+
+The reference tests against a real apiserver (envtest); our equivalent
+is this in-memory store with the semantics controllers rely on:
+get/list/create/update/delete, label-selector filtering, finalizer-aware
+deletion, and watch callbacks. It is both the test control plane and the
+default runtime store for simulation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .objects import KubeObject, LabelSelector
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+# watch event types
+ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
+
+
+class KubeClient:
+    """Thread-safe in-memory object store keyed by (kind, namespace, name)."""
+
+    def __init__(self) -> None:
+        self._objects: Dict[str, Dict[tuple, KubeObject]] = defaultdict(dict)
+        self._watchers: Dict[str, List[Callable]] = defaultdict(list)
+        self._lock = threading.RLock()
+        self._rv = 0
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _key(obj: KubeObject) -> tuple:
+        return (obj.namespace, obj.name)
+
+    def _notify(self, event: str, obj: KubeObject) -> None:
+        for cb in list(self._watchers.get(obj.kind, ())):
+            cb(event, obj)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: KubeObject) -> KubeObject:
+        with self._lock:
+            kind = obj.kind
+            key = self._key(obj)
+            if key in self._objects[kind]:
+                raise Conflict(f"{kind} {key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[kind][key] = obj
+        self._notify(ADDED, obj)
+        return obj
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Optional[KubeObject]:
+        with self._lock:
+            return self._objects[kind].get((namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[LabelSelector] = None,
+        filter_fn: Optional[Callable[[KubeObject], bool]] = None,
+    ) -> List[KubeObject]:
+        with self._lock:
+            objs = list(self._objects[kind].values())
+        if namespace is not None:
+            objs = [o for o in objs if o.namespace == namespace]
+        if label_selector is not None:
+            objs = [o for o in objs if label_selector.matches(o.metadata.labels)]
+        if filter_fn is not None:
+            objs = [o for o in objs if filter_fn(o)]
+        return objs
+
+    def update(self, obj: KubeObject) -> KubeObject:
+        with self._lock:
+            kind = obj.kind
+            key = self._key(obj)
+            if key not in self._objects[kind]:
+                raise NotFound(f"{kind} {key} not found")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[kind][key] = obj
+        self._notify(MODIFIED, obj)
+        return obj
+
+    def apply(self, obj: KubeObject) -> KubeObject:
+        """Create-or-update convenience."""
+        with self._lock:
+            if self._key(obj) in self._objects[obj.kind]:
+                return self.update(obj)
+            return self.create(obj)
+
+    def delete(self, obj_or_kind, name: str = "", namespace: str = "") -> bool:
+        """Finalizer-aware delete: sets deletionTimestamp when finalizers
+        remain, removes otherwise (apiserver semantics the termination
+        controllers depend on)."""
+        with self._lock:
+            if isinstance(obj_or_kind, KubeObject):
+                kind, key = obj_or_kind.kind, self._key(obj_or_kind)
+            else:
+                kind, key = obj_or_kind, (namespace, name)
+            obj = self._objects[kind].get(key)
+            if obj is None:
+                return False
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = time.time()
+                    self._rv += 1
+                    obj.metadata.resource_version = self._rv
+                    modified = obj
+                else:
+                    return True
+            else:
+                del self._objects[kind][key]
+                modified = None
+        if modified is not None:
+            self._notify(MODIFIED, modified)
+        else:
+            self._notify(DELETED, obj)
+        return True
+
+    def remove_finalizer(self, obj: KubeObject, finalizer: str) -> None:
+        """Drop a finalizer; if the object is terminating and none remain,
+        actually remove it."""
+        with self._lock:
+            if finalizer in obj.metadata.finalizers:
+                obj.metadata.finalizers.remove(finalizer)
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                self._objects[obj.kind].pop(self._key(obj), None)
+                gone = True
+            else:
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                gone = False
+        self._notify(DELETED if gone else MODIFIED, obj)
+
+    # -- watches -----------------------------------------------------------
+
+    def watch(self, kind: str, callback: Callable[[str, KubeObject], None]) -> Callable[[], None]:
+        """Register a watch callback; returns an unsubscribe fn. New watches
+        receive synthetic ADDED events for existing objects (informer
+        list+watch semantics)."""
+        with self._lock:
+            existing = list(self._objects[kind].values())
+            self._watchers[kind].append(callback)
+        for obj in existing:
+            callback(ADDED, obj)
+
+        def unsubscribe():
+            with self._lock:
+                if callback in self._watchers[kind]:
+                    self._watchers[kind].remove(callback)
+
+        return unsubscribe

@@ -1,0 +1,6 @@
+from .scheduler import Scheduler, SchedulerOptions, Results
+from .nodeclaim import NodeClaimTemplate, SchedulingNodeClaim
+from .existingnode import ExistingNode
+from .topology import Topology, TopologyGroup
+from .queue import Queue
+from .preferences import Preferences

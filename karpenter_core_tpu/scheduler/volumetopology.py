@@ -1,0 +1,75 @@
+"""Volume topology injection: rewrite pod node-affinity with zone
+requirements from bound/dynamic PVCs (ref
+pkg/controllers/provisioning/scheduling/volumetopology.go)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..apis import labels as wk
+from ..kube.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    OP_IN,
+    Pod,
+)
+
+
+class VolumeTopology:
+    def __init__(self, kube_client):
+        self.kube_client = kube_client
+
+    def inject(self, pod: Pod) -> None:
+        """Add zone requirements from the pod's PVCs into every required
+        node-affinity term (volumetopology.go:42 Inject)."""
+        requirements: List[NodeSelectorRequirement] = []
+        for volume in pod.spec.volumes:
+            reqs = self._requirements_for_volume(pod, volume)
+            requirements.extend(reqs)
+        if not requirements:
+            return
+        if pod.spec.affinity is None:
+            pod.spec.affinity = Affinity()
+        if pod.spec.affinity.node_affinity is None:
+            pod.spec.affinity.node_affinity = NodeAffinity()
+        na = pod.spec.affinity.node_affinity
+        if na.required is None:
+            na.required = NodeSelector()
+        if not na.required.node_selector_terms:
+            na.required.node_selector_terms = [NodeSelectorTerm()]
+        # zone requirements apply to every OR'd term (volumetopology.go:66-76)
+        for term in na.required.node_selector_terms:
+            term.match_expressions = term.match_expressions + requirements
+
+    def _requirements_for_volume(self, pod: Pod, volume) -> List[NodeSelectorRequirement]:
+        if volume.persistent_volume_claim:
+            pvc = self.kube_client.get(
+                "PersistentVolumeClaim", volume.persistent_volume_claim, namespace=pod.namespace
+            )
+            if pvc is None:
+                return []
+            # bound PV zones win; else storage class allowed topologies
+            if pvc.volume_name:
+                pv = self.kube_client.get("PersistentVolume", pvc.volume_name)
+                if pv is not None and pv.zones:
+                    return [NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, list(pv.zones))]
+            if pvc.storage_class_name:
+                sc = self.kube_client.get("StorageClass", pvc.storage_class_name)
+                if sc is not None and sc.zones:
+                    return [NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, list(sc.zones))]
+        return []
+
+    def validate_persistent_volume_claims(self, pod: Pod) -> Optional[str]:
+        """Error if a referenced PVC doesn't exist
+        (volumetopology.go:171)."""
+        for volume in pod.spec.volumes:
+            if volume.persistent_volume_claim:
+                pvc = self.kube_client.get(
+                    "PersistentVolumeClaim", volume.persistent_volume_claim, namespace=pod.namespace
+                )
+                if pvc is None:
+                    return f'configuring volume "{volume.name}", unable to find persistent volume claim "{volume.persistent_volume_claim}"'
+        return None

@@ -3,7 +3,7 @@ rules (ref pkg/scheduling/requirements.go)."""
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, Iterable, List, Optional
+from typing import AbstractSet, Dict, Iterable, List, Optional
 
 from ..apis import labels as wk
 from ..kube.objects import (
@@ -31,7 +31,7 @@ class Requirements(Dict[str, Requirement]):
                 req = req.intersection(existing)
             self[req.key] = req
 
-    def keys_set(self) -> FrozenSet[str]:
+    def keys_set(self) -> frozenset:
         return frozenset(self.keys())
 
     def has(self, key: str) -> bool:
@@ -56,7 +56,7 @@ class Requirements(Dict[str, Requirement]):
     # -- compatibility (requirements.go:163-258) ---------------------------
 
     def compatible(
-        self, incoming: "Requirements", allow_undefined: FrozenSet[str] = frozenset()
+        self, incoming: "Requirements", allow_undefined: AbstractSet[str] = frozenset()
     ) -> Optional[str]:
         """None if compatible, else an error string.
 
@@ -108,7 +108,8 @@ class Requirements(Dict[str, Requirement]):
         return ", ".join(sorted(reqs))
 
 
-ALLOW_UNDEFINED_WELL_KNOWN_LABELS = frozenset(wk.WELL_KNOWN_LABELS)
+# the live well-known set (providers may extend it at import time)
+ALLOW_UNDEFINED_WELL_KNOWN_LABELS = wk.WELL_KNOWN_LABELS
 
 
 def label_requirements(labels: Dict[str, str]) -> Requirements:

@@ -1,0 +1,1 @@
+from .statenode import StateNode

@@ -1,0 +1,188 @@
+"""StateNode: the merged Node + NodeClaim view (ref
+pkg/controllers/state/statenode.go).
+
+A node's identity during its lifecycle is (NodeClaim?, Node?) — the
+claim exists first, the node joins later, and either can be missing for
+unmanaged nodes. All scheduling reads go through this merged view.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+from ..kube.objects import EFFECT_NO_SCHEDULE, Node, Pod, ResourceList, Taint
+from ..scheduling import HostPortUsage, VolumeUsage, resources
+from ..scheduling.taints import KNOWN_EPHEMERAL_TAINTS
+from ..utils import pod as podutils
+
+DISRUPTION_TAINT = podutils.DISRUPTION_NO_SCHEDULE_TAINT
+
+
+class StateNode:
+    """statenode.go:78 — thread-safety is the Cluster's responsibility."""
+
+    def __init__(self, node: Optional[Node] = None, node_claim: Optional[NodeClaim] = None):
+        self.node = node
+        self.node_claim = node_claim
+        # pod key → requests (statenode.go pod tracking)
+        self.pod_requests: Dict[tuple, ResourceList] = {}
+        self.pod_limits: Dict[tuple, ResourceList] = {}
+        self.daemonset_requests: Dict[tuple, ResourceList] = {}
+        self.daemonset_limits: Dict[tuple, ResourceList] = {}
+        self.host_port_usage = HostPortUsage()
+        self.volume_usage = VolumeUsage()
+        self.marked_for_deletion = False
+        self.nominated_until: float = 0.0
+
+    # -- identity ----------------------------------------------------------
+
+    def name(self) -> str:
+        """NodeClaim name until registered, then Node name (statenode.go:110)."""
+        if self.node is None:
+            return self.node_claim.name if self.node_claim else ""
+        if not self.registered() and self.node_claim is not None:
+            return self.node_claim.name
+        return self.node.name
+
+    def provider_id(self) -> str:
+        if self.node is not None and self.node.spec.provider_id:
+            return self.node.spec.provider_id
+        if self.node_claim is not None:
+            return self.node_claim.status.provider_id
+        return ""
+
+    def hostname(self) -> str:
+        return self.labels().get(wk.LABEL_HOSTNAME, self.name())
+
+    def managed(self) -> bool:
+        """Managed by us ⇔ it has (or had) a NodeClaim / nodepool label."""
+        if self.node_claim is not None:
+            return True
+        return self.node is not None and wk.NODEPOOL_LABEL_KEY in self.node.metadata.labels
+
+    def nodepool_name(self) -> str:
+        return self.labels().get(wk.NODEPOOL_LABEL_KEY, "")
+
+    # -- merged views ------------------------------------------------------
+
+    def labels(self) -> Dict[str, str]:
+        """Node labels once registered, else claim labels (statenode.go:168)."""
+        if not self.registered() and self.node_claim is not None:
+            return dict(self.node_claim.metadata.labels)
+        if self.node is None:
+            return {}
+        return dict(self.node.metadata.labels)
+
+    def annotations(self) -> Dict[str, str]:
+        if not self.registered() and self.node_claim is not None:
+            return dict(self.node_claim.metadata.annotations)
+        if self.node is None:
+            return {}
+        return dict(self.node.metadata.annotations)
+
+    def taints(self) -> List[Taint]:
+        """Effective taints; ephemeral startup taints and (pre-init) startup
+        taints are ignored for scheduling (statenode.go:183-203)."""
+        ephemeral: List[Taint] = list(KNOWN_EPHEMERAL_TAINTS)
+        if not self.initialized() and self.managed() and self.node_claim is not None:
+            ephemeral += self.node_claim.spec.startup_taints
+        if (not self.registered() and self.node_claim is not None) or self.node is None:
+            source = self.node_claim.spec.taints if self.node_claim else []
+        else:
+            source = self.node.spec.taints
+        return [t for t in source if not any(t.match(e) and t.value == e.value for e in ephemeral)]
+
+    def registered(self) -> bool:
+        if self.managed():
+            return (
+                self.node is not None
+                and self.node.metadata.labels.get(wk.NODE_REGISTERED_LABEL_KEY) == "true"
+            )
+        return self.node is not None
+
+    def initialized(self) -> bool:
+        if self.managed():
+            return (
+                self.node is not None
+                and self.node.metadata.labels.get(wk.NODE_INITIALIZED_LABEL_KEY) == "true"
+            )
+        return self.node is not None
+
+    def capacity(self) -> ResourceList:
+        """Claim capacity until initialized (kubelet may under-report while
+        starting), then node capacity (statenode.go:224)."""
+        if not self.initialized() and self.node_claim is not None:
+            if self.node_claim.status.capacity:
+                return dict(self.node_claim.status.capacity)
+        if self.node is None:
+            return {}
+        return dict(self.node.status.capacity)
+
+    def allocatable(self) -> ResourceList:
+        if not self.initialized() and self.node_claim is not None:
+            if self.node_claim.status.allocatable:
+                return dict(self.node_claim.status.allocatable)
+        if self.node is None:
+            return {}
+        return dict(self.node.status.allocatable)
+
+    def available(self) -> ResourceList:
+        """Allocatable minus scheduled pod requests (statenode.go:259)."""
+        return resources.subtract(self.allocatable(), self.pod_request_total())
+
+    def pod_request_total(self) -> ResourceList:
+        return resources.merge(*self.pod_requests.values()) if self.pod_requests else {}
+
+    def pod_limit_total(self) -> ResourceList:
+        return resources.merge(*self.pod_limits.values()) if self.pod_limits else {}
+
+    def daemonset_request_total(self) -> ResourceList:
+        return resources.merge(*self.daemonset_requests.values()) if self.daemonset_requests else {}
+
+    def daemonset_limit_total(self) -> ResourceList:
+        return resources.merge(*self.daemonset_limits.values()) if self.daemonset_limits else {}
+
+    # -- nomination / deletion marks (statenode.go:311-340) ----------------
+
+    def nominate(self, now: float, window: float = 20.0) -> None:
+        self.nominated_until = now + window
+
+    def nominated(self, now: float) -> bool:
+        return self.nominated_until > now
+
+    # -- pod bookkeeping (cluster.updateNodeUsageFromPod) ------------------
+
+    def update_for_pod(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        self.pod_requests[key] = resources.requests_for_pods(pod)
+        self.pod_limits[key] = resources.limits_for_pods(pod)
+        if podutils.is_owned_by_daemonset(pod):
+            self.daemonset_requests[key] = resources.requests_for_pods(pod)
+            self.daemonset_limits[key] = resources.limits_for_pods(pod)
+        from ..scheduling.hostports import get_host_ports
+
+        self.host_port_usage.add(pod, get_host_ports(pod))
+
+    def cleanup_pod(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        self.pod_requests.pop(key, None)
+        self.pod_limits.pop(key, None)
+        self.daemonset_requests.pop(key, None)
+        self.daemonset_limits.pop(key, None)
+        self.host_port_usage.delete_pod(namespace, name)
+        self.volume_usage.delete_pod(namespace, name)
+
+    def deep_copy(self) -> "StateNode":
+        out = StateNode(copy.deepcopy(self.node), copy.deepcopy(self.node_claim))
+        out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
+        out.pod_limits = {k: dict(v) for k, v in self.pod_limits.items()}
+        out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}
+        out.daemonset_limits = {k: dict(v) for k, v in self.daemonset_limits.items()}
+        out.host_port_usage = self.host_port_usage.copy()
+        out.volume_usage = self.volume_usage.copy()
+        out.marked_for_deletion = self.marked_for_deletion
+        out.nominated_until = self.nominated_until
+        return out

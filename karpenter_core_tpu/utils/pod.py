@@ -1,0 +1,107 @@
+"""Pod predicates (ref pkg/utils/pod/scheduling.go)."""
+
+from __future__ import annotations
+
+from ..apis import labels as wk
+from ..kube.objects import EFFECT_NO_SCHEDULE, Pod, Taint
+from ..scheduling.taints import Taints
+
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+DISRUPTION_NO_SCHEDULE_TAINT = Taint(
+    key=wk.DISRUPTION_TAINT_KEY,
+    value=wk.DISRUPTION_NO_SCHEDULE_VALUE,
+    effect=EFFECT_NO_SCHEDULE,
+)
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return pod.spec.node_name != ""
+
+
+def is_terminal(pod: Pod) -> bool:
+    return pod.status.phase in ("Failed", "Succeeded")
+
+
+def is_terminating(pod: Pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def failed_to_schedule(pod: Pod) -> bool:
+    """PodScheduled=False with reason Unschedulable (scheduling.go:36)."""
+    for cond in pod.status.conditions:
+        if cond.type == "PodScheduled" and cond.status == "False" and cond.reason == "Unschedulable":
+            return True
+    return False
+
+
+def is_provisionable(pod: Pod) -> bool:
+    """Unscheduled + marked unschedulable + not terminal/terminating + not a
+    static/node-owned pod (scheduling.go:28)."""
+    return (
+        not is_scheduled(pod)
+        and not is_preempting(pod)
+        and failed_to_schedule(pod)
+        and not is_owned_by_node(pod)
+        and not is_terminal(pod)
+        and not is_terminating(pod)
+    )
+
+
+def is_preempting(pod: Pod) -> bool:
+    return False  # NominatedNodeName isn't modeled; preemption is out of scope
+
+
+def is_owned_by_daemonset(pod: Pod) -> bool:
+    return any(o.kind == "DaemonSet" for o in pod.metadata.owner_references)
+
+
+def is_owned_by_node(pod: Pod) -> bool:
+    return any(o.kind == "Node" for o in pod.metadata.owner_references)
+
+
+def has_do_not_disrupt(pod: Pod) -> bool:
+    """karpenter.sh/do-not-disrupt (+ v1alpha5 do-not-evict compat)
+    (scheduling.go:85)."""
+    ann = pod.metadata.annotations
+    return (
+        ann.get(wk.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true"
+        or ann.get(wk.DO_NOT_EVICT_ANNOTATION_KEY) == "true"
+    )
+
+
+def tolerates_unschedulable_taint(pod: Pod) -> bool:
+    return (
+        Taints([Taint(key=TAINT_NODE_UNSCHEDULABLE, effect=EFFECT_NO_SCHEDULE)]).tolerates(pod)
+        is None
+    )
+
+
+def tolerates_disruption_no_schedule_taint(pod: Pod) -> bool:
+    return Taints([DISRUPTION_NO_SCHEDULE_TAINT]).tolerates(pod) is None
+
+
+def has_pod_anti_affinity(pod: Pod) -> bool:
+    a = pod.spec.affinity
+    return a is not None and a.pod_anti_affinity is not None and (
+        len(a.pod_anti_affinity.required) > 0 or len(a.pod_anti_affinity.preferred) > 0
+    )
+
+
+def has_required_pod_anti_affinity(pod: Pod) -> bool:
+    a = pod.spec.affinity
+    return (
+        a is not None
+        and a.pod_anti_affinity is not None
+        and len(a.pod_anti_affinity.required) > 0
+    )
+
+
+def is_active(pod: Pod) -> bool:
+    return not is_terminal(pod) and not is_terminating(pod)
+
+
+def is_reschedulable(pod: Pod) -> bool:
+    """Pods that must be rescheduled elsewhere when their node is disrupted:
+    active and not owned by the node / daemonset."""
+    return is_active(pod) and not is_owned_by_node(pod) and not is_owned_by_daemonset(pod)

@@ -1,0 +1,158 @@
+"""Object builders for tests (mirrors pkg/test object builders)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.apis.nodepool import NodePool
+from karpenter_core_tpu.kube.objects import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    OwnerReference,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodCondition,
+    PodSpec,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    next_name,
+)
+from karpenter_core_tpu.kube.quantity import parse_quantity
+
+
+def make_pod(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    requests: Optional[Dict[str, object]] = None,
+    limits: Optional[Dict[str, object]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    tolerations: Optional[List[Toleration]] = None,
+    topology_spread: Optional[List[TopologySpreadConstraint]] = None,
+    required_node_affinity: Optional[List[NodeSelectorRequirement]] = None,
+    preferred_node_affinity: Optional[List[PreferredSchedulingTerm]] = None,
+    pod_affinity: Optional[List[PodAffinityTerm]] = None,
+    pod_anti_affinity: Optional[List[PodAffinityTerm]] = None,
+    host_ports: Optional[List[int]] = None,
+    node_name: str = "",
+    owner_kind: Optional[str] = None,
+    phase: str = "Pending",
+    pending_unschedulable: bool = True,
+) -> Pod:
+    pod = Pod()
+    pod.metadata.name = name or next_name("pod")
+    pod.metadata.namespace = namespace
+    pod.metadata.labels = dict(labels or {})
+    pod.metadata.annotations = dict(annotations or {})
+    if owner_kind:
+        pod.metadata.owner_references = [OwnerReference(kind=owner_kind, name="owner")]
+    ports = [ContainerPort(host_port=p) for p in (host_ports or [])]
+    pod.spec = PodSpec(
+        node_name=node_name,
+        node_selector=dict(node_selector or {}),
+        tolerations=list(tolerations or []),
+        topology_spread_constraints=list(topology_spread or []),
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(
+                    requests={k: parse_quantity(v) for k, v in (requests or {}).items()},
+                    limits={k: parse_quantity(v) for k, v in (limits or {}).items()},
+                ),
+                ports=ports,
+            )
+        ],
+    )
+    affinity = Affinity()
+    has_affinity = False
+    if required_node_affinity or preferred_node_affinity:
+        affinity.node_affinity = NodeAffinity(
+            required=(
+                NodeSelector(
+                    node_selector_terms=[NodeSelectorTerm(match_expressions=list(required_node_affinity))]
+                )
+                if required_node_affinity
+                else None
+            ),
+            preferred=list(preferred_node_affinity or []),
+        )
+        has_affinity = True
+    if pod_affinity:
+        affinity.pod_affinity = PodAffinity(required=list(pod_affinity))
+        has_affinity = True
+    if pod_anti_affinity:
+        affinity.pod_anti_affinity = PodAntiAffinity(required=list(pod_anti_affinity))
+        has_affinity = True
+    if has_affinity:
+        pod.spec.affinity = affinity
+    pod.status.phase = phase
+    if pending_unschedulable and not node_name:
+        pod.status.conditions = [
+            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+        ]
+    return pod
+
+
+def make_nodepool(
+    name: str = "default",
+    requirements: Optional[List[NodeSelectorRequirement]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[Taint]] = None,
+    limits: Optional[Dict[str, object]] = None,
+    weight: Optional[int] = None,
+) -> NodePool:
+    np = NodePool()
+    np.metadata.name = name
+    np.spec.template.requirements = list(requirements or [])
+    np.spec.template.metadata.labels = dict(labels or {})
+    np.spec.template.taints = list(taints or [])
+    np.spec.limits = {k: parse_quantity(v) for k, v in (limits or {}).items()}
+    np.spec.weight = weight
+    return np
+
+
+def make_node(
+    name: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    capacity: Optional[Dict[str, object]] = None,
+    allocatable: Optional[Dict[str, object]] = None,
+    taints: Optional[List[Taint]] = None,
+    provider_id: str = "",
+) -> Node:
+    node = Node()
+    node.metadata.name = name or next_name("node")
+    node.metadata.labels = dict(labels or {})
+    node.metadata.labels.setdefault(wk.LABEL_HOSTNAME, node.metadata.name)
+    node.spec.provider_id = provider_id or f"fake:///{node.metadata.name}"
+    node.spec.taints = list(taints or [])
+    node.status.capacity = {k: parse_quantity(v) for k, v in (capacity or {}).items()}
+    node.status.allocatable = (
+        {k: parse_quantity(v) for k, v in (allocatable or capacity or {}).items()}
+    )
+    return node
+
+
+def spread(topology_key: str, max_skew: int = 1, labels: Optional[Dict[str, str]] = None,
+           when_unsatisfiable: str = "DoNotSchedule", min_domains: Optional[int] = None) -> TopologySpreadConstraint:
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=topology_key,
+        when_unsatisfiable=when_unsatisfiable,
+        label_selector=LabelSelector(match_labels=dict(labels or {})),
+        min_domains=min_domains,
+    )
