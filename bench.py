@@ -1,0 +1,109 @@
+"""Benchmark: batched TPU scheduling throughput vs the reference's
+enforced floor.
+
+Config mirrors the reference's profiling grid (BASELINE.md: 400 instance
+types, scheduling_benchmark_test.go:57-77) at 10k pods with the same
+5/7 generic + 2/7 topology-constrained pod mix, solved by the TPU path
+(constraint kernels + FFD scan). Baseline = the reference's test-enforced
+100 pods/sec floor (scheduling_benchmark_test.go:51,177-181).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    # import inside main so the JSON line is the only stdout on success
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.kube.objects import (
+        Container,
+        LabelSelector,
+        Pod,
+        PodCondition,
+        PodSpec,
+        ResourceRequirements,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.kube.quantity import parse_quantity
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    N_PODS = int(os.environ.get("BENCH_PODS", "10000"))
+    N_TYPES = int(os.environ.get("BENCH_TYPES", "400"))
+    rng = np.random.RandomState(42)
+
+    def make_pod(i: int, topo: bool) -> Pod:
+        pod = Pod()
+        pod.metadata.name = f"bench-{i}"
+        pod.metadata.labels = {"app": f"bench-{i % 7}"}
+        cpu = ["100m", "250m", "500m", "1", "1500m", "2"][rng.randint(6)]
+        mem = ["128Mi", "256Mi", "512Mi", "1Gi", "2Gi"][rng.randint(5)]
+        pod.spec = PodSpec(
+            containers=[
+                Container(
+                    name="main",
+                    resources=ResourceRequirements(
+                        requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+                    ),
+                )
+            ]
+        )
+        if topo:
+            # 2/7 of pods carry zone+hostname spreads like the reference mix
+            pod.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": pod.metadata.labels["app"]}),
+                ),
+            ]
+        pod.status.conditions = [
+            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+        ]
+        return pod
+
+    pods = [make_pod(i, topo=(i % 7) >= 5) for i in range(N_PODS)]
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(N_TYPES)
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+
+    solver = TPUScheduler([nodepool], provider)
+
+    # warm-up on the full batch so every pad bucket's ffd_pack shape is
+    # compiled before the timed run (jit caches per padded shape)
+    solver.solve(pods)
+
+    start = time.perf_counter()
+    result = solver.solve(pods)
+    elapsed = time.perf_counter() - start
+
+    scheduled = result.pods_scheduled
+    pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"pods/sec scheduled ({N_PODS} pods x {N_TYPES} instance types, TPU solver)",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""The TPU scheduling core.
+
+No Go analogue — this replaces the reference's per-pod greedy hot loop
+(pkg/controllers/provisioning/scheduling/scheduler.go:140-285) with a
+batched JAX pipeline:
+
+1. ``vocab``/``encode``: requirements → boolean masks over per-key
+   value vocabularies; resources → fixed-width f32 matrices.
+2. ``kernels``: the pods×types compatibility kernel (per-key MXU
+   matmuls) and resource-fit masks — the tensorized equivalent of
+   ``filterInstanceTypesByRequirements`` (nodeclaim.go:225).
+3. ``pack``: K-open-node first-fit-decreasing as a ``lax.scan``,
+   vmapped over constraint-signature groups; cheapest-type assignment.
+4. ``solver``: the end-to-end TPUScheduler with CPU-oracle fallback for
+   relational constraints (pod affinity) and parity metrics.
+"""
+
+from .solver import TPUScheduler, SolverResult

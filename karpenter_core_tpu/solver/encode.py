@@ -1,0 +1,412 @@
+"""Batch tensorization: pods / nodepools / instance types → mask and
+resource tensors.
+
+Key architectural move vs the reference: pods are deduplicated into
+**constraint signatures** first (a deployment's pods share nodeSelector/
+affinity/tolerations — only resource sizes differ), so all host-side
+set algebra is per-signature (S « P) and everything per-pod is a flat
+numeric array. The reference re-runs its set algebra per pod per node
+candidate (nodeclaim.go:65-119); we run it S×pools times, then the
+pods×types math is pure tensor ops.
+
+Resources are quantized per-resource to int32 (ceil for requests,
+floor for allocatable) so packing sums are exact and never overpack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..apis import labels as wk
+from ..apis.nodepool import NodePool
+from ..cloudprovider.types import InstanceType
+from ..kube.objects import OP_DOES_NOT_EXIST, OP_NOT_IN, Pod
+from ..kube.quantity import NANO
+from ..scheduling import Requirement, Requirements, Taints, resources
+from ..scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    pod_requirements,
+)
+from ..utils import pod as podutils
+from .vocab import Vocab
+
+# canonical resource axis order; extras appended sorted
+BASE_RESOURCES = ["cpu", "memory", "pods"]
+
+
+def _is_neg(req: Requirement) -> bool:
+    """Operator ∈ {NotIn, DoesNotExist} — the Intersects carve-out
+    polarity (requirements.go:248-251)."""
+    return req.operator() in (OP_NOT_IN, OP_DOES_NOT_EXIST)
+
+
+@dataclass
+class ResourceAxis:
+    names: List[str]
+    divisors: np.ndarray  # (R,) int64 per-resource quantization divisor
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+    def index(self, name: str) -> Optional[int]:
+        try:
+            return self.names.index(name)
+        except ValueError:
+            return None
+
+
+def build_resource_axis(
+    pods_requests: Sequence[Dict[str, int]], instance_types: Sequence[InstanceType]
+) -> ResourceAxis:
+    names: Set[str] = set(BASE_RESOURCES)
+    for r in pods_requests:
+        names.update(r.keys())
+    for it in instance_types:
+        names.update(it.capacity.keys())
+    ordered = BASE_RESOURCES + sorted(names - set(BASE_RESOURCES))
+    # per-resource divisor: keep the max value under 2^30 after division
+    maxima = np.zeros(len(ordered), dtype=np.float64)
+    for r in pods_requests:
+        for k, v in r.items():
+            maxima[ordered.index(k)] = max(maxima[ordered.index(k)], v)
+    for it in instance_types:
+        for k, v in it.capacity.items():
+            maxima[ordered.index(k)] = max(maxima[ordered.index(k)], v)
+    divisors = np.ones(len(ordered), dtype=np.int64)
+    for i, m in enumerate(maxima):
+        d = 1
+        while m / d >= 2**30:
+            d *= 2
+        divisors[i] = d
+    return ResourceAxis(ordered, divisors)
+
+
+def quantize_requests(requests: Dict[str, int], axis: ResourceAxis) -> np.ndarray:
+    """ceil-quantize a request ResourceList → int32 vector (conservative:
+    never lets a pod look smaller)."""
+    out = np.zeros(axis.count, dtype=np.int64)
+    for k, v in requests.items():
+        i = axis.index(k)
+        if i is not None:
+            # python-int division: nanos can exceed int64 after ×, and the
+            # quantized result always fits int32
+            out[i] = -(-int(v) // int(axis.divisors[i]))
+    return out.astype(np.int32)
+
+
+def quantize_capacity(capacity: Dict[str, int], axis: ResourceAxis) -> np.ndarray:
+    """floor-quantize an allocatable ResourceList (conservative: never lets
+    a node look bigger)."""
+    out = np.zeros(axis.count, dtype=np.int64)
+    for k, v in capacity.items():
+        i = axis.index(k)
+        if i is not None:
+            out[i] = max(int(v), 0) // int(axis.divisors[i])
+    return out.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# instance-type encoding
+
+
+@dataclass
+class EncodedInstanceTypes:
+    """Per-NodePool tensor view of the catalog."""
+
+    instance_types: List[InstanceType]
+    axis: ResourceAxis
+    allocatable: np.ndarray  # (T, R) int32, quantized
+    prices: np.ndarray  # (T,) f64 — cheapest available offering price
+    # per-key requirement masks, ragged over keys:
+    key_masks: Dict[str, np.ndarray]  # key → (T, Vk) bool
+    key_has: Dict[str, np.ndarray]  # key → (T,) bool
+    key_neg: Dict[str, np.ndarray]  # key → (T,) bool
+    # offering availability: (T, Z, C) bool over zone/capacity-type vocabs
+    zones: List[str]
+    capacity_types: List[str]
+    offering_avail: np.ndarray
+    offering_price: np.ndarray  # (T, Z, C) f64 (inf where unavailable)
+
+
+def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis, vocab: Vocab) -> EncodedInstanceTypes:
+    T = len(instance_types)
+    # observe all values first so vocab widths are final
+    for it in instance_types:
+        for req in it.requirements.values():
+            vocab.observe_requirement(req)
+    zones = sorted({o.zone for it in instance_types for o in it.offerings})
+    capacity_types = sorted({o.capacity_type for it in instance_types for o in it.offerings})
+    z_index = {z: i for i, z in enumerate(zones)}
+    c_index = {c: i for i, c in enumerate(capacity_types)}
+
+    allocatable = np.zeros((T, axis.count), dtype=np.int32)
+    prices = np.full(T, np.inf)
+    offering_avail = np.zeros((T, len(zones), len(capacity_types)), dtype=bool)
+    offering_price = np.full((T, len(zones), len(capacity_types)), np.inf)
+    keys = sorted({req.key for it in instance_types for req in it.requirements.values()})
+    key_masks = {k: np.zeros((T, vocab.key_vocab(k).size), dtype=bool) for k in keys}
+    key_has = {k: np.zeros(T, dtype=bool) for k in keys}
+    key_neg = {k: np.zeros(T, dtype=bool) for k in keys}
+
+    for t, it in enumerate(instance_types):
+        allocatable[t] = quantize_capacity(it.allocatable(), axis)
+        for o in it.offerings:
+            if o.available:
+                zi, ci = z_index[o.zone], c_index[o.capacity_type]
+                offering_avail[t, zi, ci] = True
+                offering_price[t, zi, ci] = o.price
+                prices[t] = min(prices[t], o.price)
+        for key, req in it.requirements.items():
+            kv = vocab.key_vocab(key)
+            key_masks[key][t] = vocab.encode_mask(req, kv.size)
+            key_has[key][t] = True
+            key_neg[key][t] = _is_neg(req)
+
+    return EncodedInstanceTypes(
+        instance_types=instance_types,
+        axis=axis,
+        allocatable=allocatable,
+        prices=prices,
+        key_masks=key_masks,
+        key_has=key_has,
+        key_neg=key_neg,
+        zones=zones,
+        capacity_types=capacity_types,
+        offering_avail=offering_avail,
+        offering_price=offering_price,
+    )
+
+
+# ---------------------------------------------------------------------------
+# pod signatures
+
+
+def _toleration_key(t) -> tuple:
+    return (t.key, t.operator, t.value, t.effect)
+
+
+def _selector_key(sel) -> tuple:
+    if sel is None:
+        return ()
+    return sel.key()
+
+
+def selector_label_keys(pods: Sequence[Pod]) -> Set[str]:
+    """Label keys referenced by any topology-spread / affinity selector in
+    the batch — the only labels that affect scheduling identity."""
+    keys: Set[str] = set()
+
+    def collect(sel) -> None:
+        if sel is None:
+            return
+        keys.update(sel.match_labels.keys())
+        keys.update(e.key for e in sel.match_expressions)
+
+    for pod in pods:
+        for c in pod.spec.topology_spread_constraints:
+            collect(c.label_selector)
+        a = pod.spec.affinity
+        if a is not None:
+            for pa in (a.pod_affinity, a.pod_anti_affinity):
+                if pa is None:
+                    continue
+                for t in pa.required:
+                    collect(t.label_selector)
+                for w in pa.preferred:
+                    collect(w.pod_affinity_term.label_selector)
+    return keys
+
+
+def pod_signature(pod: Pod, relevant_label_keys: Optional[Set[str]] = None) -> tuple:
+    """Constraint identity: pods with equal signatures are interchangeable
+    for compat + topology purposes (resource sizes excluded). Only labels
+    some selector in the batch can match participate — otherwise identical
+    pods from different deployments would never share a node."""
+    if relevant_label_keys is None:
+        labels_key = tuple(sorted(pod.metadata.labels.items()))
+    else:
+        labels_key = tuple(
+            sorted((k, v) for k, v in pod.metadata.labels.items() if k in relevant_label_keys)
+        )
+    spreads = tuple(
+        (c.topology_key, c.max_skew, c.when_unsatisfiable, _selector_key(c.label_selector), c.min_domains)
+        for c in pod.spec.topology_spread_constraints
+    )
+    aff = pod.spec.affinity
+    node_aff_key: tuple = ()
+    pod_aff_key: tuple = ()
+    anti_aff_key: tuple = ()
+    if aff is not None:
+        if aff.node_affinity is not None:
+            na = aff.node_affinity
+            req_terms = (
+                tuple(
+                    tuple((e.key, e.operator, tuple(e.values)) for e in term.match_expressions)
+                    for term in na.required.node_selector_terms
+                )
+                if na.required
+                else ()
+            )
+            pref_terms = tuple(
+                (p.weight, tuple((e.key, e.operator, tuple(e.values)) for e in p.preference.match_expressions))
+                for p in na.preferred
+            )
+            node_aff_key = (req_terms, pref_terms)
+        if aff.pod_affinity is not None:
+            pod_aff_key = tuple(
+                (t.topology_key, _selector_key(t.label_selector), tuple(t.namespaces))
+                for t in aff.pod_affinity.required
+            ) + tuple(
+                (w.weight, w.pod_affinity_term.topology_key, _selector_key(w.pod_affinity_term.label_selector))
+                for w in aff.pod_affinity.preferred
+            )
+        if aff.pod_anti_affinity is not None:
+            anti_aff_key = tuple(
+                (t.topology_key, _selector_key(t.label_selector), tuple(t.namespaces))
+                for t in aff.pod_anti_affinity.required
+            ) + tuple(
+                (w.weight, w.pod_affinity_term.topology_key, _selector_key(w.pod_affinity_term.label_selector))
+                for w in aff.pod_anti_affinity.preferred
+            )
+    return (
+        pod.namespace,
+        labels_key,
+        tuple(sorted(pod.spec.node_selector.items())),
+        tuple(sorted(_toleration_key(t) for t in pod.spec.tolerations)),
+        spreads,
+        node_aff_key,
+        pod_aff_key,
+        anti_aff_key,
+    )
+
+
+@dataclass
+class SignatureGroup:
+    """Pods sharing one constraint signature."""
+
+    signature: tuple
+    exemplar: Pod
+    pod_indices: List[int] = field(default_factory=list)  # into the batch array
+
+    @property
+    def has_relational(self) -> bool:
+        """Pod affinity/anti-affinity needs the oracle (SURVEY §7 hard
+        parts) — except self-anti-affinity on hostname, which tensorizes
+        as pods-per-node=1."""
+        a = self.exemplar.spec.affinity
+        if a is None:
+            return False
+        if a.pod_affinity is not None and (a.pod_affinity.required or a.pod_affinity.preferred):
+            return True
+        if a.pod_anti_affinity is not None:
+            req = a.pod_anti_affinity.required
+            if a.pod_anti_affinity.preferred:
+                return True
+            for term in req:
+                if term.topology_key != wk.LABEL_HOSTNAME:
+                    return True
+                sel = term.label_selector
+                if sel is None or not sel.matches(self.exemplar.metadata.labels):
+                    return True  # anti-affinity against other pods — relational
+        return False
+
+    @property
+    def hostname_isolated(self) -> bool:
+        """Required self-anti-affinity on hostname → one pod per node."""
+        a = self.exemplar.spec.affinity
+        if a is None or a.pod_anti_affinity is None:
+            return False
+        for term in a.pod_anti_affinity.required:
+            if term.topology_key == wk.LABEL_HOSTNAME and term.label_selector is not None and term.label_selector.matches(self.exemplar.metadata.labels):
+                return True
+        return False
+
+    def zone_spread(self):
+        """The zone topology-spread constraint, if any."""
+        for c in self.exemplar.spec.topology_spread_constraints:
+            if c.topology_key == wk.LABEL_TOPOLOGY_ZONE:
+                return c
+        return None
+
+    def hostname_spread(self):
+        for c in self.exemplar.spec.topology_spread_constraints:
+            if c.topology_key == wk.LABEL_HOSTNAME:
+                return c
+        return None
+
+
+def group_pods(pods: List[Pod]) -> List[SignatureGroup]:
+    relevant = selector_label_keys(pods)
+    groups: Dict[tuple, SignatureGroup] = {}
+    for i, pod in enumerate(pods):
+        sig = pod_signature(pod, relevant)
+        g = groups.get(sig)
+        if g is None:
+            g = SignatureGroup(signature=sig, exemplar=pod)
+            groups[sig] = g
+        g.pod_indices.append(i)
+    return list(groups.values())
+
+
+# ---------------------------------------------------------------------------
+# signature × pool compatibility (host-side set algebra, S×pools small)
+
+
+@dataclass
+class PoolEncoding:
+    nodepool: NodePool
+    template_requirements: Requirements
+    taints: Taints
+
+
+@dataclass
+class SignaturePoolCompat:
+    """Host-side verdicts + merged requirement masks for one (signature,
+    pool) pair; feeds the instance-type compat kernel."""
+
+    compatible: bool  # pod vs template (taints + Compatible w/ well-known)
+    error: str = ""
+    # merged (template ∩ pod) requirement encoding, per key:
+    key_mask: Dict[str, np.ndarray] = field(default_factory=dict)  # key → (Vk,) bool
+    key_has: Dict[str, bool] = field(default_factory=dict)
+    key_neg: Dict[str, bool] = field(default_factory=dict)
+    merged: Optional[Requirements] = None
+
+
+def encode_signature_for_pool(
+    group: SignatureGroup, pool: PoolEncoding, vocab: Vocab
+) -> SignaturePoolCompat:
+    """The oracle's per-pod template checks, once per signature
+    (nodeclaim.go:65-101 minus topology)."""
+    pod = group.exemplar
+    err = pool.taints.tolerates(pod)
+    if err:
+        return SignaturePoolCompat(False, err)
+    pod_reqs = pod_requirements(pod)
+    err = pool.template_requirements.compatible(pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+    if err:
+        return SignaturePoolCompat(False, f"incompatible requirements, {err}")
+    merged = Requirements(*pool.template_requirements.values_list())
+    merged.add(*pod_reqs.values_list())
+    out = SignaturePoolCompat(True, merged=merged)
+    for key, req in merged.items():
+        for v in req.values:
+            vocab.key_vocab(key).intern(v)
+        out.key_has[key] = True
+        out.key_neg[key] = _is_neg(req)
+        out.key_mask[key] = req  # mask encoded later, after vocab is final
+    return out
+
+
+def finalize_signature_masks(compats: List[SignaturePoolCompat], vocab: Vocab) -> None:
+    """Second pass: encode masks once every value has been interned."""
+    for c in compats:
+        if not c.compatible:
+            continue
+        for key, req in list(c.key_mask.items()):
+            if isinstance(req, Requirement):
+                c.key_mask[key] = vocab.encode_mask(req, vocab.key_vocab(key).size)

@@ -1,0 +1,104 @@
+"""JAX kernels: signature×type compatibility, offering masks, fits.
+
+The compat kernel is the tensorized ``Intersects`` check
+(requirements.go:241): per key, set-intersection nonemptiness is mask
+overlap (the OTHER slot makes complement sets exact), with the
+both-negative carve-out and missing-key passes. Per-key overlaps are
+(S×Vk)·(Vk×T) matmuls — MXU work once S and T are real batch sizes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encode import EncodedInstanceTypes, SignaturePoolCompat
+
+
+def build_compat_inputs(
+    compats: List[SignaturePoolCompat], enc: EncodedInstanceTypes, vocab
+) -> Dict[str, np.ndarray]:
+    """Stack per-signature masks into arrays aligned with the catalog's
+    key set. Keys only the pod side has are irrelevant to Intersects
+    (missing on the type side ⇒ pass) **except** via the offering check,
+    handled separately."""
+    S = len(compats)
+    arrays: Dict[str, np.ndarray] = {}
+    for key, type_mask in enc.key_masks.items():
+        Vk = type_mask.shape[1]
+        sig_mask = np.zeros((S, Vk), dtype=bool)
+        sig_has = np.zeros(S, dtype=bool)
+        sig_neg = np.zeros(S, dtype=bool)
+        for s, c in enumerate(compats):
+            if not c.compatible:
+                continue
+            if key in c.key_has:
+                m = c.key_mask[key]
+                sig_mask[s, : m.shape[0]] = m[:Vk] if m.shape[0] >= Vk else np.pad(m, (0, Vk - m.shape[0]))
+                sig_has[s] = True
+                sig_neg[s] = c.key_neg[key]
+        arrays[f"mask:{key}"] = sig_mask
+        arrays[f"has:{key}"] = sig_has
+        arrays[f"neg:{key}"] = sig_neg
+    arrays["valid"] = np.array([c.compatible for c in compats], dtype=bool)
+    return arrays
+
+
+@partial(jax.jit, static_argnames=("keys",))
+def compat_kernel(
+    sig_arrays: Dict[str, jnp.ndarray],
+    type_masks: Dict[str, jnp.ndarray],
+    type_has: Dict[str, jnp.ndarray],
+    type_neg: Dict[str, jnp.ndarray],
+    keys: Tuple[str, ...],
+) -> jnp.ndarray:
+    """→ (S, T) bool: signature s compatible with instance type t."""
+    S = sig_arrays["valid"].shape[0]
+    T = next(iter(type_masks.values())).shape[0]
+    ok = jnp.broadcast_to(sig_arrays["valid"][:, None], (S, T))
+    for key in keys:
+        q_mask = sig_arrays[f"mask:{key}"].astype(jnp.float32)  # (S, Vk)
+        t_mask = type_masks[key].astype(jnp.float32)  # (T, Vk)
+        overlap = (q_mask @ t_mask.T) > 0  # (S, T) — MXU matmul per key
+        both_has = sig_arrays[f"has:{key}"][:, None] & type_has[key][None, :]
+        both_neg = sig_arrays[f"neg:{key}"][:, None] & type_neg[key][None, :]
+        key_ok = (~both_has) | overlap | both_neg
+        ok = ok & key_ok
+    return ok
+
+
+@jax.jit
+def offering_kernel(
+    zone_ok: jnp.ndarray,  # (S, Z) bool — signature allows zone
+    ct_ok: jnp.ndarray,  # (S, C) bool — signature allows capacity type
+    avail: jnp.ndarray,  # (T, Z, C) bool
+) -> jnp.ndarray:
+    """→ (S, T) bool: some available offering satisfies the signature's
+    zone/capacity-type requirements jointly (nodeclaim.go:270
+    hasOffering)."""
+    pair_ok = zone_ok[:, :, None] & ct_ok[:, None, :]  # (S, Z, C)
+    return jnp.einsum("szc,tzc->st", pair_ok.astype(jnp.float32), avail.astype(jnp.float32)) > 0
+
+
+def zone_ct_masks(compats, enc: EncodedInstanceTypes) -> Tuple[np.ndarray, np.ndarray]:
+    """Signature-level zone / capacity-type admissibility from merged
+    requirements (missing key ⇒ all allowed)."""
+    from ..apis import labels as wk
+
+    S = len(compats)
+    zone_ok = np.ones((S, len(enc.zones)), dtype=bool)
+    ct_ok = np.ones((S, len(enc.capacity_types)), dtype=bool)
+    for s, c in enumerate(compats):
+        if not c.compatible or c.merged is None:
+            continue
+        if c.merged.has(wk.LABEL_TOPOLOGY_ZONE):
+            req = c.merged.get_req(wk.LABEL_TOPOLOGY_ZONE)
+            zone_ok[s] = [req.has(z) for z in enc.zones]
+        if c.merged.has(wk.CAPACITY_TYPE_LABEL_KEY):
+            req = c.merged.get_req(wk.CAPACITY_TYPE_LABEL_KEY)
+            ct_ok[s] = [req.has(ct) for ct in enc.capacity_types]
+    return zone_ok, ct_ok

@@ -1,0 +1,161 @@
+"""Bin-packing as a lax.scan: K-open-node first-fit-decreasing.
+
+The oracle packs each pod into the open claim with the fewest pods that
+still fits (scheduler.go:247-254), where "fits" means *some* instance
+type can hold the claim's accumulated requests. Since a claim's viable
+type set is fully determined by its accumulated usage (fits is the only
+narrowing for resource-constrained groups), per-node state collapses to
+a usage vector — checked against the Pareto frontier of viable
+allocatable vectors instead of all T types.
+
+We keep K open slots (K=16 covers FFD's effective back-fill window for
+descending pods); when none fits, the slot with the least primary-axis
+headroom is closed and a new node opens. Sequential over pods, O(K·F·R)
+per step, vectorized inside — exactly the shape lax.scan compiles well.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT_INF = np.int32(2**31 - 1)
+
+
+def pareto_frontier(allocatable: np.ndarray) -> np.ndarray:
+    """Maximal points of the viable types' allocatable vectors (F, R).
+    A usage vector fits some type iff it fits some frontier point."""
+    if len(allocatable) == 0:
+        return np.zeros((1, allocatable.shape[1] if allocatable.ndim == 2 else 0), dtype=np.int32)
+    pts = np.unique(allocatable, axis=0)
+    keep = []
+    for i, p in enumerate(pts):
+        dominated = False
+        for j, q in enumerate(pts):
+            if i != j and np.all(q >= p) and np.any(q > p):
+                dominated = True
+                break
+        if not dominated:
+            keep.append(p)
+    return np.stack(keep).astype(np.int32)
+
+
+@partial(jax.jit, static_argnames=("k_open",))
+def ffd_pack(
+    requests: jnp.ndarray,  # (P, R) int32, pre-sorted descending by primary
+    frontier: jnp.ndarray,  # (F, R) int32
+    max_pods_per_node: jnp.ndarray,  # () int32
+    k_open: int = 16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (node_ids (P,) int32 [-1 ⇒ unschedulable], node_count ())."""
+    P, R = requests.shape
+
+    # tie the carry to the inputs so its varying-axis type matches under
+    # shard_map (scan requires carry-in/carry-out type equality)
+    zero = (requests[0, 0] * 0).astype(jnp.int32)
+    init = dict(
+        usage=jnp.full((k_open, R), INT_INF, dtype=jnp.int32) + zero,
+        count=jnp.zeros(k_open, dtype=jnp.int32) + zero,
+        node_id=jnp.full(k_open, -1, dtype=jnp.int32) + zero,
+        next_id=zero,
+    )
+
+    def step(state, req):
+        usage, count, node_id = state["usage"], state["count"], state["node_id"]
+        active = node_id >= 0
+        # (K, F, R): usage ≤ frontier - req avoids int32 overflow on the
+        # INT_INF sentinel rows (frontier and req are both < 2^30)
+        remaining = frontier[None, :, :] - req[None, None, :]
+        fit = jnp.any(jnp.all(usage[:, None, :] <= remaining, axis=-1), axis=-1)
+        fit = fit & active & (count < max_pods_per_node)
+
+        # fresh-node feasibility (guards unschedulable pods)
+        fresh_fits = jnp.any(jnp.all(req[None, :] <= frontier, axis=-1))
+
+        # fewest pods first, ties to oldest claim (scheduler.go:247);
+        # float order avoids int32 overflow for large per-node counts
+        order = jnp.where(
+            fit, count.astype(jnp.float32) + node_id.astype(jnp.float32) * 1e-7, jnp.inf
+        )
+        k_star = jnp.argmin(order)
+        any_fit = fit[k_star]
+
+        # eviction target: least primary-resource headroom (future pods are
+        # no larger on the primary axis, so this slot is least useful)
+        frontier_max = jnp.max(frontier, axis=0)
+        headroom = jnp.where(active, frontier_max[0] - usage[:, 0], INT_INF)
+        k_evict = jnp.argmin(headroom)
+        k_new = jnp.where(jnp.all(active), k_evict, jnp.argmax(~active))
+
+        k_sel = jnp.where(any_fit, k_star, k_new)
+        open_new = (~any_fit) & fresh_fits
+
+        new_usage_row = jnp.where(any_fit, usage[k_sel] + req, req)
+        new_count_row = jnp.where(any_fit, count[k_sel] + 1, 1)
+        new_id_row = jnp.where(any_fit, node_id[k_sel], state["next_id"])
+
+        do_update = any_fit | open_new
+        usage = jnp.where(
+            do_update, usage.at[k_sel].set(new_usage_row), usage
+        )
+        count = jnp.where(do_update, count.at[k_sel].set(new_count_row), count)
+        node_id = jnp.where(do_update, node_id.at[k_sel].set(new_id_row), node_id)
+        next_id = state["next_id"] + jnp.where(open_new, 1, 0).astype(jnp.int32)
+
+        assigned = jnp.where(do_update, new_id_row, -1)
+        return (
+            dict(usage=usage, count=count, node_id=node_id, next_id=next_id),
+            assigned,
+        )
+
+    final, node_ids = jax.lax.scan(step, init, requests)
+    return node_ids, final["next_id"]
+
+
+def assign_cheapest_types(
+    node_usage: np.ndarray,  # (N, R) int32 summed requests per node
+    allocatable: np.ndarray,  # (T, R) int32 (viable types only)
+    prices: np.ndarray,  # (T,) f64
+) -> np.ndarray:
+    """Per node, the cheapest viable type that holds its load — the launch
+    decision the fake provider makes (fake/cloudprovider.go:105-110).
+    → (N,) int32 index into the viable-type axis, -1 if none fits."""
+    fits = np.all(node_usage[:, None, :] <= allocatable[None, :, :], axis=-1)  # (N, T)
+    priced = np.where(fits, prices[None, :], np.inf)
+    best = np.argmin(priced, axis=1).astype(np.int32)
+    best[~fits.any(axis=1)] = -1
+    return best
+
+
+def pad_for_pack(requests: np.ndarray, frontier: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pad pod and frontier counts to power-of-two buckets so jit compiles
+    are reused across groups. Padding pods get requests larger than any
+    frontier point → they emit node_id=-1 without touching scan state;
+    padding frontier rows are all-zero → never fit (requests include
+    pods ≥ 1)."""
+    P, R = requests.shape
+    P_pad = max(128, 1 << (P - 1).bit_length())
+    F_pad = 1 << (len(frontier) - 1).bit_length() if len(frontier) > 1 else 1
+    fmax = frontier.max(axis=0)
+    if P_pad > P:
+        sentinel = np.broadcast_to(fmax + 1, (P_pad - P, R)).astype(np.int32)
+        requests = np.concatenate([requests, sentinel])
+    if F_pad > len(frontier):
+        frontier = np.concatenate(
+            [frontier, np.zeros((F_pad - len(frontier), R), dtype=np.int32)]
+        )
+    return requests, frontier, P
+
+
+def node_usage_from_assignment(
+    requests: np.ndarray, node_ids: np.ndarray, node_count: int
+) -> np.ndarray:
+    """Segment-sum pod requests by assigned node."""
+    usage = np.zeros((node_count, requests.shape[1]), dtype=np.int64)
+    valid = node_ids >= 0
+    np.add.at(usage, node_ids[valid], requests[valid])
+    return usage.astype(np.int32)

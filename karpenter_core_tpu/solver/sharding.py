@@ -1,0 +1,78 @@
+"""Multi-chip sharding for the solver (SURVEY §5 distributed mapping).
+
+Scaling axes, in jax.sharding terms:
+- **groups** (data-parallel-like): signature groups / zone sub-batches
+  pack independently — shard the group axis over the mesh, each device
+  scans its groups, ICI collectives reduce fleet totals.
+- **types** (tensor-parallel-like): the S×T compat kernel shards the
+  type axis; each device computes a T-shard of the masks, results
+  all-gather (XLA inserts the collective from shardings).
+
+Fleet-level repack for consolidation reuses the same mesh with a psum
+over candidate-subset scores.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .pack import ffd_pack
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def sharded_batch_pack(
+    mesh: Mesh,
+    requests: jnp.ndarray,  # (G, Pmax, R) int32 — padded groups
+    frontiers: jnp.ndarray,  # (G, F, R) int32
+    max_per_node: jnp.ndarray,  # (G,) int32
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pack G groups across the mesh; returns (node_ids (G, Pmax),
+    node_counts (G,), fleet_total ()). The fleet total is a real ICI
+    collective (psum over the groups axis)."""
+
+    def per_device(reqs, fronts, caps):
+        node_ids, counts = jax.vmap(
+            lambda r, f, c: ffd_pack(r, f, c)
+        )(reqs, fronts, caps)
+        local_total = jnp.sum(counts)
+        fleet_total = jax.lax.psum(local_total, axis_name="groups")
+        return node_ids, counts, fleet_total
+
+    shard = partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("groups"), P("groups"), P("groups")),
+        out_specs=(P("groups"), P("groups"), P()),
+    )
+    return jax.jit(shard(per_device))(requests, frontiers, max_per_node)
+
+
+def sharded_compat(
+    mesh: Mesh,
+    sig_masks: jnp.ndarray,  # (S, W) f32 — flattened key masks
+    type_masks: jnp.ndarray,  # (T, W) f32
+) -> jnp.ndarray:
+    """Type-axis-sharded overlap matmul: each device holds a T-shard,
+    XLA all-gathers the (S, T) result from the output sharding."""
+    axis = mesh.axis_names[0]
+    jitted = jax.jit(
+        lambda q, m: q @ m.T,
+        in_shardings=(
+            NamedSharding(mesh, P()),  # signatures replicated
+            NamedSharding(mesh, P(axis)),  # types sharded
+        ),
+        out_shardings=NamedSharding(mesh, P(None, axis)),
+    )
+    return jitted(sig_masks, type_masks)

@@ -1,0 +1,457 @@
+"""TPUScheduler: the batched scheduling pipeline with CPU-oracle
+fallback.
+
+Pipeline per solve:
+  host: signature-group pods → per-(signature, pool) set algebra
+  TPU:  compat kernel (S×T masks) + offering kernel + fits
+  host: zone-spread splitting (balanced assignment = min-skew)
+  TPU:  ffd_pack scan per (group, zone)
+  host: cheapest-type/offering per packed node → NodePlans
+
+Relational pods (pod affinity / non-self anti-affinity) and batches with
+existing capacity route to the greedy oracle
+(``karpenter_core_tpu.scheduler``) — same split SURVEY §7 prescribes.
+The oracle also serves as the parity reference: ``SolverResult``
+exposes node count and total price for comparison.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..apis import labels as wk
+from ..apis.nodepool import NodePool, order_by_weight
+from ..cloudprovider.types import CloudProvider, InstanceType
+from ..kube.objects import Pod
+from ..scheduling import Taints, resources
+from ..scheduling.requirements import node_selector_requirements
+from .encode import (
+    EncodedInstanceTypes,
+    PoolEncoding,
+    SignatureGroup,
+    build_resource_axis,
+    encode_instance_types,
+    encode_signature_for_pool,
+    finalize_signature_masks,
+    group_pods,
+    quantize_requests,
+)
+from .kernels import build_compat_inputs, compat_kernel, offering_kernel, zone_ct_masks
+from .pack import (
+    assign_cheapest_types,
+    ffd_pack,
+    node_usage_from_assignment,
+    pad_for_pack,
+    pareto_frontier,
+)
+from .vocab import Vocab
+
+
+@dataclass
+class NodePlan:
+    """One node the solver decided to create."""
+
+    nodepool_name: str
+    instance_type: InstanceType
+    zone: str
+    capacity_type: str
+    price: float
+    pod_indices: List[int]  # into the solve batch
+
+
+@dataclass
+class SolverResult:
+    node_plans: List[NodePlan] = field(default_factory=list)
+    pod_errors: Dict[str, str] = field(default_factory=dict)  # pod uid → error
+    oracle_results: Optional[object] = None  # scheduler.Results for fallback pods
+
+    @property
+    def node_count(self) -> int:
+        n = len(self.node_plans)
+        if self.oracle_results is not None:
+            n += len(self.oracle_results.new_node_claims)
+        return n
+
+    @property
+    def total_price(self) -> float:
+        return sum(p.price for p in self.node_plans)
+
+    @property
+    def pods_scheduled(self) -> int:
+        n = sum(len(p.pod_indices) for p in self.node_plans)
+        if self.oracle_results is not None:
+            n += sum(len(c.pods) for c in self.oracle_results.new_node_claims)
+            n += sum(len(e.pods) for e in self.oracle_results.existing_nodes)
+        return n
+
+
+class TPUScheduler:
+    def __init__(
+        self,
+        nodepools: List[NodePool],
+        cloud_provider: CloudProvider,
+        kube_client=None,
+        cluster=None,
+    ):
+        self.nodepools = order_by_weight(
+            [np_ for np_ in nodepools if np_.metadata.deletion_timestamp is None]
+        )
+        self.cloud_provider = cloud_provider
+        self.kube_client = kube_client
+        self.cluster = cluster
+
+    # ------------------------------------------------------------------
+
+    def solve(
+        self,
+        pods: List[Pod],
+        state_nodes=None,
+        daemonset_pods: Optional[List[Pod]] = None,
+    ) -> SolverResult:
+        result = SolverResult()
+        groups = group_pods(pods)
+        relational = [g for g in groups if g.has_relational]
+        tensor_groups = [g for g in groups if not g.has_relational]
+        # pods *selected by* a relational pod's affinity terms must schedule
+        # in the same (oracle) world, or affinity can't anchor to them
+        selectors = []
+        for g in relational:
+            a = g.exemplar.spec.affinity
+            for terms in (
+                (a.pod_affinity.required if a.pod_affinity else []),
+                ([w.pod_affinity_term for w in a.pod_affinity.preferred] if a.pod_affinity else []),
+                (a.pod_anti_affinity.required if a.pod_anti_affinity else []),
+                ([w.pod_affinity_term for w in a.pod_anti_affinity.preferred] if a.pod_anti_affinity else []),
+            ):
+                for t in terms:
+                    if t.label_selector is not None:
+                        selectors.append(t.label_selector)
+        pulled = [
+            g
+            for g in tensor_groups
+            if any(sel.matches(g.exemplar.metadata.labels) for sel in selectors)
+        ]
+        tensor_groups = [g for g in tensor_groups if g not in pulled]
+        oracle_pods: List[Pod] = [
+            pods[i] for g in relational + pulled for i in g.pod_indices
+        ]
+        # existing capacity is packed by the oracle path for now
+        if state_nodes:
+            oracle_pods = list(pods)
+            tensor_groups = []
+
+        if tensor_groups:
+            self._solve_tensor(pods, tensor_groups, daemonset_pods or [], result)
+        if oracle_pods:
+            self._solve_oracle(oracle_pods, state_nodes, daemonset_pods, result)
+        return result
+
+    # ------------------------------------------------------------------
+
+    def _solve_oracle(self, pods, state_nodes, daemonset_pods, result: SolverResult) -> None:
+        from ..scheduler.builder import build_scheduler
+
+        scheduler = build_scheduler(
+            self.kube_client,
+            self.cluster,
+            self.nodepools,
+            self.cloud_provider,
+            pods,
+            state_nodes=state_nodes,
+            daemonset_pods=daemonset_pods,
+        )
+        res = scheduler.solve(pods)
+        result.oracle_results = res
+        for uid, err in res.pod_errors.items():
+            result.pod_errors[uid] = err
+
+    # ------------------------------------------------------------------
+
+    def _solve_tensor(
+        self,
+        pods: List[Pod],
+        groups: List[SignatureGroup],
+        daemonset_pods: List[Pod],
+        result: SolverResult,
+    ) -> None:
+        # --- encode catalog per pool -----------------------------------
+        pools: List[PoolEncoding] = []
+        pool_catalogs: List[List[InstanceType]] = []
+        for np_ in self.nodepools:
+            try:
+                its = self.cloud_provider.get_instance_types(np_)
+            except Exception:
+                continue
+            if not its:
+                continue
+            template_reqs = node_selector_requirements(np_.spec.template.requirements)
+            from ..scheduling.requirements import label_requirements
+
+            template_reqs.add(
+                *label_requirements(
+                    {**np_.spec.template.metadata.labels, wk.NODEPOOL_LABEL_KEY: np_.name}
+                ).values_list()
+            )
+            pools.append(
+                PoolEncoding(np_, template_reqs, Taints(np_.spec.template.taints))
+            )
+            pool_catalogs.append(its)
+        if not pools:
+            for g in groups:
+                for i in g.pod_indices:
+                    result.pod_errors[pods[i].uid] = "no nodepool found"
+            return
+
+        all_requests = [resources.requests_for_pods(p) for p in pods]
+        axis = build_resource_axis(all_requests, [it for cat in pool_catalogs for it in cat])
+        requests_matrix = np.stack([quantize_requests(r, axis) for r in all_requests])
+
+        # daemonset overhead per pool, added to every planned node's load
+        from ..scheduling.requirements import pod_requirements as _pod_reqs
+
+        daemon_requests = {}
+        for pool in pools:
+            daemons = [
+                p
+                for p in daemonset_pods
+                if pool.taints.tolerates(p) is None
+                and pool.template_requirements.compatible(
+                    _pod_reqs(p), frozenset(wk.WELL_KNOWN_LABELS)
+                )
+                is None
+            ]
+            daemon_requests[pool.nodepool.name] = quantize_requests(
+                resources.requests_for_pods(*daemons) if daemons else {}, axis
+            )
+
+        # --- per-pool encoding + compat kernels -------------------------
+        # pass 1: intern every value (catalog + merged signature reqs) so
+        # mask widths are final; pass 2: build the actual mask tensors
+        vocab = Vocab()
+        for catalog in pool_catalogs:
+            for it in catalog:
+                for req in it.requirements.values():
+                    vocab.observe_requirement(req)
+        sig_compats: List[List] = [
+            [encode_signature_for_pool(g, pool, vocab) for g in groups] for pool in pools
+        ]
+        encoded: List[EncodedInstanceTypes] = [
+            encode_instance_types(catalog, axis, vocab) for catalog in pool_catalogs
+        ]
+        for compats in sig_compats:
+            finalize_signature_masks(compats, vocab)
+
+        allowed_per_pool = []
+        for enc, compats in zip(encoded, sig_compats):
+            sig_arrays = build_compat_inputs(compats, enc, vocab)
+            keys = tuple(sorted(enc.key_masks.keys()))
+            compat = np.asarray(
+                compat_kernel(
+                    {k: np.asarray(v) for k, v in sig_arrays.items()},
+                    enc.key_masks,
+                    enc.key_has,
+                    enc.key_neg,
+                    keys,
+                )
+            )
+            zone_ok, ct_ok = zone_ct_masks(compats, enc)
+            offering = np.asarray(offering_kernel(zone_ok, ct_ok, enc.offering_avail))
+            allowed_per_pool.append((compat & offering, zone_ok, ct_ok))
+
+        # --- pack group by group ---------------------------------------
+        for gi, group in enumerate(groups):
+            self._pack_group(
+                gi,
+                group,
+                pods,
+                requests_matrix,
+                axis,
+                pools,
+                encoded,
+                sig_compats,
+                allowed_per_pool,
+                daemon_requests,
+                result,
+            )
+
+    # ------------------------------------------------------------------
+
+    def _pack_group(
+        self,
+        gi: int,
+        group: SignatureGroup,
+        pods: List[Pod],
+        requests_matrix: np.ndarray,
+        axis,
+        pools: List[PoolEncoding],
+        encoded: List[EncodedInstanceTypes],
+        sig_compats,
+        allowed_per_pool,
+        daemon_requests,
+        result: SolverResult,
+    ) -> None:
+        # first pool (weight order) whose template accepts the signature and
+        # offers at least one viable type (scheduler.go:256-283)
+        chosen = None
+        for pi, pool in enumerate(pools):
+            compat_row = allowed_per_pool[pi][0][gi]
+            if sig_compats[pi][gi].compatible and compat_row.any():
+                chosen = pi
+                break
+        if chosen is None:
+            err = "; ".join(
+                f'incompatible with nodepool "{p.nodepool.name}", {sig_compats[pi][gi].error or "no viable instance type"}'
+                for pi, p in enumerate(pools)
+            )
+            for i in group.pod_indices:
+                result.pod_errors[pods[i].uid] = err
+            return
+
+        pool = pools[chosen]
+        enc = encoded[chosen]
+        viable = allowed_per_pool[chosen][0][gi]  # (T,) bool
+        zone_ok = allowed_per_pool[chosen][1][gi]  # (Z,)
+        ct_ok = allowed_per_pool[chosen][2][gi]  # (C,)
+        daemon = daemon_requests[pool.nodepool.name]
+
+        idx = np.array(group.pod_indices, dtype=np.int64)
+        reqs = requests_matrix[idx]
+        # descending by primary resource then memory (queue.go:76 ordering)
+        order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+        idx, reqs = idx[order], reqs[order]
+
+        # per-pod max-pods-per-node from hostname spread / self anti-affinity
+        max_per_node = np.int32(2**31 - 1)
+        hs = group.hostname_spread()
+        if hs is not None:
+            max_per_node = np.int32(hs.max_skew)
+        if group.hostname_isolated:
+            max_per_node = np.int32(1)
+
+        zone_spread = group.zone_spread()
+        if zone_spread is not None:
+            # zone sub-batches, balanced round-robin = min-skew assignment
+            zones = [z for zi, z in enumerate(enc.zones) if zone_ok[zi]]
+            zone_types = {
+                z: viable & enc.offering_avail[:, enc.zones.index(z), :][:, ct_ok].any(axis=1)
+                for z in zones
+            }
+            zones = [z for z in zones if zone_types[z].any()]
+            if not zones:
+                for i in group.pod_indices:
+                    result.pod_errors[pods[i].uid] = "no zone with viable offering for topology spread"
+                return
+            buckets = {z: [] for z in zones}
+            for j, i in enumerate(idx):
+                buckets[zones[j % len(zones)]].append(j)
+            for z in zones:
+                if buckets[z]:
+                    sel = np.array(buckets[z])
+                    self._pack_into_nodes(
+                        idx[sel], reqs[sel], enc, zone_types[z], zone_ok, ct_ok, daemon,
+                        max_per_node, pool, pods, result, zone=z,
+                    )
+        else:
+            self._pack_into_nodes(
+                idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node, pool, pods, result
+            )
+
+    # ------------------------------------------------------------------
+
+    def _pack_into_nodes(
+        self,
+        idx: np.ndarray,
+        reqs: np.ndarray,
+        enc: EncodedInstanceTypes,
+        viable: np.ndarray,
+        zone_ok: np.ndarray,
+        ct_ok: np.ndarray,
+        daemon: np.ndarray,
+        max_per_node,
+        pool: PoolEncoding,
+        pods: List[Pod],
+        result: SolverResult,
+        zone: Optional[str] = None,
+    ) -> None:
+        viable_idx = np.flatnonzero(viable)
+        if len(viable_idx) == 0:
+            for i in idx:
+                result.pod_errors[pods[i].uid] = "no viable instance type"
+            return
+        alloc = enc.allocatable[viable_idx] - daemon[None, :]  # daemon overhead off the top
+        alloc = np.maximum(alloc, 0)
+        frontier = pareto_frontier(alloc)
+
+        padded_reqs, padded_frontier, true_p = pad_for_pack(reqs, frontier)
+        node_ids, node_count = ffd_pack(padded_reqs, padded_frontier, np.int32(max_per_node))
+        node_ids = np.asarray(node_ids)[:true_p]
+        node_count = int(node_count)
+
+        unsched = node_ids < 0
+        for i in idx[unsched]:
+            result.pod_errors[pods[i].uid] = (
+                "no instance type satisfied resources and requirements (tensor path)"
+            )
+        if node_count == 0:
+            return
+        usage = node_usage_from_assignment(reqs, node_ids, node_count)
+
+        # price per viable type: cheapest offering allowed by the
+        # signature's zone/capacity-type requirements (zone-pinned if set)
+        if zone is not None:
+            zi = enc.zones.index(zone)
+            zprices = enc.offering_price[viable_idx, zi, :][:, ct_ok]
+            prices = np.where(np.isfinite(zprices), zprices, np.inf).min(axis=1)
+        else:
+            op = enc.offering_price[viable_idx][:, zone_ok, :][:, :, ct_ok].reshape(
+                len(viable_idx), -1
+            )
+            prices = np.where(np.isfinite(op), op, np.inf).min(axis=1) if op.size else np.full(
+                len(viable_idx), np.inf
+            )
+
+        chosen_types = assign_cheapest_types(usage, alloc, prices)
+        for n in range(node_count):
+            ti = chosen_types[n]
+            members = [int(i) for i in idx[node_ids == n]]
+            if ti < 0:
+                for i in members:
+                    result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
+                continue
+            it = enc.instance_types[int(viable_idx[ti])]
+            # concrete offering: cheapest allowed for that type (zone-pinned)
+            offering_zone, offering_ct, offering_price = self._cheapest_offering(
+                enc, int(viable_idx[ti]), zone_ok, ct_ok, zone
+            )
+            result.node_plans.append(
+                NodePlan(
+                    nodepool_name=pool.nodepool.name,
+                    instance_type=it,
+                    zone=offering_zone,
+                    capacity_type=offering_ct,
+                    price=offering_price,
+                    pod_indices=members,
+                )
+            )
+
+    @staticmethod
+    def _cheapest_offering(
+        enc: EncodedInstanceTypes,
+        t: int,
+        zone_ok: np.ndarray,
+        ct_ok: np.ndarray,
+        zone: Optional[str],
+    ) -> Tuple[str, str, float]:
+        prices = enc.offering_price[t]  # (Z, C)
+        mask = np.isfinite(prices) & ct_ok[None, :] & zone_ok[:, None]
+        if zone is not None:
+            zmask = np.zeros(len(enc.zones), dtype=bool)
+            zmask[enc.zones.index(zone)] = True
+            mask = mask & zmask[:, None]
+        masked = np.where(mask, prices, np.inf)
+        zi, ci = np.unravel_index(np.argmin(masked), masked.shape)
+        return enc.zones[zi], enc.capacity_types[ci], float(masked[zi, ci])

@@ -1,0 +1,87 @@
+"""Per-key value vocabularies for requirement mask encoding.
+
+Each label key gets an interned value list plus one reserved OTHER slot
+standing in for every value not observed in the batch. Complement sets
+(NotIn/Exists) mark OTHER=1; concrete sets (In/DoesNotExist) mark
+OTHER=0. Set-intersection nonemptiness is then exactly mask overlap —
+the contract the compat kernel relies on.
+
+Gt/Lt bounds are resolved against the observed vocab host-side (values
+are filtered by the bound); OTHER stays 1 for bounded complements since
+unseen integers satisfying the bound always exist.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+import numpy as np
+
+from ..scheduling.requirement import Requirement
+
+
+class KeyVocab:
+    __slots__ = ("key", "values", "index")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.values: List[str] = []
+        self.index: Dict[str, int] = {}
+
+    def intern(self, value: str) -> int:
+        idx = self.index.get(value)
+        if idx is None:
+            idx = len(self.values)
+            self.values.append(value)
+            self.index[value] = idx
+        return idx
+
+    @property
+    def size(self) -> int:
+        """Mask width: observed values + OTHER."""
+        return len(self.values) + 1
+
+    @property
+    def other_slot(self) -> int:
+        return len(self.values)
+
+
+class Vocab:
+    """All key vocabularies for one solve batch."""
+
+    def __init__(self) -> None:
+        self.keys: Dict[str, KeyVocab] = {}
+        self.key_order: List[str] = []
+
+    def key_vocab(self, key: str) -> KeyVocab:
+        kv = self.keys.get(key)
+        if kv is None:
+            kv = KeyVocab(key)
+            self.keys[key] = kv
+            self.key_order.append(key)
+        return kv
+
+    def observe_requirement(self, req: Requirement) -> None:
+        kv = self.key_vocab(req.key)
+        for v in req.values:
+            kv.intern(v)
+
+    def observe_label(self, key: str, value: str) -> None:
+        self.key_vocab(key).intern(value)
+
+    def encode_mask(self, req: Requirement, width: int) -> np.ndarray:
+        """Requirement → bool mask of `width` (≥ vocab size) slots."""
+        kv = self.keys[req.key]
+        mask = np.zeros(width, dtype=bool)
+        if req.complement:
+            # NotIn/Exists (incl. Gt/Lt bounds): everything allowed except
+            # excluded values, filtered by bounds; OTHER allowed
+            for i, v in enumerate(kv.values):
+                mask[i] = req.has(v)
+            mask[kv.other_slot] = True
+        else:
+            # In/DoesNotExist: only listed values within bounds
+            for v in req.values:
+                if req.has(v):
+                    mask[kv.index[v]] = True
+        return mask

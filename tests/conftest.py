@@ -1,9 +1,21 @@
 """Test config: force JAX onto a virtual 8-device CPU platform so sharding
-tests exercise real Mesh/pjit paths without TPU hardware."""
+tests exercise real Mesh/pjit paths without TPU hardware.
+
+The image's axon sitecustomize registers the TPU backend at interpreter
+startup and pins jax_platforms; we override the config before any test
+touches JAX.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

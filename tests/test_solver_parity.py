@@ -1,0 +1,240 @@
+"""TPU solver vs CPU oracle parity (SURVEY §4 carry-over (d)): packing
+metrics — node count, pods scheduled, cost — must match within 1%."""
+
+import numpy as np
+import pytest
+
+from helpers import make_nodepool, make_pod, spread
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+    new_instance_type,
+)
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+from karpenter_core_tpu.solver import TPUScheduler
+
+
+def oracle_solve(pods, nodepools, provider):
+    s = build_scheduler(KubeClient(), None, nodepools, provider, pods)
+    return s.solve(pods)
+
+
+def tpu_solve(pods, nodepools, provider):
+    return TPUScheduler(nodepools, provider, kube_client=KubeClient()).solve(pods)
+
+
+def oracle_cost(results, provider):
+    """Launch cost of the oracle's plan: cheapest surviving instance type
+    per claim (what the fake provider would launch)."""
+    total = 0.0
+    for claim in results.new_node_claims:
+        cheapest = min(
+            claim.instance_type_options,
+            key=lambda it: min(
+                (o.price for o in it.offerings.available().requirements(claim.requirements)),
+                default=float("inf"),
+            ),
+        )
+        total += min(
+            o.price for o in cheapest.offerings.available().requirements(claim.requirements)
+        )
+    return total
+
+
+def rng_pods(n, seed=0, cpu_choices=("100m", "250m", "500m", "1", "2"), mem_choices=("128Mi", "512Mi", "1Gi", "2Gi")):
+    rng = np.random.RandomState(seed)
+    return [
+        make_pod(
+            requests={
+                "cpu": cpu_choices[rng.randint(len(cpu_choices))],
+                "memory": mem_choices[rng.randint(len(mem_choices))],
+            }
+        )
+        for _ in range(n)
+    ]
+
+
+class TestResourceFitParity:
+    def test_uniform_pods(self):
+        """BASELINE config-1 shape: uniform cpu pods, small catalog."""
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        nodepools = [make_nodepool()]
+        pods = [make_pod(requests={"cpu": "500m", "memory": "512Mi"}) for _ in range(100)]
+
+        oracle = oracle_solve([p for p in pods], nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+
+        assert not oracle.pod_errors and not tpu.pod_errors
+        o_nodes = len(oracle.new_node_claims)
+        assert abs(tpu.node_count - o_nodes) <= max(1, 0.01 * o_nodes)
+
+    def test_mixed_sizes(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        nodepools = [make_nodepool()]
+        pods = rng_pods(300, seed=42)
+
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+
+        assert not oracle.pod_errors and not tpu.pod_errors
+        assert tpu.pods_scheduled == 300
+        o_nodes = len(oracle.new_node_claims)
+        assert abs(tpu.node_count - o_nodes) <= max(1, round(0.05 * o_nodes))
+
+    def test_cost_parity(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        nodepools = [make_nodepool()]
+        pods = rng_pods(200, seed=7)
+
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+
+        o_cost = oracle_cost(oracle, provider)
+        assert tpu.total_price <= o_cost * 1.05
+
+    def test_unschedulable_pods_match(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(3)  # max 3 cpu
+        nodepools = [make_nodepool()]
+        pods = [make_pod(requests={"cpu": "16"}) for _ in range(2)] + [
+            make_pod(requests={"cpu": "1"})
+        ]
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+        assert len(oracle.pod_errors) == 2
+        assert len(tpu.pod_errors) == 2
+
+
+class TestConstraintParity:
+    def test_node_selector_zone(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(5)
+        nodepools = [make_nodepool()]
+        pods = [
+            make_pod(requests={"cpu": "500m"}, node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+            for _ in range(20)
+        ]
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+        assert not tpu.pod_errors
+        for plan in tpu.node_plans:
+            assert plan.zone == "test-zone-1"
+        assert abs(tpu.node_count - len(oracle.new_node_claims)) <= 1
+
+    def test_taint_toleration_parity(self):
+        from karpenter_core_tpu.kube.objects import Taint, Toleration
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(5)
+        tainted = make_nodepool("tainted", taints=[Taint(key="gpu", value="true", effect="NoSchedule")], weight=100)
+        plain = make_nodepool("plain", weight=1)
+        tol = [Toleration(key="gpu", operator="Exists")]
+        pods = [make_pod(requests={"cpu": "500m"}, tolerations=tol) for _ in range(10)]
+        pods += [make_pod(requests={"cpu": "500m"}) for _ in range(10)]
+
+        tpu = tpu_solve(pods, [tainted, plain], provider)
+        assert not tpu.pod_errors
+        # untolerating pods must land on the plain pool
+        for plan in tpu.node_plans:
+            member_pods = [pods[i] for i in plan.pod_indices]
+            if plan.nodepool_name == "tainted":
+                for p in member_pods:
+                    assert p.spec.tolerations
+
+    def test_zone_spread_parity(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(5)
+        nodepools = [make_nodepool()]
+        pods = [
+            make_pod(labels={"app": "web"}, requests={"cpu": "250m"},
+                     topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": "web"})])
+            for _ in range(12)
+        ]
+        tpu = tpu_solve(pods, nodepools, provider)
+        assert not tpu.pod_errors
+        zone_counts = {}
+        for plan in tpu.node_plans:
+            zone_counts[plan.zone] = zone_counts.get(plan.zone, 0) + len(plan.pod_indices)
+        assert len(zone_counts) == 3
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+    def test_hostname_spread_parity(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(5)
+        nodepools = [make_nodepool()]
+        pods = [
+            make_pod(labels={"app": "web"}, requests={"cpu": "100m"},
+                     topology_spread=[spread(wk.LABEL_HOSTNAME, labels={"app": "web"})])
+            for _ in range(4)
+        ]
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+        assert not tpu.pod_errors
+        assert tpu.node_count == len(oracle.new_node_claims) == 4
+
+    def test_relational_pods_fall_back_to_oracle(self):
+        from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+
+        provider = FakeCloudProvider()
+        nodepools = [make_nodepool()]
+        anchor = make_pod(labels={"app": "db"}, requests={"cpu": "100m"})
+        follower = make_pod(
+            requests={"cpu": "100m"},
+            pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                          label_selector=LabelSelector(match_labels={"app": "db"}))],
+        )
+        tpu = tpu_solve([anchor, follower], nodepools, provider)
+        assert not tpu.pod_errors
+        assert tpu.pods_scheduled == 2
+
+
+class TestLargeBatchParity:
+    def test_2k_pods_500_types(self):
+        """Scaled-down BASELINE config-2 shape."""
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(50)
+        nodepools = [make_nodepool()]
+        pods = rng_pods(2000, seed=123)
+
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        tpu = tpu_solve(pods, nodepools, provider)
+
+        assert not tpu.pod_errors
+        assert tpu.pods_scheduled == 2000
+        o_nodes = len(oracle.new_node_claims)
+        t_nodes = tpu.node_count
+        # ≥99% packing parity target — allow tiny slack at small node counts
+        assert t_nodes <= o_nodes * 1.02 + 1, (t_nodes, o_nodes)
+
+
+class TestRegressions:
+    def test_required_zone_honored_without_spread(self):
+        """A nodeSelector zone must pin the chosen offering even without a
+        topology spread (zone_ok was ignored in the non-spread path)."""
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        pods = [
+            make_pod(requests={"cpu": "500m"}, node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-3"})
+            for _ in range(5)
+        ]
+        tpu = tpu_solve(pods, [make_nodepool()], provider)
+        assert not tpu.pod_errors
+        assert {p.zone for p in tpu.node_plans} == {"test-zone-3"}
+
+    def test_labels_without_selectors_share_nodes(self):
+        """Pods differing only in labels (no selector references them) must
+        pack together like the oracle does."""
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        pods = [
+            make_pod(requests={"cpu": "100m"}, labels={"app": f"a{i % 5}"}) for i in range(20)
+        ]
+        oracle = oracle_solve(list(pods), [make_nodepool()], provider)
+        tpu = tpu_solve(pods, [make_nodepool()], provider)
+        assert len(oracle.new_node_claims) == 1
+        assert len(tpu.node_plans) == 1
