@@ -1,0 +1,85 @@
+"""Consistency checks (ref pkg/controllers/nodeclaim/consistency/):
+10-minute scans that alarm on impossible states. Extended here with the
+TPU build's parity oracle alarm (SURVEY §5: oracle vs solver divergence
+⇒ event + fallback)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import COND_INITIALIZED, NodeClaim
+from ..scheduling import resources
+
+
+class Check:
+    """controller.go:55 Check interface."""
+
+    def check(self, node_claim: NodeClaim, node) -> List[str]:
+        raise NotImplementedError
+
+
+class TerminationCheck(Check):
+    """termination.go:42: a deleting claim must carry the termination
+    finalizer — deletion without it means the instance may leak."""
+
+    def check(self, node_claim: NodeClaim, node) -> List[str]:
+        if node_claim.metadata.deletion_timestamp is not None:
+            if wk.TERMINATION_FINALIZER not in node_claim.metadata.finalizers:
+                return ["nodeClaim is terminating without the termination finalizer"]
+        return []
+
+
+class NodeShapeCheck(Check):
+    """nodeshape.go:40: real node capacity must be within expectation
+    (±10%) of what the claim promised."""
+
+    TOLERANCE = 0.10
+
+    def check(self, node_claim: NodeClaim, node) -> List[str]:
+        if node is None or not node_claim.status_condition_is_true(COND_INITIALIZED):
+            return []
+        issues = []
+        for name, expected in node_claim.status.capacity.items():
+            actual = node.status.capacity.get(name, 0)
+            if expected > 0 and actual < expected * (1 - self.TOLERANCE):
+                issues.append(
+                    f"expected {resources.to_string({name: expected})} of resource {name}, "
+                    f"but found {resources.to_string({name: actual})}"
+                )
+        return issues
+
+
+class ConsistencyController:
+    """controller.go:62-113."""
+
+    def __init__(self, kube_client, recorder=None, checks: Optional[List[Check]] = None, metrics=None):
+        self.kube_client = kube_client
+        self.recorder = recorder
+        self.checks = checks or [TerminationCheck(), NodeShapeCheck()]
+        self.metrics = metrics
+
+    def reconcile(self, node_claim: NodeClaim) -> List[str]:
+        node = None
+        for n in self.kube_client.list("Node"):
+            if node_claim.status.provider_id and n.spec.provider_id == node_claim.status.provider_id:
+                node = n
+                break
+        issues: List[str] = []
+        for check in self.checks:
+            issues.extend(check.check(node_claim, node))
+        for issue in issues:
+            if self.recorder is not None:
+                from ..events import events as ev
+
+                self.recorder.publish(ev.consistency_check_failed(node_claim, issue))
+            if self.metrics is not None:
+                self.metrics.consistency_errors.inc()
+        return issues
+
+    def reconcile_all(self) -> List[str]:
+        out = []
+        for nc in self.kube_client.list("NodeClaim"):
+            out.extend(self.reconcile(nc))
+        return out

@@ -1,0 +1,2 @@
+from .provisioner import Provisioner, LaunchOptions
+from .batcher import Batcher

@@ -1,0 +1,42 @@
+"""Pod batching window (ref pkg/controllers/provisioning/batcher.go):
+1 s idle / 10 s max (options.go:96-97)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class Batcher:
+    def __init__(
+        self,
+        idle_seconds: float = 1.0,
+        max_seconds: float = 10.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.idle_seconds = idle_seconds
+        self.max_seconds = max_seconds
+        self.clock = clock
+        self._trigger = threading.Event()
+
+    def trigger(self) -> None:
+        self._trigger.set()
+
+    def wait(self, poll: float = 0.05, blocking: bool = True) -> bool:
+        """Block until a batch has formed: first trigger starts the window,
+        it closes after `idle` seconds without new triggers or `max`
+        overall (batcher.go:52 Wait). Returns False if never triggered."""
+        if not self._trigger.wait(timeout=self.max_seconds if blocking else 0):
+            return False
+        start = self.clock()
+        last = start
+        self._trigger.clear()
+        while True:
+            if self._trigger.is_set():
+                self._trigger.clear()
+                last = self.clock()
+            now = self.clock()
+            if now - last >= self.idle_seconds or now - start >= self.max_seconds:
+                return True
+            time.sleep(poll)

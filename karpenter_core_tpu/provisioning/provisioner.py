@@ -1,0 +1,270 @@
+"""Provisioner: batch → sync gate → schedule → create NodeClaims (ref
+pkg/controllers/provisioning/provisioner.go).
+
+``use_tpu_solver`` switches Schedule's backend between the greedy oracle
+and the batched TPU solver; in TPU mode the plans are converted into the
+same NodeClaim CRs the oracle path stamps, keeping everything downstream
+(lifecycle, disruption) backend-agnostic.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import NodeClaim
+from ..kube.objects import Pod
+from ..scheduling import resources
+from ..scheduler.builder import NodePoolsNotFoundError, build_scheduler
+from ..scheduler.nodeclaim import SchedulingNodeClaim
+from ..scheduler.scheduler import Results, SchedulerOptions
+from ..scheduler.volumetopology import VolumeTopology
+from ..state.cluster import Cluster
+from ..utils import pod as podutils
+from .batcher import Batcher
+
+
+@dataclass
+class LaunchOptions:
+    """provisioner.go:40-73."""
+
+    record_pod_nomination: bool = False
+    reason: str = "provisioning"
+
+
+class LimitsExceededError(Exception):
+    pass
+
+
+class Provisioner:
+    def __init__(
+        self,
+        kube_client,
+        cloud_provider,
+        cluster: Cluster,
+        recorder=None,
+        batcher: Optional[Batcher] = None,
+        use_tpu_solver: bool = False,
+        metrics=None,
+    ):
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.recorder = recorder
+        self.batcher = batcher or Batcher()
+        self.use_tpu_solver = use_tpu_solver
+        self.metrics = metrics
+
+    def trigger(self) -> None:
+        self.batcher.trigger()
+
+    # -- reconcile (provisioner.go:114) ------------------------------------
+
+    def reconcile(self, wait_for_batch: bool = False) -> Tuple[List[str], Optional[str]]:
+        """One pass: returns (created nodeclaim names, requeue reason)."""
+        if wait_for_batch:
+            if not self.batcher.wait():
+                return [], None
+        if not self.cluster.synced():
+            return [], "waiting on cluster sync"
+        results = self.schedule()
+        if results is None:
+            return [], None
+        names: List[str] = []
+        opts = LaunchOptions(record_pod_nomination=True, reason="provisioning")
+        if results.new_node_claims:
+            created, _ = self.create_node_claims(results.new_node_claims, opts)
+            names.extend(created)
+        for plan in getattr(results, "tpu_plans", []):
+            try:
+                names.append(self.create_from_plan(plan, opts))
+            except Exception:  # noqa: BLE001 — one failed plan must not skip the rest
+                continue
+        return names, None
+
+    # -- pod discovery (provisioner.go:155-178) ----------------------------
+
+    def get_pending_pods(self) -> List[Pod]:
+        pods = []
+        vt = VolumeTopology(self.kube_client)
+        for pod in self.kube_client.list("Pod", filter_fn=lambda p: not p.spec.node_name):
+            if not podutils.is_provisionable(pod):
+                continue
+            err = vt.validate_persistent_volume_claims(pod)
+            if err is not None:
+                continue
+            pods.append(pod)
+        return pods
+
+    # -- schedule (provisioner.go:298) -------------------------------------
+
+    def schedule(self) -> Optional[Results]:
+        # snapshot nodes BEFORE listing pods to avoid over-provisioning
+        # (provisioner.go:301-312)
+        nodes = self.cluster.deep_copy_nodes()
+        active = [n for n in nodes if not n.marked_for_deletion]
+        deleting = [n for n in nodes if n.marked_for_deletion]
+        pending = self.get_pending_pods()
+        # pods on deleting nodes need replacement capacity
+        # (provisioner.go:317-323)
+        deleting_pods: List[Pod] = []
+        for n in deleting:
+            for ns, name in n.pod_requests:
+                pod = self.kube_client.get("Pod", name, namespace=ns)
+                if pod is not None and podutils.is_reschedulable(pod):
+                    deleting_pods.append(pod)
+        pods = pending + deleting_pods
+        if not pods:
+            return Results()
+
+        nodepools = [
+            np_
+            for np_ in self.kube_client.list("NodePool")
+            if np_.metadata.deletion_timestamp is None
+        ]
+        if not nodepools:
+            return Results()
+        # pure pending-pod batches go straight to the TPU path — building
+        # the greedy scheduler would duplicate all of its setup work
+        if self.use_tpu_solver and not active:
+            return self._schedule_tpu(pods, nodepools)
+        try:
+            scheduler = build_scheduler(
+                self.kube_client,
+                self.cluster,
+                nodepools,
+                self.cloud_provider,
+                pods,
+                state_nodes=active,
+                daemonset_pods=self.cluster.get_daemonset_pods(),
+                recorder=self.recorder,
+                opts=SchedulerOptions(),
+            )
+        except NodePoolsNotFoundError:
+            return Results()
+        return scheduler.solve(pods)
+
+    def _schedule_tpu(self, pods: List[Pod], nodepools) -> Results:
+        """TPU path: solve plans, then re-express them as scheduler results
+        via single-claim templates so CreateNodeClaims is uniform."""
+        from ..solver import TPUScheduler
+
+        solver = TPUScheduler(
+            nodepools, self.cloud_provider, kube_client=self.kube_client, cluster=self.cluster
+        )
+        sr = solver.solve(pods, daemonset_pods=self.cluster.get_daemonset_pods())
+        results = sr.oracle_results or Results()
+        results.pod_errors.update(sr.pod_errors)
+        by_uid = {p.uid: p for p in pods}
+        results._pods_by_uid.update(by_uid)
+        if sr.node_plans:
+            for plan in sr.node_plans:
+                plan.pods = [pods[i] for i in plan.pod_indices]
+            results.tpu_plans = sr.node_plans  # consumed by reconcile
+        return results
+
+    # -- create (provisioner.go:141-153, 341-367) --------------------------
+
+    def create_node_claims(
+        self, claims: List[SchedulingNodeClaim], options: Optional[LaunchOptions] = None
+    ) -> Tuple[List[str], List[str]]:
+        options = options or LaunchOptions()
+        names: List[str] = []
+        errors: List[str] = []
+        with concurrent.futures.ThreadPoolExecutor(max_workers=max(1, min(len(claims), 16))) as ex:
+            futures = {ex.submit(self.create, c, options): c for c in claims}
+            for fut in concurrent.futures.as_completed(futures):
+                try:
+                    names.append(fut.result())
+                except Exception as e:  # noqa: BLE001 — collected like multierr
+                    errors.append(f"creating node claim, {e}")
+        return names, errors
+
+    def create(self, claim: SchedulingNodeClaim, options: Optional[LaunchOptions] = None) -> str:
+        options = options or LaunchOptions()
+        latest = self.kube_client.get("NodePool", claim.nodepool_name)
+        if latest is None:
+            raise LimitsExceededError(f"nodepool {claim.nodepool_name} not found")
+        err = self._limits_exceeded(latest)
+        if err:
+            raise LimitsExceededError(err)
+        node_claim = claim.to_node_claim(latest)
+        self.kube_client.create(node_claim)
+        if self.metrics is not None:
+            self.metrics.nodeclaims_created.inc(
+                reason=options.reason, nodepool=claim.nodepool_name
+            )
+        if options.record_pod_nomination and self.recorder is not None:
+            from ..events import events as ev
+
+            for pod in claim.pods:
+                self.recorder.publish(ev.nominate_pod(pod, node_claim.name))
+        return node_claim.name
+
+    def create_from_plan(self, plan, options: Optional[LaunchOptions] = None) -> str:
+        """Stamp a NodeClaim CR from a TPU solver NodePlan: instance type,
+        zone and capacity type are already decided, so the claim pins them."""
+        from ..apis.nodeclaim import NodeClaimResources, NodeClaimSpec
+        from ..kube.objects import NodeSelectorRequirement, OwnerReference, next_name
+
+        options = options or LaunchOptions()
+        nodepool = self.kube_client.get("NodePool", plan.nodepool_name)
+        if nodepool is None:
+            raise LimitsExceededError(f"nodepool {plan.nodepool_name} not found")
+        err = self._limits_exceeded(nodepool)
+        if err:
+            raise LimitsExceededError(err)
+        template = nodepool.spec.template
+        nc = NodeClaim()
+        nc.metadata.name = next_name(plan.nodepool_name)
+        nc.metadata.labels = {
+            **template.metadata.labels,
+            wk.NODEPOOL_LABEL_KEY: plan.nodepool_name,
+        }
+        nc.metadata.annotations = {
+            **template.metadata.annotations,
+            wk.NODEPOOL_HASH_ANNOTATION_KEY: nodepool.static_hash(),
+        }
+        nc.spec = NodeClaimSpec(
+            taints=list(template.taints),
+            startup_taints=list(template.startup_taints),
+            requirements=[
+                NodeSelectorRequirement(wk.LABEL_INSTANCE_TYPE, "In", [plan.instance_type.name]),
+                NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", [plan.zone]),
+                NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [plan.capacity_type]),
+            ],
+            kubelet=template.kubelet,
+            node_class_ref=template.node_class_ref,
+        )
+        nc.spec.resources = NodeClaimResources(requests=dict(plan.requests or {}))
+        nc.metadata.owner_references = [
+            OwnerReference(
+                api_version="karpenter.sh/v1beta1",
+                kind="NodePool",
+                name=nodepool.name,
+                uid=nodepool.uid,
+                block_owner_deletion=True,
+            )
+        ]
+        self.kube_client.create(nc)
+        if self.metrics is not None:
+            self.metrics.nodeclaims_created.inc(
+                reason=options.reason, nodepool=plan.nodepool_name
+            )
+        if options.record_pod_nomination and self.recorder is not None:
+            from ..events import events as ev
+
+            for pod in getattr(plan, "pods", None) or []:
+                self.recorder.publish(ev.nominate_pod(pod, nc.metadata.name))
+        return nc.metadata.name
+
+    @staticmethod
+    def _limits_exceeded(nodepool) -> Optional[str]:
+        """Limits.ExceededBy(status.resources) (nodepool.go:127 Limits)."""
+        for name, limit in nodepool.spec.limits.items():
+            usage = nodepool.status.resources.get(name, 0)
+            if usage > limit:
+                return f"limit exceeded for resource {name}"
+        return None

@@ -61,6 +61,8 @@ class NodePlan:
     capacity_type: str
     price: float
     pod_indices: List[int]  # into the solve batch
+    requests: Optional[dict] = None  # summed pod requests (nanos)
+    pods: Optional[List[Pod]] = None  # resolved by the provisioner for events
 
 
 @dataclass
@@ -435,6 +437,7 @@ class TPUScheduler:
                     capacity_type=offering_ct,
                     price=offering_price,
                     pod_indices=members,
+                    requests=resources.requests_for_pods(*(pods[i] for i in members)),
                 )
             )
 

@@ -42,6 +42,7 @@ def is_provisionable(pod: Pod) -> bool:
         not is_scheduled(pod)
         and not is_preempting(pod)
         and failed_to_schedule(pod)
+        and not is_owned_by_daemonset(pod)
         and not is_owned_by_node(pod)
         and not is_terminal(pod)
         and not is_terminating(pod)

@@ -1,0 +1,44 @@
+"""Kubelet simulation helpers (mirrors pkg/test/expectations
+ExpectMakeNodesInitialized / ExpectMakeNodeClaimsInitialized): fabricate
+Node objects for launched NodeClaims and flip them Ready."""
+
+from __future__ import annotations
+
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.kube.objects import Condition, Node
+
+
+def join_node_for_claim(kube, node_claim, ready: bool = True) -> Node:
+    """Simulate the kubelet joining the cluster for a launched claim."""
+    node = Node()
+    node.metadata.name = f"node-for-{node_claim.name}"
+    node.metadata.labels = dict(node_claim.metadata.labels)
+    node.metadata.labels[wk.LABEL_HOSTNAME] = node.metadata.name
+    node.spec.provider_id = node_claim.status.provider_id
+    node.spec.taints = list(node_claim.spec.taints) + list(node_claim.spec.startup_taints)
+    node.status.capacity = dict(node_claim.status.capacity)
+    node.status.allocatable = dict(node_claim.status.allocatable)
+    if ready:
+        node.status.conditions = [Condition(type="Ready", status="True")]
+    kube.create(node)
+    return node
+
+
+def make_node_ready(kube, node) -> None:
+    node.status.conditions = [c for c in node.status.conditions if c.type != "Ready"]
+    node.status.conditions.append(Condition(type="Ready", status="True"))
+    kube.apply(node)
+
+
+def remove_startup_taints(kube, node, node_claim) -> None:
+    startup = list(node_claim.spec.startup_taints)
+    node.spec.taints = [t for t in node.spec.taints if not any(t.match(s) for s in startup)]
+    kube.apply(node)
+
+
+def bind_pods_to_node(kube, node, *pods) -> None:
+    for pod in pods:
+        pod.spec.node_name = node.name
+        pod.status.phase = "Running"
+        pod.status.conditions = []
+        kube.apply(pod)

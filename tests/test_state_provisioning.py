@@ -1,0 +1,171 @@
+"""Cluster state + provisioner loop tests (modeled on state/suite_test.go
+and provisioning/suite_test.go behaviors)."""
+
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.apis.nodeclaim import NodeClaim
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import DaemonSet, OwnerReference, PodSpec, Container, ResourceRequirements
+from karpenter_core_tpu.kube.quantity import parse_quantity
+from karpenter_core_tpu.provisioning import Provisioner
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informers import Informers
+
+
+@pytest.fixture
+def env():
+    kube = KubeClient()
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(10)
+    cluster = Cluster(kube, provider)
+    informers = Informers(kube, cluster)
+    informers.start()
+    recorder = Recorder(kube)
+    provisioner = Provisioner(kube, provider, cluster, recorder=recorder)
+    yield kube, provider, cluster, provisioner, recorder
+    informers.stop()
+
+
+class TestClusterState:
+    def test_node_tracked_via_informer(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node(capacity={"cpu": "4", "memory": "8Gi", "pods": 10})
+        kube.create(node)
+        assert cluster.synced()
+        nodes = cluster.deep_copy_nodes()
+        assert len(nodes) == 1
+        assert nodes[0].name() == node.name
+
+    def test_unsynced_when_nodeclaim_missing_provider_id(self, env):
+        kube, _, cluster, _, _ = env
+        nc = NodeClaim()
+        nc.metadata.name = "pending-claim"
+        kube.create(nc)
+        assert not cluster.synced()
+        nc.status.provider_id = "fake:///abc"
+        kube.update(nc)
+        assert cluster.synced()
+
+    def test_pod_binding_updates_usage(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node(capacity={"cpu": "4", "memory": "8Gi", "pods": 10})
+        kube.create(node)
+        pod = make_pod(requests={"cpu": "1"}, node_name=node.name, pending_unschedulable=False)
+        kube.create(pod)
+        sn = cluster.deep_copy_nodes()[0]
+        assert sn.pod_request_total().get("cpu") == parse_quantity("1")
+        assert sn.available()["cpu"] == parse_quantity("3")
+
+    def test_pod_deletion_releases_usage(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node(capacity={"cpu": "4", "memory": "8Gi", "pods": 10})
+        kube.create(node)
+        pod = make_pod(requests={"cpu": "1"}, node_name=node.name, pending_unschedulable=False)
+        kube.create(pod)
+        kube.delete(pod)
+        sn = cluster.deep_copy_nodes()[0]
+        assert sn.pod_request_total().get("cpu", 0) == 0
+
+    def test_mark_for_deletion(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node(capacity={"cpu": "4"})
+        kube.create(node)
+        pid = cluster.deep_copy_nodes()[0].provider_id()
+        cluster.mark_for_deletion(pid)
+        assert cluster.deep_copy_nodes()[0].marked_for_deletion
+        cluster.unmark_for_deletion(pid)
+        assert not cluster.deep_copy_nodes()[0].marked_for_deletion
+
+    def test_consolidation_timestamp_moves(self, env):
+        kube, _, cluster, _, _ = env
+        t0 = cluster.consolidation_state()
+        node = make_node(capacity={"cpu": "4"})
+        kube.create(node)
+        kube.delete(node)
+        assert cluster.consolidation_state() >= t0
+
+
+class TestProvisioner:
+    def test_provisions_pending_pods(self, env):
+        kube, provider, cluster, provisioner, _ = env
+        kube.create(make_nodepool())
+        for _ in range(3):
+            kube.create(make_pod(requests={"cpu": "1"}))
+        names, reason = provisioner.reconcile()
+        assert reason is None
+        assert names
+        claims = kube.list("NodeClaim")
+        assert len(claims) == len(names)
+        assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "default"
+        assert claims[0].spec.resources.requests.get("cpu", 0) >= parse_quantity("3")
+
+    def test_no_pending_pods_no_claims(self, env):
+        kube, _, _, provisioner, _ = env
+        kube.create(make_nodepool())
+        names, _ = provisioner.reconcile()
+        assert not names
+        assert not kube.list("NodeClaim")
+
+    def test_scheduled_pods_ignored(self, env):
+        kube, _, _, provisioner, _ = env
+        kube.create(make_nodepool())
+        kube.create(make_pod(requests={"cpu": "1"}, node_name="existing", pending_unschedulable=False))
+        names, _ = provisioner.reconcile()
+        assert not names
+
+    def test_daemonset_pods_ignored_for_provisioning(self, env):
+        kube, _, _, provisioner, _ = env
+        kube.create(make_nodepool())
+        pod = make_pod(requests={"cpu": "1"}, owner_kind="DaemonSet")
+        kube.create(pod)
+        names, _ = provisioner.reconcile()
+        assert not names
+
+    def test_nodepool_limit_blocks_create(self, env):
+        kube, _, _, provisioner, _ = env
+        np = make_nodepool(limits={"cpu": "1"})
+        np.status.resources = {"cpu": parse_quantity("2")}  # already over
+        kube.create(np)
+        kube.create(make_pod(requests={"cpu": "1"}))
+        names, _ = provisioner.reconcile()
+        assert not names
+
+    def test_nomination_events_recorded(self, env):
+        kube, _, _, provisioner, recorder = env
+        kube.create(make_nodepool())
+        kube.create(make_pod(requests={"cpu": "1"}))
+        provisioner.reconcile()
+        assert "Nominated" in recorder.reasons()
+
+    def test_pods_on_deleting_nodes_get_replacement(self, env):
+        kube, provider, cluster, provisioner, _ = env
+        kube.create(make_nodepool())
+        node = make_node(
+            labels={wk.NODE_REGISTERED_LABEL_KEY: "true", wk.NODE_INITIALIZED_LABEL_KEY: "true",
+                    wk.NODEPOOL_LABEL_KEY: "default"},
+            capacity={"cpu": "4", "memory": "8Gi", "pods": 10},
+        )
+        kube.create(node)
+        pod = make_pod(requests={"cpu": "1"}, node_name=node.name, pending_unschedulable=False)
+        pod.status.phase = "Running"
+        kube.create(pod)
+        pid = cluster.deep_copy_nodes()[0].provider_id()
+        cluster.mark_for_deletion(pid)
+        names, _ = provisioner.reconcile()
+        # replacement capacity for the displaced pod
+        assert len(names) == 1
+
+    def test_tpu_solver_backend(self, env):
+        kube, provider, cluster, _, recorder = env
+        provisioner = Provisioner(kube, provider, cluster, recorder=recorder, use_tpu_solver=True)
+        kube.create(make_nodepool())
+        for _ in range(5):
+            kube.create(make_pod(requests={"cpu": "500m"}))
+        names, _ = provisioner.reconcile()
+        claims = kube.list("NodeClaim")
+        assert len(claims) >= 1
+        assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "default"
