@@ -1,0 +1,4 @@
+from .controller import DisruptionController
+from .types import Candidate, Command, ACTION_DELETE, ACTION_REPLACE, ACTION_NOOP
+from .orchestration import OrchestrationQueue
+from .markers import NodeClaimDisruptionController

@@ -1,0 +1,173 @@
+"""Disruption controller: the 10 s singleton loop running Methods in
+order (ref pkg/controllers/disruption/controller.go)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..apis import labels as wk
+from ..kube.objects import EFFECT_NO_SCHEDULE, Taint
+from ..provisioning.provisioner import LaunchOptions
+from ..utils import pod as podutils
+from .helpers import get_candidates
+from .methods import (
+    Drift,
+    Emptiness,
+    EmptyNodeConsolidation,
+    Expiration,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from .orchestration import OrchestrationQueue
+from .types import ACTION_NOOP, Command
+
+
+@dataclass
+class DisruptionContext:
+    """Shared dependencies for methods (the `consolidation` struct,
+    consolidation.go:28)."""
+
+    kube_client: object
+    cluster: object
+    provisioner: object
+    cloud_provider: object
+    recorder: object
+    queue: OrchestrationQueue
+    clock: Callable[[], float] = time.time
+    # test hook: replaces the 15 s validation wait (consolidation.go:42);
+    # None skips waiting entirely
+    validation_sleep: Optional[Callable[[float], None]] = None
+
+
+class DisruptionController:
+    """controller.go:72-136."""
+
+    def __init__(
+        self,
+        kube_client,
+        cluster,
+        provisioner,
+        cloud_provider,
+        recorder=None,
+        clock: Callable[[], float] = time.time,
+        queue: Optional[OrchestrationQueue] = None,
+        validation_sleep: Optional[Callable[[float], None]] = None,
+        use_tpu_screen: bool = True,
+        metrics=None,
+    ):
+        self.kube_client = kube_client
+        self.cluster = cluster
+        self.clock = clock
+        self.metrics = metrics
+        self.queue = queue or OrchestrationQueue(kube_client, cluster, recorder, clock, metrics)
+        self.ctx = DisruptionContext(
+            kube_client=kube_client,
+            cluster=cluster,
+            provisioner=provisioner,
+            cloud_provider=cloud_provider,
+            recorder=recorder,
+            queue=self.queue,
+            clock=clock,
+            validation_sleep=validation_sleep,
+        )
+        # method order is the disruption priority (controller.go:72-85)
+        self.methods = [
+            Expiration(self.ctx),
+            Drift(self.ctx),
+            Emptiness(self.ctx),
+            EmptyNodeConsolidation(self.ctx),
+            MultiNodeConsolidation(self.ctx, use_tpu_screen=use_tpu_screen),
+            SingleNodeConsolidation(self.ctx),
+        ]
+
+    def reconcile(self) -> Optional[str]:
+        """One pass; returns the executed method name or None."""
+        if not self.cluster.synced():
+            return None
+        self._cleanup_stale_taints()
+        for method in self.methods:
+            candidates = get_candidates(
+                self.cluster,
+                self.kube_client,
+                self.ctx.recorder,
+                self.clock,
+                self.ctx.cloud_provider,
+                method.should_disrupt,
+                self.queue,
+            )
+            if self.metrics is not None:
+                self.metrics.eligible_nodes.set(
+                    len(candidates), method=method.type_name
+                )
+            if not candidates:
+                continue
+            cmd = method.compute_command(candidates)
+            if cmd.action() == ACTION_NOOP:
+                continue
+            self._execute(cmd, method)
+            return method.type_name
+        return None
+
+    # -- execute (controller.go:177-213) -----------------------------------
+
+    def _execute(self, cmd: Command, method) -> None:
+        # 1. cordon candidates with the disruption taint
+        for c in cmd.candidates:
+            node = self.kube_client.get("Node", c.name())
+            if node is not None:
+                taint = podutils.DISRUPTION_NO_SCHEDULE_TAINT
+                if not any(taint.match(t) for t in node.spec.taints):
+                    node.spec.taints.append(
+                        Taint(key=taint.key, value=taint.value, effect=taint.effect)
+                    )
+                self.kube_client.apply(node)
+        # 2. launch replacements
+        replacement_names: List[str] = []
+        if cmd.replacements:
+            replacement_names, errs = self.ctx.provisioner.create_node_claims(
+                cmd.replacements, LaunchOptions(reason=method.type_name)
+            )
+            if errs:
+                # roll back the cordon and abort (controller.go:189-199)
+                for c in cmd.candidates:
+                    node = self.kube_client.get("Node", c.name())
+                    if node is not None:
+                        node.spec.taints = [
+                            t for t in node.spec.taints if t.key != wk.DISRUPTION_TAINT_KEY
+                        ]
+                        self.kube_client.apply(node)
+                return
+        # 3. mark for deletion + hand to orchestration
+        self.cluster.mark_for_deletion(*[c.provider_id() for c in cmd.candidates])
+        self.queue.add(cmd, replacement_names, method.type_name, getattr(method, "consolidation_type", ""))
+        if self.ctx.recorder is not None:
+            from ..events import events as ev
+
+            for c in cmd.candidates:
+                self.ctx.recorder.publish(
+                    ev.disrupt_node(c.state_node.node, method.type_name)
+                )
+        if self.metrics is not None:
+            self.metrics.disruption_actions.inc(
+                method=method.type_name, action=cmd.action()
+            )
+
+    def _cleanup_stale_taints(self) -> None:
+        """Remove disruption taints from nodes no orchestration command owns
+        — crash-safe restart behavior (controller.go:111-118)."""
+        for node in self.kube_client.list("Node"):
+            if any(t.key == wk.DISRUPTION_TAINT_KEY for t in node.spec.taints):
+                pid = node.spec.provider_id
+                if not self.queue.has_any(pid) and not self._marked(pid):
+                    node.spec.taints = [
+                        t for t in node.spec.taints if t.key != wk.DISRUPTION_TAINT_KEY
+                    ]
+                    self.kube_client.apply(node)
+
+    def _marked(self, provider_id: str) -> bool:
+        for n in self.cluster.deep_copy_nodes():
+            if n.provider_id() == provider_id:
+                return n.marked_for_deletion
+        return False

@@ -1,0 +1,192 @@
+"""Disruption helpers: candidate filtering, scheduling simulation, price
+filtering, PDB limits (ref pkg/controllers/disruption/helpers.go,
+pdblimits.go)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..apis import labels as wk
+from ..cloudprovider.types import InstanceType
+from ..kube.objects import Pod
+from ..scheduler.builder import NodePoolsNotFoundError, build_scheduler
+from ..scheduler.scheduler import Results, SchedulerOptions
+from ..utils import pod as podutils
+from .types import Candidate, CandidateError, new_candidate
+
+
+class CandidateDeletingError(Exception):
+    pass
+
+
+class PDBLimits:
+    """pdblimits.go:36: can the pods be evicted without violating a PDB?"""
+
+    def __init__(self, kube_client):
+        self.kube_client = kube_client
+        self.pdbs = kube_client.list("PodDisruptionBudget")
+
+    def can_evict_pods(self, pods: List[Pod]) -> Tuple[str, bool]:
+        for pod in pods:
+            for pdb in self.pdbs:
+                if pdb.namespace == pod.namespace and pdb.selector.matches(pod.metadata.labels):
+                    if pdb.disruptions_allowed < 1:
+                        return f"{pdb.namespace}/{pdb.name}", False
+        return "", True
+
+
+def has_do_not_disrupt_pod(candidate: Candidate) -> Optional[Pod]:
+    for p in candidate.pods:
+        if podutils.has_do_not_disrupt(p) and not podutils.is_terminating(p) and not podutils.is_terminal(p):
+            return p
+    return None
+
+
+def filter_candidates(kube_client, recorder, candidates: List[Candidate]) -> List[Candidate]:
+    """helpers.go:47 filterCandidates: deleting nodes, PDB-blocked nodes and
+    do-not-disrupt pods all block voluntary disruption."""
+    pdbs = PDBLimits(kube_client)
+    out = []
+    for cn in candidates:
+        if cn.state_node.node is not None and cn.state_node.node.metadata.deletion_timestamp is not None:
+            continue
+        pdb_name, ok = pdbs.can_evict_pods(cn.pods)
+        if not ok:
+            _blocked(recorder, cn, f'PDB "{pdb_name}" prevents pod evictions')
+            continue
+        blocked_pod = has_do_not_disrupt_pod(cn)
+        if blocked_pod is not None:
+            _blocked(recorder, cn, f'Pod "{blocked_pod.namespace}/{blocked_pod.name}" has do not evict annotation')
+            continue
+        out.append(cn)
+    return out
+
+
+def _blocked(recorder, candidate: Candidate, message: str) -> None:
+    if recorder is not None:
+        from ..events import events as ev
+
+        recorder.publish(ev.blocked(candidate.state_node.node, message, message))
+
+
+def get_candidates(
+    cluster,
+    kube_client,
+    recorder,
+    clock: Callable[[], float],
+    cloud_provider,
+    should_disrupt: Callable[[Candidate], bool],
+    queue=None,
+) -> List[Candidate]:
+    """helpers.go GetCandidates: scan cluster state for disruptable nodes."""
+    nodepool_map = {np.name: np for np in kube_client.list("NodePool")}
+    instance_type_map: Dict[str, Dict[str, InstanceType]] = {}
+    for name, np_ in nodepool_map.items():
+        try:
+            instance_type_map[name] = {it.name: it for it in cloud_provider.get_instance_types(np_)}
+        except Exception:
+            continue
+    candidates = []
+    for node in cluster.deep_copy_nodes():
+        try:
+            cn = new_candidate(
+                kube_client, recorder, clock, node, nodepool_map, instance_type_map, queue
+            )
+        except CandidateError:
+            continue
+        if should_disrupt(cn):
+            candidates.append(cn)
+    return candidates
+
+
+def simulate_scheduling(
+    kube_client, cluster, provisioner, candidates: List[Candidate]
+) -> Results:
+    """helpers.go:73 simulateScheduling: run the scheduler in simulation
+    mode over pending + candidate + deleting-node pods minus the candidate
+    nodes, rejecting placements on uninitialized nodes."""
+    candidate_names = {c.name() for c in candidates}
+    nodes = cluster.deep_copy_nodes()
+    deleting = [n for n in nodes if n.marked_for_deletion]
+    state_nodes = [
+        n for n in nodes if not n.marked_for_deletion and n.name() not in candidate_names
+    ]
+    if any(n.name() in candidate_names for n in deleting):
+        raise CandidateDeletingError()
+
+    pods: List[Pod] = provisioner.get_pending_pods()
+    for c in candidates:
+        pods.extend(p for p in c.pods if podutils.is_reschedulable(p))
+    for n in deleting:
+        for ns, name in n.pod_requests:
+            p = kube_client.get("Pod", name, namespace=ns)
+            if p is not None and podutils.is_reschedulable(p):
+                pods.append(p)
+
+    nodepools = [
+        np_ for np_ in kube_client.list("NodePool") if np_.metadata.deletion_timestamp is None
+    ]
+    if not nodepools:
+        raise NodePoolsNotFoundError("no nodepools found")
+    scheduler = build_scheduler(
+        kube_client,
+        cluster,
+        nodepools,
+        provisioner.cloud_provider,
+        pods,
+        state_nodes=state_nodes,
+        daemonset_pods=cluster.get_daemonset_pods(),
+        recorder=None,
+        opts=SchedulerOptions(simulation_mode=True),
+    )
+    results = scheduler.solve(pods)
+    # placements that depend on uninitialized nodes don't count
+    # (helpers.go:108-115)
+    for existing in results.existing_nodes:
+        if not existing.initialized():
+            for p in existing.pods:
+                results.pod_errors[p.uid] = (
+                    f"would schedule against a non-initialized node {existing.name()}"
+                )
+                results._pods_by_uid[p.uid] = p
+    return results
+
+
+def filter_by_price(
+    instance_types: List[InstanceType], requirements, max_price: float
+) -> List[InstanceType]:
+    """Keep instance types with an allowed offering cheaper than max_price
+    (consolidation.go filterByPrice)."""
+    out = []
+    for it in instance_types:
+        offerings = it.offerings.available().requirements(requirements)
+        cheapest = offerings.cheapest()
+        if cheapest is not None and cheapest.price < max_price:
+            out.append(it)
+    return out
+
+
+def get_candidate_prices(candidates: List[Candidate]) -> float:
+    """Sum of candidate offering prices (consolidation.go
+    getCandidatePrices)."""
+    total = 0.0
+    for c in candidates:
+        price = c.price()
+        if price is None:
+            raise ValueError(
+                f"unable to determine offering for {c.instance_type.name}/{c.capacity_type}/{c.zone}"
+            )
+        total += price
+    return total
+
+
+def instance_types_are_subset(lhs: List[InstanceType], rhs: List[InstanceType]) -> bool:
+    rhs_names = {it.name for it in rhs}
+    return all(it.name in rhs_names for it in lhs)
+
+
+def map_candidates(proposed: List[Candidate], current: List[Candidate]) -> List[Candidate]:
+    """Intersect proposed command candidates with fresh state (validation.go
+    mapCandidates)."""
+    current_by_id = {c.provider_id(): c for c in current}
+    return [current_by_id[c.provider_id()] for c in proposed if c.provider_id() in current_by_id]

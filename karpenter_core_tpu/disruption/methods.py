@@ -1,0 +1,402 @@
+"""Disruption methods, run in priority order by the controller (ref
+pkg/controllers/disruption/{expiration,drift,emptiness,
+emptynodeconsolidation,multinodeconsolidation,
+singlenodeconsolidation,validation}.go)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import COND_DRIFTED, COND_EMPTY, COND_EXPIRED
+from ..apis.nodepool import CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+from ..scheduling import Requirement
+from ..kube.objects import OP_IN
+from .helpers import (
+    CandidateDeletingError,
+    filter_by_price,
+    filter_candidates,
+    get_candidate_prices,
+    instance_types_are_subset,
+    map_candidates,
+    simulate_scheduling,
+)
+from .types import ACTION_DELETE, ACTION_NOOP, ACTION_REPLACE, Candidate, Command
+
+CONSOLIDATION_TTL = 15.0  # consolidation.go:25
+MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:34
+SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0  # singlenodeconsolidation.go:29
+MAX_PARALLEL = 100  # multinodeconsolidation.go:58
+
+
+class Method:
+    """types.go:38 Method interface."""
+
+    type_name = ""
+    consolidation_type = ""
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        raise NotImplementedError
+
+    def compute_command(self, candidates: List[Candidate]) -> Command:
+        raise NotImplementedError
+
+
+class ConditionMethod(Method):
+    """Expiration / Drift / Emptiness: act on status conditions set by the
+    marker controller; replacements are counted by simulation."""
+
+    condition = ""
+    needs_replacement = True
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        nc = candidate.state_node.node_claim
+        return nc is not None and nc.status_condition_is_true(self.condition)
+
+    def compute_command(self, candidates: List[Candidate]) -> Command:
+        candidates = filter_candidates(self.ctx.kube_client, self.ctx.recorder, candidates)
+        if not candidates:
+            return Command()
+        if not self.needs_replacement:
+            return Command(candidates=candidates)
+        # disrupt candidates one at a time, launching replacement capacity
+        # for displaced pods (expiration.go:80-123, drift.go:75-121)
+        for candidate in candidates:
+            try:
+                results = simulate_scheduling(
+                    self.ctx.kube_client, self.ctx.cluster, self.ctx.provisioner, [candidate]
+                )
+            except CandidateDeletingError:
+                continue
+            if not results.all_non_pending_pods_scheduled():
+                continue
+            return Command(candidates=[candidate], replacements=results.new_node_claims)
+        return Command()
+
+
+class Expiration(ConditionMethod):
+    condition = COND_EXPIRED
+    type_name = "expiration"
+
+
+class Drift(ConditionMethod):
+    condition = COND_DRIFTED
+    type_name = "drift"
+
+
+class Emptiness(ConditionMethod):
+    """Fast path: Empty-condition nodes delete without simulation
+    (emptiness.go:42-65)."""
+
+    condition = COND_EMPTY
+    needs_replacement = False
+    type_name = "emptiness"
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        if not super().should_disrupt(candidate):
+            return False
+        d = candidate.nodepool.spec.disruption
+        if d.consolidate_after is None:
+            return False
+        nc = candidate.state_node.node_claim
+        cond = nc.get_condition(COND_EMPTY)
+        return self.ctx.clock() - cond.last_transition_time >= d.consolidate_after
+
+
+class ConsolidationBase(Method):
+    """consolidation.go:27 shared base."""
+
+    type_name = "consolidation"
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.last_consolidation_state = -1.0
+
+    def is_consolidated(self) -> bool:
+        return self.last_consolidation_state == self.ctx.cluster.consolidation_state()
+
+    def mark_consolidated(self) -> None:
+        self.last_consolidation_state = self.ctx.cluster.consolidation_state()
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        """consolidation.go:73 ShouldDisrupt."""
+        if candidate.annotations().get(wk.DO_NOT_CONSOLIDATE_ANNOTATION_KEY) == "true":
+            return False
+        d = candidate.nodepool.spec.disruption
+        return d.consolidation_policy == CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+
+    def sort_and_filter(self, candidates: List[Candidate]) -> List[Candidate]:
+        candidates = filter_candidates(self.ctx.kube_client, self.ctx.recorder, candidates)
+        return sorted(candidates, key=lambda c: c.disruption_cost)
+
+    # -- the decision core (consolidation.go:113 computeConsolidation) -----
+
+    def compute_consolidation(self, candidates: List[Candidate]) -> Command:
+        try:
+            results = simulate_scheduling(
+                self.ctx.kube_client, self.ctx.cluster, self.ctx.provisioner, candidates
+            )
+        except CandidateDeletingError:
+            return Command()
+        if not results.all_non_pending_pods_scheduled():
+            return Command()
+        if not results.new_node_claims:
+            return Command(candidates=candidates)
+        if len(results.new_node_claims) != 1:
+            return Command()
+
+        replacement = results.new_node_claims[0]
+        candidate_price = get_candidate_prices(candidates)
+        replacement.instance_type_options = filter_by_price(
+            replacement.instance_type_options, replacement.requirements, candidate_price
+        )
+        if not replacement.instance_type_options:
+            return Command()
+
+        # spot→spot replacement is disallowed; force OD→spot when allowed
+        # (consolidation.go:142-169)
+        all_spot = all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates)
+        ct_req = replacement.requirements.get_req(wk.CAPACITY_TYPE_LABEL_KEY)
+        if all_spot and ct_req.has(wk.CAPACITY_TYPE_SPOT):
+            return Command()
+        if ct_req.has(wk.CAPACITY_TYPE_SPOT) and ct_req.has(wk.CAPACITY_TYPE_ON_DEMAND):
+            replacement.requirements.add(
+                Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [wk.CAPACITY_TYPE_SPOT])
+            )
+        return Command(candidates=candidates, replacements=[replacement])
+
+    def validate(self, cmd: Command) -> bool:
+        v = Validation(self.ctx, self.should_disrupt)
+        return v.is_valid(cmd)
+
+
+class EmptyNodeConsolidation(ConsolidationBase):
+    """emptynodeconsolidation.go: delete all empty candidates at once."""
+
+    consolidation_type = "empty"
+
+    def compute_command(self, candidates: List[Candidate]) -> Command:
+        if self.is_consolidated():
+            return Command()
+        candidates = self.sort_and_filter(candidates)
+        empty = [c for c in candidates if not c.pods or all(_ignorable(p) for p in c.pods)]
+        if not empty:
+            self.mark_consolidated()
+            return Command()
+        # re-check after the TTL that the nodes are still empty
+        # (emptynodeconsolidation.go validation loop)
+        if self.ctx.validation_sleep is not None:
+            self.ctx.validation_sleep(CONSOLIDATION_TTL)
+        still_empty = []
+        for c in empty:
+            pods = [
+                p
+                for p in self.ctx.kube_client.list("Pod")
+                if p.spec.node_name == c.state_node.name() and not _ignorable(p)
+            ]
+            if not pods and not self.ctx.cluster.is_node_nominated(c.provider_id()):
+                still_empty.append(c)
+        return Command(candidates=still_empty)
+
+
+class MultiNodeConsolidation(ConsolidationBase):
+    """multinodeconsolidation.go — with the TPU prefix screen replacing the
+    log(N)-simulations binary search when available."""
+
+    consolidation_type = "multi"
+
+    def __init__(self, ctx, use_tpu_screen: bool = True):
+        super().__init__(ctx)
+        self.use_tpu_screen = use_tpu_screen
+
+    def compute_command(self, candidates: List[Candidate]) -> Command:
+        if self.is_consolidated():
+            return Command()
+        candidates = self.sort_and_filter(candidates)
+        max_parallel = min(len(candidates), MAX_PARALLEL)
+        cmd = self.first_n_consolidation(candidates, max_parallel)
+        if cmd.action() == ACTION_NOOP:
+            self.mark_consolidated()
+            return cmd
+        if not self.validate(cmd):
+            return Command()
+        return cmd
+
+    def first_n_consolidation(self, candidates: List[Candidate], max_n: int) -> Command:
+        """multinodeconsolidation.go:66 firstNConsolidationOption. With the
+        TPU screen we jump straight to the largest capacity-feasible prefix
+        and walk down on simulation failure; without it, binary search."""
+        if len(candidates) < 2:
+            return Command()
+        max_n = min(max_n, len(candidates))
+        deadline = self.ctx.clock() + MULTI_NODE_CONSOLIDATION_TIMEOUT
+
+        order = None
+        if self.use_tpu_screen:
+            from .tpu_repack import screen_prefixes
+
+            k = screen_prefixes(self.ctx, candidates[:max_n])
+            if k >= 2:
+                # try the screened k first, then fall down
+                order = list(range(k, 1, -1))
+        if order is None:
+            return self._binary_search(candidates, max_n, deadline)
+
+        last = Command()
+        for k in order[:4]:  # bounded verification attempts
+            if self.ctx.clock() > deadline:
+                break
+            cmd = self._attempt(candidates[:k])
+            if cmd is not None:
+                return cmd
+        # screen over-estimated; fall back to binary search below the
+        # screened sizes
+        return self._binary_search(candidates, min(max_n, (order[-1] if order else max_n)), deadline)
+
+    def _attempt(self, prefix: List[Candidate]) -> Optional[Command]:
+        cmd = self.compute_consolidation(prefix)
+        if cmd.action() == ACTION_REPLACE:
+            cmd.replacements[0].instance_type_options = filter_out_same_type(
+                cmd.replacements[0], prefix
+            )
+            if not cmd.replacements[0].instance_type_options:
+                return None
+            return cmd
+        if cmd.action() == ACTION_DELETE:
+            return cmd
+        return None
+
+    def _binary_search(self, candidates: List[Candidate], max_n: int, deadline: float) -> Command:
+        lo_, hi = 1, max_n - 1
+        last = Command()
+        while lo_ <= hi:
+            if self.ctx.clock() > deadline:
+                return last
+            mid = (lo_ + hi) // 2
+            cmd = self._attempt(candidates[: mid + 1])
+            if cmd is not None:
+                last = cmd
+                lo_ = mid + 1
+            else:
+                hi = mid - 1
+        return last
+
+
+class SingleNodeConsolidation(ConsolidationBase):
+    """singlenodeconsolidation.go: linear scan, first success wins."""
+
+    consolidation_type = "single"
+
+    def compute_command(self, candidates: List[Candidate]) -> Command:
+        if self.is_consolidated():
+            return Command()
+        candidates = self.sort_and_filter(candidates)
+        deadline = self.ctx.clock() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+        for candidate in candidates:
+            if self.ctx.clock() > deadline:
+                return Command()
+            cmd = self.compute_consolidation([candidate])
+            if cmd.action() == ACTION_NOOP:
+                continue
+            if not self.validate(cmd):
+                return Command()
+            return cmd
+        self.mark_consolidated()
+        return Command()
+
+
+class Validation:
+    """validation.go: wait out the TTL, rebuild candidates, re-simulate."""
+
+    def __init__(self, ctx, should_disrupt: Callable[[Candidate], bool]):
+        self.ctx = ctx
+        self.should_disrupt = should_disrupt
+
+    def is_valid(self, cmd: Command) -> bool:
+        if self.ctx.validation_sleep is not None:
+            self.ctx.validation_sleep(CONSOLIDATION_TTL)
+        from .helpers import get_candidates
+
+        fresh = get_candidates(
+            self.ctx.cluster,
+            self.ctx.kube_client,
+            self.ctx.recorder,
+            self.ctx.clock,
+            self.ctx.cloud_provider,
+            self.should_disrupt,
+            self.ctx.queue,
+        )
+        mapped = filter_candidates(
+            self.ctx.kube_client, self.ctx.recorder, map_candidates(cmd.candidates, fresh)
+        )
+        if len(mapped) != len(cmd.candidates):
+            return False
+        for c in mapped:
+            if self.ctx.cluster.is_node_nominated(c.provider_id()):
+                return False
+        return self._validate_command(cmd, mapped)
+
+    def _validate_command(self, cmd: Command, candidates: List[Candidate]) -> bool:
+        """validation.go:110 ValidateCommand."""
+        if not candidates:
+            return False
+        try:
+            results = simulate_scheduling(
+                self.ctx.kube_client, self.ctx.cluster, self.ctx.provisioner, candidates
+            )
+        except CandidateDeletingError:
+            return False
+        if not results.all_non_pending_pods_scheduled():
+            return False
+        if not results.new_node_claims:
+            return not cmd.replacements
+        if len(results.new_node_claims) > 1:
+            return False
+        if not cmd.replacements:
+            return False
+        # the original replacement's instance types must cover the new
+        # simulation's needs (validation.go tail: subset + price re-check)
+        return instance_types_are_subset(
+            results.new_node_claims[0].instance_type_options,
+            cmd.replacements[0].instance_type_options,
+        ) or instance_types_are_subset(
+            cmd.replacements[0].instance_type_options,
+            results.new_node_claims[0].instance_type_options,
+        )
+
+
+def filter_out_same_type(replacement, consolidated: List[Candidate]):
+    """multinodeconsolidation.go:142 filterOutSameType: price-sanity — the
+    replacement must be cheaper than the cheapest existing instance of any
+    type it shares with the candidates."""
+    import math
+
+    existing_types = set()
+    prices_by_type = {}
+    for c in consolidated:
+        existing_types.add(c.instance_type.name)
+        offering = c.instance_type.offerings.get(c.capacity_type, c.zone)
+        if offering is None:
+            continue
+        prices_by_type[c.instance_type.name] = min(
+            prices_by_type.get(c.instance_type.name, math.inf), offering.price
+        )
+    max_price = math.inf
+    for it in replacement.instance_type_options:
+        if it.name in existing_types:
+            max_price = min(max_price, prices_by_type.get(it.name, math.inf))
+    return filter_by_price(replacement.instance_type_options, replacement.requirements, max_price)
+
+
+def _ignorable(pod) -> bool:
+    from ..utils import pod as podutils
+
+    return (
+        podutils.is_owned_by_daemonset(pod)
+        or podutils.is_terminal(pod)
+        or podutils.is_terminating(pod)
+    )

@@ -1,0 +1,101 @@
+"""TPU consolidation screen: evaluate every candidate-prefix size in one
+batched computation.
+
+The reference binary-searches prefix sizes, paying a full scheduler
+simulation per probe (multinodeconsolidation.go:77-137, O(log N) solves,
+1-minute budget). The BASELINE north star asks for the prefixes to be
+evaluated in parallel instead. This module computes, on device, a
+**capacity feasibility screen** for all prefixes at once:
+
+  feasible[k] = the pods of candidates[0..k] fit into
+                (free capacity of the surviving fleet) + (one new node)
+
+via a cumulative-sum over candidate pod loads against a psum'd fleet
+free-capacity vector — O(N·R) on TPU for all N prefixes, one dispatch.
+The screened k is then verified with the oracle simulation (same role as
+the reference's Validation re-solve); capacity screening is necessary
+but not sufficient (constraints can still reject), so the caller walks
+down on verification failure.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scheduling import resources
+from ..solver.encode import build_resource_axis, quantize_capacity, quantize_requests
+from .types import Candidate
+
+
+@jax.jit
+def prefix_screen_kernel(
+    candidate_loads: jnp.ndarray,  # (N, R) int32 — per-candidate pod request sums
+    candidate_free: jnp.ndarray,  # (N, R) int32 — per-candidate free capacity
+    fleet_free: jnp.ndarray,  # (R,) int32 — free capacity of non-candidate fleet
+    new_node_cap: jnp.ndarray,  # (R,) int32 — largest launchable instance
+) -> jnp.ndarray:
+    """→ (N,) bool: prefix of size k+1 is capacity-feasible.
+
+    Removing candidates[0..k] frees their nodes but orphans their pods;
+    the orphans must fit into the remaining fleet's free space — which
+    includes the free space of the *not-removed* candidates — plus at
+    most one replacement node."""
+    cum_load = jnp.cumsum(candidate_loads, axis=0)  # (N, R)
+    total_candidate_free = jnp.sum(candidate_free, axis=0)
+    cum_candidate_free = jnp.cumsum(candidate_free, axis=0)
+    surviving_candidate_free = total_candidate_free[None, :] - cum_candidate_free
+    headroom = fleet_free[None, :] + surviving_candidate_free + new_node_cap[None, :]
+    return jnp.all(cum_load <= headroom, axis=-1)
+
+
+def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
+    """Largest prefix size (≥0) that passes the capacity screen."""
+    if len(candidates) < 2:
+        return 0
+    candidate_names = {c.name() for c in candidates}
+
+    all_requests = [resources.requests_for_pods(*c.pods) if c.pods else {} for c in candidates]
+    instance_types = [c.instance_type for c in candidates]
+    axis = build_resource_axis(all_requests, instance_types)
+
+    loads = np.stack([quantize_requests(r, axis) for r in all_requests])
+    free = np.stack(
+        [quantize_capacity(c.state_node.available(), axis) for c in candidates]
+    )
+
+    fleet_free = np.zeros(axis.count, dtype=np.int64)
+    for node in ctx.cluster.deep_copy_nodes():
+        if node.marked_for_deletion or node.name() in candidate_names:
+            continue
+        if not node.initialized():
+            continue
+        fleet_free += quantize_capacity(node.available(), axis)
+    fleet_free = np.minimum(fleet_free, 2**30).astype(np.int32)
+
+    # the largest instance a replacement could be (upper bound; the oracle
+    # verification enforces the real price/compat constraints)
+    new_node_cap = np.zeros(axis.count, dtype=np.int32)
+    for np_ in ctx.kube_client.list("NodePool"):
+        try:
+            for it in ctx.cloud_provider.get_instance_types(np_):
+                new_node_cap = np.maximum(new_node_cap, quantize_capacity(it.allocatable(), axis))
+        except Exception:
+            continue
+
+    feasible = np.asarray(
+        prefix_screen_kernel(
+            jnp.asarray(loads),
+            jnp.asarray(free),
+            jnp.asarray(fleet_free),
+            jnp.asarray(new_node_cap),
+        )
+    )
+    if not feasible.any():
+        return 0
+    # prefix sizes are 1-indexed; find the largest feasible prefix
+    return int(np.max(np.flatnonzero(feasible))) + 1

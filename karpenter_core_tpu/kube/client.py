@@ -30,13 +30,18 @@ ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
 
 
 class KubeClient:
-    """Thread-safe in-memory object store keyed by (kind, namespace, name)."""
+    """Thread-safe in-memory object store keyed by (kind, namespace, name).
 
-    def __init__(self) -> None:
+    ``clock`` stamps deletion timestamps; inject the same clock the
+    controllers use so timestamp comparisons agree under simulated time.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.time) -> None:
         self._objects: Dict[str, Dict[tuple, KubeObject]] = defaultdict(dict)
         self._watchers: Dict[str, List[Callable]] = defaultdict(list)
         self._lock = threading.RLock()
         self._rv = 0
+        self.clock = clock
 
     # -- helpers -----------------------------------------------------------
 
@@ -116,7 +121,7 @@ class KubeClient:
                 return False
             if obj.metadata.finalizers:
                 if obj.metadata.deletion_timestamp is None:
-                    obj.metadata.deletion_timestamp = time.time()
+                    obj.metadata.deletion_timestamp = self.clock()
                     self._rv += 1
                     obj.metadata.resource_version = self._rv
                     modified = obj

@@ -41,9 +41,18 @@ class EvictionQueue:
         do-not-disrupt is NOT honored here: it gates voluntary disruption
         candidacy (disruption engine), not the termination drain — refusing
         would deadlock node finalization (ref terminator/eviction.go)."""
-        for pdb in self.kube_client.list("PodDisruptionBudget", namespace=pod.namespace):
-            if pdb.selector.matches(pod.metadata.labels) and pdb.disruptions_allowed <= 0:
-                return False  # the PDB 429 path
+        matched = [
+            pdb
+            for pdb in self.kube_client.list("PodDisruptionBudget", namespace=pod.namespace)
+            if pdb.selector.matches(pod.metadata.labels)
+        ]
+        if any(pdb.disruptions_allowed <= 0 for pdb in matched):
+            return False  # the PDB 429 path
+        # consume the budget like the eviction API does; the (simulated)
+        # disruption controller replenishes it as replacements go healthy
+        for pdb in matched:
+            pdb.disruptions_allowed -= 1
+            self.kube_client.apply(pdb)
         self.kube_client.delete(pod)
         if self.recorder is not None:
             from ..events import events as ev

@@ -1,0 +1,220 @@
+"""Prometheus-compatible metrics (ref pkg/metrics/metrics.go,
+constants.go): counters/gauges/histograms with label sets, exposable in
+text format. Metric names mirror the reference's `karpenter_` namespace
+so dashboards port over."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter"
+
+# duration buckets (constants.go:24-60 DurationBuckets)
+DURATION_BUCKETS = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600,
+]
+
+
+def _labels_key(labels: Dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "", label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self.values: Dict[tuple, float] = {}
+        self._mu = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = _labels_key(labels)
+        with self._mu:
+            self.values[key] = self.values.get(key, 0.0) + value
+
+    def get(self, **labels) -> float:
+        return self.values.get(_labels_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self.values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = "", label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self.values: Dict[tuple, float] = {}
+        self._mu = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        with self._mu:
+            self.values[_labels_key(labels)] = value
+
+    def get(self, **labels) -> Optional[float]:
+        return self.values.get(_labels_key(labels))
+
+    def delete(self, **labels) -> None:
+        with self._mu:
+            self.values.pop(_labels_key(labels), None)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self.values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets: Optional[List[float]] = None, label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets or DURATION_BUCKETS
+        self.label_names = tuple(label_names)
+        self.counts: Dict[tuple, List[int]] = {}
+        self.sums: Dict[tuple, float] = {}
+        self.totals: Dict[tuple, int] = {}
+        self._mu = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = _labels_key(labels)
+        with self._mu:
+            if key not in self.counts:
+                self.counts[key] = [0] * len(self.buckets)
+                self.sums[key] = 0.0
+                self.totals[key] = 0
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[key][i] += 1
+            self.sums[key] += value
+            self.totals[key] += 1
+
+    def time(self, **labels):
+        """Context manager: `with h.time(): ...` (metrics.Measure helper)."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.start = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.start, **labels)
+                return False
+
+        return _Timer()
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self.counts):
+            cumulative = 0
+            for i, b in enumerate(self.buckets):
+                cumulative = self.counts[key][i]
+                out.append(f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {cumulative}')
+            out.append(f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {self.totals[key]}')
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {self.sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {self.totals[key]}")
+        return out
+
+
+def _fmt_labels(key: tuple, **extra) -> str:
+    pairs = list(key) + sorted(extra.items())
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self.metrics: List[object] = []
+        self._mu = threading.Lock()
+
+    def register(self, metric):
+        with self._mu:
+            self.metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str = "", labels: Iterable[str] = ()) -> Counter:
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str = "", labels: Iterable[str] = ()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))
+
+    def histogram(self, name: str, help_: str = "", buckets=None, labels: Iterable[str] = ()) -> Histogram:
+        return self.register(Histogram(name, help_, buckets, labels))
+
+    def expose(self) -> str:
+        """Prometheus text exposition format (the /metrics payload)."""
+        lines: List[str] = []
+        for m in self.metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+class Metrics:
+    """The reference's metric set (pkg/metrics/metrics.go:29-135 +
+    per-package metrics), bound to one registry."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        ns = NAMESPACE
+        self.nodeclaims_created = r.counter(f"{ns}_nodeclaims_created", "NodeClaims created", ["reason", "nodepool"])
+        self.nodeclaims_terminated = r.counter(f"{ns}_nodeclaims_terminated", "NodeClaims terminated", ["reason", "nodepool"])
+        self.nodeclaims_launched = r.counter(f"{ns}_nodeclaims_launched", "NodeClaims launched", ["nodepool"])
+        self.nodeclaims_registered = r.counter(f"{ns}_nodeclaims_registered", "NodeClaims registered", ["nodepool"])
+        self.nodeclaims_initialized = r.counter(f"{ns}_nodeclaims_initialized", "NodeClaims initialized", ["nodepool"])
+        self.nodeclaims_disrupted = r.counter(f"{ns}_nodeclaims_disrupted", "NodeClaims disrupted", ["method"])
+        self.nodeclaims_drifted = r.counter(f"{ns}_nodeclaims_drifted", "NodeClaims drifted", ["type"])
+        self.nodes_created = r.counter(f"{ns}_nodes_created", "Nodes created", ["nodepool"])
+        self.nodes_terminated = r.counter(f"{ns}_nodes_terminated", "Nodes terminated", ["nodepool"])
+        self.scheduling_duration = r.histogram(
+            f"{ns}_provisioner_scheduling_duration_seconds", "Scheduling duration"
+        )
+        self.simulation_duration = r.histogram(
+            f"{ns}_provisioner_scheduling_simulation_duration_seconds", "Simulation duration"
+        )
+        self.disruption_evaluation_duration = r.histogram(
+            f"{ns}_disruption_evaluation_duration_seconds", "Disruption evaluation duration", labels=["method"]
+        )
+        self.disruption_actions = r.counter(
+            f"{ns}_disruption_actions_performed_total", "Disruption actions", ["method", "action"]
+        )
+        self.eligible_nodes = r.gauge(
+            f"{ns}_disruption_eligible_nodes", "Disruption-eligible nodes", ["method"]
+        )
+        self.consistency_errors = r.counter(f"{ns}_nodeclaims_consistency_errors", "Consistency errors")
+        self.cloudprovider_duration = r.histogram(
+            f"{ns}_cloudprovider_duration_seconds", "Cloud provider method duration", labels=["method", "provider"]
+        )
+        self.cloudprovider_errors = r.counter(
+            f"{ns}_cloudprovider_errors_total", "Cloud provider errors", ["method", "provider"]
+        )
+        self.solver_duration = r.histogram(
+            f"{ns}_tpu_solver_duration_seconds", "TPU solve wall time"
+        )
+        self.solver_parity = r.gauge(
+            f"{ns}_tpu_solver_packing_parity", "TPU/oracle packing parity ratio"
+        )
+        # node/nodepool/pod scrapers (metrics/{node,nodepool,pod})
+        self.node_allocatable = r.gauge(f"{ns}_nodes_allocatable", "Node allocatable", ["node", "resource"])
+        self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])
+        self.node_pod_limits = r.gauge(f"{ns}_nodes_total_pod_limits", "Node pod limits", ["node", "resource"])
+        self.node_daemon_requests = r.gauge(f"{ns}_nodes_total_daemon_requests", "Node daemon requests", ["node", "resource"])
+        self.node_system_overhead = r.gauge(f"{ns}_nodes_system_overhead", "Node system overhead", ["node", "resource"])
+        self.nodepool_limit = r.gauge(f"{ns}_nodepool_limit", "NodePool limit", ["nodepool", "resource"])
+        self.nodepool_usage = r.gauge(f"{ns}_nodepool_usage", "NodePool usage", ["nodepool", "resource"])
+        self.pod_state = r.gauge(f"{ns}_pods_state", "Pod state", ["name", "namespace", "phase"])
+        self.pod_startup_time = r.histogram(f"{ns}_pods_startup_time_seconds", "Pod startup time")
+        self.reconcile_duration = r.histogram(
+            f"{ns}_controller_reconcile_duration_seconds", "Controller reconcile duration", labels=["controller"]
+        )
+        self.reconcile_errors = r.counter(
+            f"{ns}_controller_reconcile_errors_total", "Controller reconcile errors", ["controller"]
+        )

@@ -1,0 +1,86 @@
+"""Dedup-publishing metrics store + scrapers (ref pkg/metrics/store.go,
+pkg/controllers/metrics/{node,nodepool,pod})."""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from ..kube.quantity import NANO
+from ..scheduling import resources
+from .registry import Metrics
+
+
+class MetricsStore:
+    """store.go:32: tracks which label sets were published so stale series
+    are deleted when objects disappear."""
+
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+        self._published_nodes: Set[str] = set()
+        self._published_pools: Set[str] = set()
+        self._published_pods: Set[tuple] = set()
+
+    # -- node scraper (metrics/node/controller.go:48-96) -------------------
+
+    def scrape_nodes(self, cluster) -> None:
+        seen = set()
+
+        def visit(sn) -> bool:
+            name = sn.name()
+            seen.add(name)
+            for res, qty in sn.allocatable().items():
+                self.metrics.node_allocatable.set(qty / NANO, node=name, resource=res)
+            for res, qty in sn.pod_request_total().items():
+                self.metrics.node_pod_requests.set(qty / NANO, node=name, resource=res)
+            for res, qty in sn.pod_limit_total().items():
+                self.metrics.node_pod_limits.set(qty / NANO, node=name, resource=res)
+            for res, qty in sn.daemonset_request_total().items():
+                self.metrics.node_daemon_requests.set(qty / NANO, node=name, resource=res)
+            overhead = resources.subtract(sn.capacity(), sn.allocatable())
+            for res, qty in overhead.items():
+                self.metrics.node_system_overhead.set(qty / NANO, node=name, resource=res)
+            return True
+
+        cluster.for_each_node(visit)
+        for stale in self._published_nodes - seen:
+            for gauge in (
+                self.metrics.node_allocatable,
+                self.metrics.node_pod_requests,
+                self.metrics.node_pod_limits,
+                self.metrics.node_daemon_requests,
+                self.metrics.node_system_overhead,
+            ):
+                for key in [k for k in gauge.values if ("node", stale) in k]:
+                    gauge.values.pop(key, None)
+        self._published_nodes = seen
+
+    # -- nodepool scraper (metrics/nodepool/controller.go:49-64) -----------
+
+    def scrape_nodepools(self, kube_client) -> None:
+        seen = set()
+        for np_ in kube_client.list("NodePool"):
+            seen.add(np_.name)
+            for res, qty in np_.spec.limits.items():
+                self.metrics.nodepool_limit.set(qty / NANO, nodepool=np_.name, resource=res)
+            for res, qty in np_.status.resources.items():
+                self.metrics.nodepool_usage.set(qty / NANO, nodepool=np_.name, resource=res)
+        self._published_pools = seen
+
+    # -- pod scraper (metrics/pod/controller.go:59-71) ---------------------
+
+    def scrape_pods(self, kube_client) -> None:
+        seen = set()
+        for pod in kube_client.list("Pod"):
+            key = (pod.namespace, pod.name)
+            seen.add(key)
+            self.metrics.pod_state.set(
+                1.0, name=pod.name, namespace=pod.namespace, phase=pod.status.phase
+            )
+        for stale in self._published_pods - seen:
+            for k in [
+                k
+                for k in self.metrics.pod_state.values
+                if ("name", stale[1]) in k and ("namespace", stale[0]) in k
+            ]:
+                self.metrics.pod_state.values.pop(k, None)
+        self._published_pods = seen

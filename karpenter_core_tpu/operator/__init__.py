@@ -1,0 +1,3 @@
+from .options import Options
+from .operator import Operator
+from .controller import SingletonController

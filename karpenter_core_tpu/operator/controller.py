@@ -1,0 +1,68 @@
+"""Controller abstractions (ref pkg/operator/controller/controller.go,
+singleton.go): singleton poll-loop controllers with reconcile metrics and
+the 10 ms → 10 s backoff rate limiter."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+BASE_DELAY = 0.01  # singleton.go:133 rate-limiter base
+MAX_DELAY = 10.0  # singleton.go:141 max
+
+
+class SingletonController:
+    """singleton.go:39: a controller that reconciles in its own loop."""
+
+    def __init__(
+        self,
+        name: str,
+        reconcile: Callable[[], Optional[float]],
+        metrics=None,
+        logger=None,
+        period: float = 10.0,
+    ):
+        self.name = name
+        self._reconcile = reconcile
+        self.metrics = metrics
+        self.logger = logger
+        self.period = period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error_streak = 0
+
+    def reconcile_once(self) -> Optional[float]:
+        """One reconcile; returns the requeue delay. Errors back off
+        exponentially (singleton.go:81-123)."""
+        start = time.perf_counter()
+        try:
+            requeue_after = self._reconcile()
+            self._error_streak = 0
+        except Exception as e:  # noqa: BLE001 — controller loops never die
+            self._error_streak += 1
+            if self.metrics is not None:
+                self.metrics.reconcile_errors.inc(controller=self.name)
+            if self.logger is not None:
+                self.logger.with_(controller=self.name).error("reconcile error, %s", e)
+            requeue_after = min(BASE_DELAY * (2 ** self._error_streak), MAX_DELAY)
+        finally:
+            if self.metrics is not None:
+                self.metrics.reconcile_duration.observe(
+                    time.perf_counter() - start, controller=self.name
+                )
+        return requeue_after if requeue_after is not None else self.period
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.is_set():
+                delay = self.reconcile_once()
+                self._stop.wait(delay)
+
+        self._thread = threading.Thread(target=loop, name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,65 @@
+"""Structured logging (ref pkg/operator/logging/logging.go): zap-style
+leveled logger with key-value context."""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO, "warn": logging.WARNING, "error": logging.ERROR}
+
+
+class StructuredFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "level": record.levelname.lower(),
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        extra = getattr(record, "kv", None)
+        if extra:
+            payload.update(extra)
+        return json.dumps(payload)
+
+
+class Logger:
+    """knative-style sugar: .with_(k=v) returns a child carrying context."""
+
+    def __init__(self, name: str = "controller", level: str = "info", kv: Optional[dict] = None):
+        self._logger = logging.getLogger(name)
+        if not self._logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(StructuredFormatter())
+            self._logger.addHandler(handler)
+            self._logger.propagate = False
+        self._logger.setLevel(_LEVELS.get(level, logging.INFO))
+        self.kv = kv or {}
+
+    def with_(self, **kv) -> "Logger":
+        child = Logger.__new__(Logger)
+        child._logger = self._logger
+        child.kv = {**self.kv, **kv}
+        return child
+
+    def _log(self, level: int, msg: str, *args) -> None:
+        self._logger.log(level, msg % args if args else msg, extra={"kv": self.kv})
+
+    def debug(self, msg: str, *args) -> None:
+        self._log(logging.DEBUG, msg, *args)
+
+    def info(self, msg: str, *args) -> None:
+        self._log(logging.INFO, msg, *args)
+
+    def warn(self, msg: str, *args) -> None:
+        self._log(logging.WARNING, msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self._log(logging.ERROR, msg, *args)
+
+
+def new_logger(level: str = "info") -> Logger:
+    return Logger(level=level)

@@ -1,0 +1,216 @@
+"""Operator: the composition root (ref pkg/operator/operator.go +
+pkg/controllers/controllers.go:47-82 — the single place listing every
+controller)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..cloudprovider.metrics import MetricsDecorator
+from ..disruption import DisruptionController, NodeClaimDisruptionController, OrchestrationQueue
+from ..events import Recorder
+from ..kube.client import KubeClient
+from ..lifecycle import (
+    ConsistencyController,
+    EvictionQueue,
+    LeaseGarbageCollectionController,
+    NodeClaimGarbageCollectionController,
+    NodeClaimLifecycleController,
+    NodeClaimTerminationController,
+    NodePoolCounterController,
+    NodePoolHashController,
+    NodeTerminationController,
+    Terminator,
+)
+from ..metrics import Metrics, MetricsStore, Registry
+from ..provisioning import Batcher, Provisioner
+from ..state.cluster import Cluster
+from ..state.informers import Informers
+from .controller import SingletonController
+from .logging import new_logger
+from .options import Options
+
+
+class Operator:
+    """operator.go:80 NewOperator / WithControllers / Start, collapsed into
+    one object (we have no provider-binary split)."""
+
+    def __init__(
+        self,
+        cloud_provider,
+        kube_client: Optional[KubeClient] = None,
+        options: Optional[Options] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.options = options or Options.from_env()
+        self.logger = new_logger(self.options.log_level)
+        self.kube_client = kube_client or KubeClient(clock=clock)
+        self.registry = Registry()
+        self.metrics = Metrics(self.registry)
+        self.cloud_provider = MetricsDecorator(cloud_provider, self.metrics)
+        self.recorder = Recorder(self.kube_client, clock=clock)
+        self.clock = clock
+
+        self.cluster = Cluster(self.kube_client, self.cloud_provider, clock=clock)
+        self.informers = Informers(self.kube_client, self.cluster)
+        self.batcher = Batcher(
+            idle_seconds=self.options.batch_idle_duration,
+            max_seconds=self.options.batch_max_duration,
+            clock=clock,
+        )
+        self.provisioner = Provisioner(
+            self.kube_client,
+            self.cloud_provider,
+            self.cluster,
+            recorder=self.recorder,
+            batcher=self.batcher,
+            use_tpu_solver=self.options.use_tpu_solver,
+            metrics=self.metrics,
+        )
+        self.eviction_queue = EvictionQueue(self.kube_client, self.recorder)
+        self.terminator = Terminator(self.kube_client, self.eviction_queue, clock=clock)
+        self.orchestration_queue = OrchestrationQueue(
+            self.kube_client, self.cluster, self.recorder, clock, self.metrics
+        )
+        self.nodeclaim_lifecycle = NodeClaimLifecycleController(
+            self.kube_client, self.cloud_provider, self.recorder, clock, self.metrics
+        )
+        self.nodeclaim_termination = NodeClaimTerminationController(
+            self.kube_client, self.cloud_provider, self.metrics
+        )
+        self.node_termination = NodeTerminationController(
+            self.kube_client, self.cloud_provider, self.terminator, self.recorder, self.metrics
+        )
+        self.nodeclaim_gc = NodeClaimGarbageCollectionController(
+            self.kube_client, self.cloud_provider, clock
+        )
+        self.nodeclaim_disruption = NodeClaimDisruptionController(
+            self.kube_client,
+            self.cloud_provider,
+            self.cluster,
+            clock,
+            drift_enabled=self.options.feature_gates.drift,
+        )
+        self.disruption = DisruptionController(
+            self.kube_client,
+            self.cluster,
+            self.provisioner,
+            self.cloud_provider,
+            recorder=self.recorder,
+            clock=clock,
+            queue=self.orchestration_queue,
+            use_tpu_screen=self.options.tpu_consolidation_screen,
+            metrics=self.metrics,
+        )
+        self.consistency = ConsistencyController(self.kube_client, self.recorder, metrics=self.metrics)
+        self.nodepool_counter = NodePoolCounterController(self.kube_client, self.cluster)
+        self.nodepool_hash = NodePoolHashController(self.kube_client)
+        self.lease_gc = LeaseGarbageCollectionController(self.kube_client)
+        self.metrics_store = MetricsStore(self.metrics)
+
+        # the reconcile surface, mirroring controllers.go:47-82
+        self.controllers: List[SingletonController] = [
+            SingletonController("provisioner", self._reconcile_provisioner, self.metrics, self.logger, period=10.0),
+            SingletonController("disruption", self._reconcile_disruption, self.metrics, self.logger, period=10.0),
+            SingletonController("disruption.queue", self._reconcile_queue, self.metrics, self.logger, period=1.0),
+            SingletonController("nodeclaim.lifecycle", self._reconcile_lifecycle, self.metrics, self.logger, period=2.0),
+            SingletonController("nodeclaim.termination", self._reconcile_nc_termination, self.metrics, self.logger, period=2.0),
+            SingletonController("node.termination", self._reconcile_node_termination, self.metrics, self.logger, period=2.0),
+            SingletonController("nodeclaim.garbagecollection", lambda: self._none(self.nodeclaim_gc.reconcile), self.metrics, self.logger, period=120.0),
+            SingletonController("nodeclaim.disruption", lambda: self._none(self.nodeclaim_disruption.reconcile_all), self.metrics, self.logger, period=10.0),
+            SingletonController("nodeclaim.consistency", lambda: self._none(self.consistency.reconcile_all), self.metrics, self.logger, period=600.0),
+            SingletonController("nodepool.counter", lambda: self._none(self.nodepool_counter.reconcile_all), self.metrics, self.logger, period=10.0),
+            SingletonController("nodepool.hash", lambda: self._none(self.nodepool_hash.reconcile_all), self.metrics, self.logger, period=10.0),
+            SingletonController("lease.garbagecollection", lambda: self._none(self.lease_gc.reconcile), self.metrics, self.logger, period=120.0),
+            SingletonController("metrics.scraper", self._reconcile_metrics, self.metrics, self.logger, period=10.0),
+            SingletonController("eviction.queue", lambda: self._none(self.eviction_queue.reconcile), self.metrics, self.logger, period=1.0),
+        ]
+        self._started = False
+        self._batching = False
+
+    # -- reconcile wrappers -------------------------------------------------
+
+    @staticmethod
+    def _none(fn: Callable) -> None:
+        fn()
+        return None
+
+    def _reconcile_provisioner(self) -> None:
+        with self.metrics.scheduling_duration.time():
+            _, reason = self.provisioner.reconcile(wait_for_batch=self._batching)
+        if reason:
+            self.logger.with_(controller="provisioner").info("%s", reason)
+        return None
+
+    def _reconcile_disruption(self) -> None:
+        self.disruption.reconcile()
+        return None
+
+    def _reconcile_queue(self) -> None:
+        self.orchestration_queue.reconcile()
+        return None
+
+    def _reconcile_lifecycle(self) -> None:
+        self.nodeclaim_lifecycle.reconcile_all()
+        return None
+
+    def _reconcile_nc_termination(self) -> None:
+        self.nodeclaim_termination.reconcile_all()
+        return None
+
+    def _reconcile_node_termination(self) -> None:
+        self.node_termination.reconcile_all()
+        return None
+
+    def _reconcile_metrics(self) -> None:
+        self.metrics_store.scrape_nodes(self.cluster)
+        self.metrics_store.scrape_nodepools(self.kube_client)
+        self.metrics_store.scrape_pods(self.kube_client)
+        return None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """operator.go:203 Start: informers first (cache sync), then all
+        controllers."""
+        self.informers.start()
+        # pod-watch → batcher trigger, the provisioning trigger controller
+        # (provisioning/controller.go:58)
+        from ..utils import pod as podutils
+
+        def on_pod(event, pod):
+            if event != "DELETED" and podutils.is_provisionable(pod):
+                self.provisioner.trigger()
+
+        self._pod_watch_unsub = self.kube_client.watch("Pod", on_pod)
+        self._batching = True
+        for c in self.controllers:
+            c.start()
+        self._started = True
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()
+        unsub = getattr(self, "_pod_watch_unsub", None)
+        if unsub is not None:
+            unsub()
+        self.informers.stop()
+        self._started = False
+        self._batching = False
+
+    def reconcile_all_once(self) -> None:
+        """Synchronous single pass over every controller (test/simulation
+        driver)."""
+        if not self._started:
+            self.informers.start()
+            self._started = True
+        for c in self.controllers:
+            c.reconcile_once()
+
+    def healthy(self) -> bool:
+        return self.cluster.synced()
+
+    def metrics_text(self) -> str:
+        return self.registry.expose()

@@ -73,16 +73,20 @@ class Provisioner:
         if results is None:
             return [], None
         names: List[str] = []
+        create_errors: List[str] = []
         opts = LaunchOptions(record_pod_nomination=True, reason="provisioning")
         if results.new_node_claims:
-            created, _ = self.create_node_claims(results.new_node_claims, opts)
+            created, errs = self.create_node_claims(results.new_node_claims, opts)
             names.extend(created)
+            create_errors.extend(errs)
         for plan in getattr(results, "tpu_plans", []):
             try:
                 names.append(self.create_from_plan(plan, opts))
-            except Exception:  # noqa: BLE001 — one failed plan must not skip the rest
-                continue
-        return names, None
+            except Exception as e:  # noqa: BLE001 — one failed plan must not skip the rest
+                create_errors.append(f"creating node claim from plan, {e}")
+        # surface failures instead of looking like "nothing to do"
+        reason = "; ".join(create_errors[:5]) if create_errors else None
+        return names, reason
 
     # -- pod discovery (provisioner.go:155-178) ----------------------------
 
@@ -157,6 +161,16 @@ class Provisioner:
         sr = solver.solve(pods, daemonset_pods=self.cluster.get_daemonset_pods())
         results = sr.oracle_results or Results()
         results.pod_errors.update(sr.pod_errors)
+        # the oracle path publishes these inside solve(); mirror it here so
+        # the event stream is backend-agnostic
+        if self.recorder is not None and sr.pod_errors:
+            from ..events import events as ev
+
+            by_uid = {p.uid: p for p in pods}
+            for uid, err in sr.pod_errors.items():
+                pod = by_uid.get(uid)
+                if pod is not None:
+                    self.recorder.publish(ev.pod_failed_to_schedule(pod, err))
         by_uid = {p.uid: p for p in pods}
         results._pods_by_uid.update(by_uid)
         if sr.node_plans:

@@ -148,6 +148,10 @@ class Cluster:
             pid = node.spec.provider_id or node.name
             old_pid = self.node_name_to_provider_id.get(node.name)
             old = self.nodes.get(pid) or (self.nodes.get(old_pid) if old_pid else None)
+            if old_pid and old_pid != pid:
+                # the node's provider id changed (e.g. stamped after
+                # registration) — drop the stale entry or it double-counts
+                self.nodes.pop(old_pid, None)
             state = StateNode(node, old.node_claim if old else None)
             self._carry_pods(old, state)
             # populate CSI limits from annotations if present

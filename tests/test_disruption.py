@@ -1,0 +1,301 @@
+"""Disruption engine tests (modeled on
+pkg/controllers/disruption/consolidation_test.go, emptiness_test.go,
+drift_test.go, expiration_test.go)."""
+
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.apis.nodeclaim import (
+    COND_DRIFTED,
+    COND_EMPTY,
+    COND_EXPIRED,
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from karpenter_core_tpu.apis.nodepool import (
+    CONSOLIDATION_POLICY_WHEN_EMPTY,
+    CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED,
+)
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.disruption import DisruptionController, NodeClaimDisruptionController
+from karpenter_core_tpu.disruption.helpers import get_candidates
+from karpenter_core_tpu.disruption.tpu_repack import screen_prefixes
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import LabelSelector, PodDisruptionBudget
+from karpenter_core_tpu.kube.quantity import parse_quantity
+from karpenter_core_tpu.provisioning import Provisioner
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informers import Informers
+
+
+class Env:
+    def __init__(self, policy=CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED, consolidate_after=0.0):
+        self.now = 10_000.0
+        self.kube = KubeClient()
+        self.provider = FakeCloudProvider()
+        self.provider.instance_types = instance_types(10)
+        self.cluster = Cluster(self.kube, self.provider, clock=self.clock)
+        self.informers = Informers(self.kube, self.cluster)
+        self.informers.start()
+        self.recorder = Recorder()
+        self.provisioner = Provisioner(self.kube, self.provider, self.cluster, recorder=self.recorder)
+        self.nodepool = make_nodepool()
+        self.nodepool.spec.disruption.consolidation_policy = policy
+        self.nodepool.spec.disruption.consolidate_after = consolidate_after
+        self.kube.create(self.nodepool)
+        self.controller = DisruptionController(
+            self.kube,
+            self.cluster,
+            self.provisioner,
+            self.provider,
+            recorder=self.recorder,
+            clock=self.clock,
+            validation_sleep=lambda t: None,
+        )
+
+    def clock(self):
+        return self.now
+
+    def make_initialized_node(self, instance_type_name="fake-it-4", zone="test-zone-1",
+                              capacity_type="on-demand", pods=()):
+        """An initialized node+claim pair owned by the nodepool."""
+        it = next(i for i in self.provider.get_instance_types(self.nodepool) if i.name == instance_type_name)
+        provider_id = f"fake:///node-{len(self.kube.list('Node'))}"
+        nc = NodeClaim()
+        nc.metadata.name = f"claim-{len(self.kube.list('NodeClaim'))}"
+        nc.metadata.labels = {
+            wk.NODEPOOL_LABEL_KEY: self.nodepool.name,
+            wk.LABEL_INSTANCE_TYPE: instance_type_name,
+            wk.LABEL_TOPOLOGY_ZONE: zone,
+            wk.CAPACITY_TYPE_LABEL_KEY: capacity_type,
+        }
+        nc.metadata.annotations = {wk.NODEPOOL_HASH_ANNOTATION_KEY: self.nodepool.static_hash()}
+        nc.status.provider_id = provider_id
+        nc.status.capacity = dict(it.capacity)
+        nc.status.allocatable = it.allocatable()
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            nc.set_condition(cond, "True")
+        self.kube.create(nc)
+        self.provider.created_node_claims[provider_id] = nc
+
+        node = make_node(
+            labels={**nc.metadata.labels,
+                    wk.NODE_REGISTERED_LABEL_KEY: "true", wk.NODE_INITIALIZED_LABEL_KEY: "true"},
+            capacity={k: v for k, v in it.capacity.items()},
+            provider_id=provider_id,
+        )
+        node.status.allocatable = it.allocatable()
+        node.metadata.creation_timestamp = self.now - 100
+        self.kube.create(node)
+        for pod in pods:
+            pod.spec.node_name = node.name
+            pod.status.phase = "Running"
+            pod.status.conditions = []
+            self.kube.create(pod)
+        return node, nc
+
+    def stop(self):
+        self.informers.stop()
+
+
+@pytest.fixture
+def env():
+    e = Env()
+    yield e
+    e.stop()
+
+
+def running_pod(cpu="100m", labels=None):
+    return make_pod(requests={"cpu": cpu}, labels=labels, pending_unschedulable=False)
+
+
+class TestMarkers:
+    def test_emptiness_condition(self):
+        e = Env(policy=CONSOLIDATION_POLICY_WHEN_EMPTY, consolidate_after=30.0)
+        try:
+            node, nc = e.make_initialized_node()
+            markers = NodeClaimDisruptionController(e.kube, e.provider, e.cluster, clock=e.clock)
+            markers.reconcile_all()
+            nc = e.kube.get("NodeClaim", nc.name)
+            assert nc.status_condition_is_true(COND_EMPTY)
+            # pod lands → not empty
+            pod = running_pod()
+            pod.spec.node_name = node.name
+            e.kube.create(pod)
+            markers.reconcile_all()
+            assert not e.kube.get("NodeClaim", nc.name).status_condition_is_true(COND_EMPTY)
+        finally:
+            e.stop()
+
+    def test_expiration_condition(self, env):
+        env.nodepool.spec.disruption.expire_after = 3600.0
+        env.kube.apply(env.nodepool)
+        node, nc = env.make_initialized_node()
+        markers = NodeClaimDisruptionController(env.kube, env.provider, env.cluster, clock=env.clock)
+        markers.reconcile_all()
+        assert not env.kube.get("NodeClaim", nc.name).status_condition_is_true(COND_EXPIRED)
+        env.now += 3700
+        markers.reconcile_all()
+        assert env.kube.get("NodeClaim", nc.name).status_condition_is_true(COND_EXPIRED)
+
+    def test_drift_condition_on_hash_change(self, env):
+        from karpenter_core_tpu.kube.objects import Taint
+        from karpenter_core_tpu.lifecycle import NodePoolHashController
+
+        node, nc = env.make_initialized_node()
+        markers = NodeClaimDisruptionController(env.kube, env.provider, env.cluster, clock=env.clock)
+        env.provider.drifted = ""  # no cloud drift
+        hash_ctrl = NodePoolHashController(env.kube)
+        hash_ctrl.reconcile_all()
+        markers.reconcile_all()
+        assert not env.kube.get("NodeClaim", nc.name).status_condition_is_true(COND_DRIFTED)
+        # nodepool template changes → hash controller re-stamps → static drift
+        env.nodepool.spec.template.taints = [Taint(key="new", effect="NoSchedule")]
+        env.kube.apply(env.nodepool)
+        hash_ctrl.reconcile_all()
+        markers.reconcile_all()
+        assert env.kube.get("NodeClaim", nc.name).status_condition_is_true(COND_DRIFTED)
+
+    def test_drift_gate_disabled(self, env):
+        node, nc = env.make_initialized_node()
+        markers = NodeClaimDisruptionController(
+            env.kube, env.provider, env.cluster, clock=env.clock, drift_enabled=False
+        )
+        markers.reconcile_all()
+        assert not env.kube.get("NodeClaim", nc.name).status_condition_is_true(COND_DRIFTED)
+
+
+class TestEmptyNodeConsolidation:
+    def test_empty_nodes_deleted(self, env):
+        for _ in range(3):
+            env.make_initialized_node()
+        executed = env.controller.reconcile()
+        assert executed == "consolidation"
+        # command queued → replacements none → candidates deleted immediately
+        env.controller.queue.reconcile()
+        claims = [c for c in env.kube.list("NodeClaim") if c.metadata.deletion_timestamp is None]
+        assert len(claims) == 0
+
+
+class TestSingleNodeConsolidation:
+    def test_delete_when_pods_fit_elsewhere(self, env):
+        # big node with room + small node whose pod fits on the big one
+        big, _ = env.make_initialized_node("fake-it-9")
+        small, _ = env.make_initialized_node("fake-it-0", pods=[running_pod()])
+        executed = env.controller.reconcile()
+        assert executed == "consolidation"
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert len(marked) >= 1
+
+
+class TestMultiNodeConsolidation:
+    def test_underutilized_nodes_repacked(self, env):
+        # several barely-used mid-size nodes; pods all fit on one
+        for _ in range(4):
+            env.make_initialized_node("fake-it-4", pods=[running_pod()])
+        executed = env.controller.reconcile()
+        assert executed == "consolidation"
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert len(marked) >= 2
+
+    def test_tpu_screen_prefix(self, env):
+        candidates = []
+        for _ in range(4):
+            env.make_initialized_node("fake-it-4", pods=[running_pod()])
+        cands = get_candidates(
+            env.cluster, env.kube, env.recorder, env.clock, env.provider,
+            lambda c: True, env.controller.queue,
+        )
+        cands.sort(key=lambda c: c.disruption_cost)
+        k = screen_prefixes(env.controller.ctx, cands)
+        assert 2 <= k <= 4
+
+
+class TestBlocked:
+    def test_do_not_disrupt_annotation_blocks(self, env):
+        node, nc = env.make_initialized_node(pods=[running_pod()])
+        node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.kube.apply(node)
+        executed = env.controller.reconcile()
+        assert executed is None
+
+    def test_do_not_disrupt_pod_blocks(self, env):
+        pod = running_pod()
+        pod.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        env.make_initialized_node(pods=[pod])
+        executed = env.controller.reconcile()
+        assert executed is None
+
+    def test_pdb_blocks(self, env):
+        pod = running_pod(labels={"app": "guarded"})
+        env.make_initialized_node(pods=[pod])
+        pdb = PodDisruptionBudget(selector=LabelSelector(match_labels={"app": "guarded"}))
+        pdb.metadata.name = "guard"
+        pdb.disruptions_allowed = 0
+        env.kube.create(pdb)
+        executed = env.controller.reconcile()
+        assert executed is None
+
+    def test_nominated_node_not_candidate(self, env):
+        node, nc = env.make_initialized_node()
+        env.cluster.nominate_node_for_pod(node.spec.provider_id)
+        executed = env.controller.reconcile()
+        assert executed is None
+
+
+class TestExpirationDisruption:
+    def test_expired_node_replaced(self, env):
+        env.nodepool.spec.disruption.expire_after = 3600.0
+        env.kube.apply(env.nodepool)
+        node, nc = env.make_initialized_node(pods=[running_pod()])
+        env.now += 3700
+        NodeClaimDisruptionController(env.kube, env.provider, env.cluster, clock=env.clock).reconcile_all()
+        executed = env.controller.reconcile()
+        assert executed == "expiration"
+        # replacement claim created for displaced pod
+        new_claims = [
+            c for c in env.kube.list("NodeClaim") if not c.status_condition_is_true(COND_INITIALIZED)
+        ]
+        assert len(new_claims) == 1
+
+
+class TestOrchestration:
+    def test_waits_for_replacement_then_deletes(self, env):
+        env.nodepool.spec.disruption.expire_after = 3600.0
+        env.kube.apply(env.nodepool)
+        node, nc = env.make_initialized_node(pods=[running_pod()])
+        env.now += 3700
+        NodeClaimDisruptionController(env.kube, env.provider, env.cluster, clock=env.clock).reconcile_all()
+        env.controller.reconcile()
+        # replacement exists but not initialized → candidate survives
+        env.controller.queue.reconcile()
+        assert env.kube.get("NodeClaim", nc.name).metadata.deletion_timestamp is None
+        # initialize the replacement
+        for c in env.kube.list("NodeClaim"):
+            if c.name != nc.name:
+                for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+                    c.set_condition(cond, "True")
+                env.kube.apply(c)
+        env.controller.queue.reconcile()
+        gone = env.kube.get("NodeClaim", nc.name)
+        assert gone is None or gone.metadata.deletion_timestamp is not None
+
+    def test_timeout_unwinds(self, env):
+        env.nodepool.spec.disruption.expire_after = 3600.0
+        env.kube.apply(env.nodepool)
+        node, nc = env.make_initialized_node(pods=[running_pod()])
+        env.now += 3700
+        NodeClaimDisruptionController(env.kube, env.provider, env.cluster, clock=env.clock).reconcile_all()
+        env.controller.reconcile()
+        pid = node.spec.provider_id
+        assert any(n.marked_for_deletion for n in env.cluster.deep_copy_nodes() if n.provider_id() == pid)
+        env.now += 11 * 60  # past the 10 min orchestration timeout
+        env.controller.queue.reconcile()
+        state = [n for n in env.cluster.deep_copy_nodes() if n.provider_id() == pid][0]
+        assert not state.marked_for_deletion
+        node = env.kube.get("Node", node.name)
+        assert not any(t.key == wk.DISRUPTION_TAINT_KEY for t in node.spec.taints)

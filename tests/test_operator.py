@@ -1,0 +1,121 @@
+"""Operator composition + metrics + options tests."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from kubelet_sim import bind_pods_to_node, join_node_for_claim
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.apis.nodeclaim import COND_INITIALIZED
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.metrics import Metrics, Registry
+from karpenter_core_tpu.operator import Operator, Options
+from karpenter_core_tpu.operator.options import FeatureGates
+
+
+class TestOptions:
+    def test_defaults(self):
+        opts = Options()
+        assert opts.batch_idle_duration == 1.0
+        assert opts.batch_max_duration == 10.0
+        assert opts.feature_gates.drift is True
+
+    def test_feature_gate_parse(self):
+        assert FeatureGates.parse("Drift=false").drift is False
+        assert FeatureGates.parse("Drift=true").drift is True
+        assert FeatureGates.parse("").drift is True
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("BATCH_IDLE_DURATION", "2.5")
+        monkeypatch.setenv("FEATURE_GATES", "Drift=false")
+        opts = Options.from_env()
+        assert opts.batch_idle_duration == 2.5
+        assert opts.feature_gates.drift is False
+
+    def test_args_override(self):
+        opts = Options.from_args(["--batch-max-duration", "20", "--log-level", "debug"])
+        assert opts.batch_max_duration == 20.0
+        assert opts.log_level == "debug"
+
+
+class TestMetrics:
+    def test_counter_and_exposition(self):
+        m = Metrics()
+        m.nodeclaims_created.inc(reason="provisioning", nodepool="default")
+        m.nodeclaims_created.inc(reason="provisioning", nodepool="default")
+        text = m.registry.expose()
+        assert 'karpenter_nodeclaims_created{nodepool="default",reason="provisioning"} 2.0' in text
+
+    def test_histogram_observe(self):
+        m = Metrics()
+        m.scheduling_duration.observe(0.05)
+        text = m.registry.expose()
+        assert "karpenter_provisioner_scheduling_duration_seconds_count 1" in text
+
+    def test_histogram_timer(self):
+        m = Metrics()
+        with m.simulation_duration.time():
+            pass
+        assert m.simulation_duration.totals[()] == 1
+
+
+class TestOperator:
+    def test_full_loop_via_operator(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        op = Operator(provider, options=Options(use_tpu_solver=False))
+        op.informers.start()
+        op._started = True
+        op.kube_client.create(make_nodepool())
+        for _ in range(3):
+            op.kube_client.create(make_pod(requests={"cpu": "1"}))
+
+        # drive synchronously: provision → launch
+        op.provisioner.reconcile()
+        op.nodeclaim_lifecycle.reconcile_all()
+        claims = op.kube_client.list("NodeClaim")
+        assert claims and all(c.status.provider_id for c in claims)
+
+        # kubelet joins, then the next pass initializes
+        for c in claims:
+            join_node_for_claim(op.kube_client, c)
+        op.nodeclaim_lifecycle.reconcile_all()
+        assert all(
+            c.status_condition_is_true(COND_INITIALIZED)
+            for c in op.kube_client.list("NodeClaim")
+        )
+        # metrics got recorded through the decorator + counters
+        assert op.metrics.nodeclaims_created.get(reason="provisioning", nodepool="default") >= 1
+        assert op.metrics.cloudprovider_duration.totals  # decorator observed calls
+        op.metrics_store.scrape_nodes(op.cluster)
+        assert "karpenter_nodes_allocatable" in op.metrics_text()
+        op.stop()
+
+    def test_singleton_error_backoff(self):
+        from karpenter_core_tpu.operator.controller import SingletonController
+
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise RuntimeError("boom")
+
+        m = Metrics()
+        c = SingletonController("test", failing, metrics=m)
+        d1 = c.reconcile_once()
+        d2 = c.reconcile_once()
+        assert d2 > d1  # exponential backoff
+        assert m.reconcile_errors.get(controller="test") == 2
+
+    def test_health_reflects_sync(self):
+        provider = FakeCloudProvider()
+        op = Operator(provider)
+        op.informers.start()
+        op._started = True
+        assert op.healthy()
+        from karpenter_core_tpu.apis.nodeclaim import NodeClaim
+
+        nc = NodeClaim()
+        nc.metadata.name = "unsynced"
+        op.kube_client.create(nc)
+        assert not op.healthy()  # claim without provider id
+        op.stop()
