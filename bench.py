@@ -40,8 +40,9 @@ def main() -> None:
     from karpenter_core_tpu.kube.quantity import parse_quantity
     from karpenter_core_tpu.solver import TPUScheduler
 
-    N_PODS = int(os.environ.get("BENCH_PODS", "10000"))
-    N_TYPES = int(os.environ.get("BENCH_TYPES", "400"))
+    # default grid = the BASELINE.json north-star config (50k × 2k)
+    N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
+    N_TYPES = int(os.environ.get("BENCH_TYPES", "2000"))
     rng = np.random.RandomState(42)
 
     def make_pod(i: int, topo: bool) -> Pod:

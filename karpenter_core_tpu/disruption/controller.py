@@ -130,7 +130,13 @@ class DisruptionController:
                 cmd.replacements, LaunchOptions(reason=method.type_name)
             )
             if errs:
-                # roll back the cordon and abort (controller.go:189-199)
+                # roll back: un-cordon AND delete any partially created
+                # replacements so an aborted command leaks no capacity
+                # (controller.go:189-199)
+                for name in replacement_names:
+                    nc = self.kube_client.get("NodeClaim", name)
+                    if nc is not None:
+                        self.kube_client.delete(nc)
                 for c in cmd.candidates:
                     node = self.kube_client.get("Node", c.name())
                     if node is not None:

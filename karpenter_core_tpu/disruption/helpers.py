@@ -27,10 +27,12 @@ class PDBLimits:
         self.pdbs = kube_client.list("PodDisruptionBudget")
 
     def can_evict_pods(self, pods: List[Pod]) -> Tuple[str, bool]:
+        from ..lifecycle.node_termination import pdb_disruptions_allowed
+
         for pod in pods:
             for pdb in self.pdbs:
                 if pdb.namespace == pod.namespace and pdb.selector.matches(pod.metadata.labels):
-                    if pdb.disruptions_allowed < 1:
+                    if pdb_disruptions_allowed(self.kube_client, pdb) < 1:
                         return f"{pdb.namespace}/{pdb.name}", False
         return "", True
 

@@ -40,7 +40,7 @@ class NodeClaimDisruptionController:
         self.clock = clock
         self.drift_enabled = drift_enabled
 
-    def reconcile(self, node_claim: NodeClaim) -> None:
+    def reconcile(self, node_claim: NodeClaim, _index: Optional[dict] = None) -> None:
         if node_claim.metadata.deletion_timestamp is not None:
             return
         nodepool = self.kube_client.get(
@@ -48,14 +48,33 @@ class NodeClaimDisruptionController:
         )
         if nodepool is None:
             return
+        before = self._conditions_snapshot(node_claim)
         self._drift(nodepool, node_claim)
         self._expiration(nodepool, node_claim)
-        self._emptiness(nodepool, node_claim)
-        self.kube_client.apply(node_claim)
+        self._emptiness(nodepool, node_claim, _index)
+        # only write back (and fire watch events) on an actual change
+        if self._conditions_snapshot(node_claim) != before:
+            self.kube_client.apply(node_claim)
+
+    @staticmethod
+    def _conditions_snapshot(nc: NodeClaim) -> tuple:
+        return tuple(
+            sorted((c.type, c.status, c.reason) for c in nc.status.conditions)
+        )
 
     def reconcile_all(self) -> None:
+        # one sweep-level index: pods by node name + nodes by provider id,
+        # instead of O(claims × cluster) re-listing
+        pods_by_node: dict = {}
+        for p in self.kube_client.list("Pod"):
+            if p.spec.node_name:
+                pods_by_node.setdefault(p.spec.node_name, []).append(p)
+        nodes_by_pid = {
+            n.spec.provider_id: n for n in self.kube_client.list("Node") if n.spec.provider_id
+        }
+        index = {"pods_by_node": pods_by_node, "nodes_by_pid": nodes_by_pid}
         for nc in self.kube_client.list("NodeClaim"):
-            self.reconcile(nc)
+            self.reconcile(nc, index)
 
     # -- drift (drift.go:49-140) -------------------------------------------
 
@@ -118,7 +137,7 @@ class NodeClaimDisruptionController:
 
     # -- emptiness (emptiness.go:46-90) ------------------------------------
 
-    def _emptiness(self, nodepool: NodePool, nc: NodeClaim) -> None:
+    def _emptiness(self, nodepool: NodePool, nc: NodeClaim, index: Optional[dict] = None) -> None:
         d = nodepool.spec.disruption
         if d.consolidation_policy != CONSOLIDATION_POLICY_WHEN_EMPTY or d.consolidate_after is None:
             nc.clear_condition(COND_EMPTY)
@@ -126,27 +145,33 @@ class NodeClaimDisruptionController:
         if not nc.status_condition_is_true(COND_INITIALIZED):
             nc.clear_condition(COND_EMPTY)
             return
-        node = self._node_for(nc)
+        node = self._node_for(nc, index)
         if node is None:
             nc.clear_condition(COND_EMPTY)
             return
         if self.cluster is not None and self.cluster.is_node_nominated(node.spec.provider_id):
             nc.clear_condition(COND_EMPTY)
             return
+        if index is not None:
+            node_pods = index["pods_by_node"].get(node.name, [])
+        else:
+            node_pods = [p for p in self.kube_client.list("Pod") if p.spec.node_name == node.name]
         pods = [
             p
-            for p in self.kube_client.list("Pod")
-            if p.spec.node_name == node.name
-            and not podutils.is_owned_by_daemonset(p)
-            and not podutils.is_terminal(p)
+            for p in node_pods
+            if not podutils.is_owned_by_daemonset(p) and not podutils.is_terminal(p)
         ]
         if pods:
             nc.clear_condition(COND_EMPTY)
         else:
             nc.set_condition(COND_EMPTY, "True")
 
-    def _node_for(self, nc: NodeClaim):
+    def _node_for(self, nc: NodeClaim, index: Optional[dict] = None):
+        if not nc.status.provider_id:
+            return None
+        if index is not None:
+            return index["nodes_by_pid"].get(nc.status.provider_id)
         for n in self.kube_client.list("Node"):
-            if nc.status.provider_id and n.spec.provider_id == nc.status.provider_id:
+            if n.spec.provider_id == nc.status.provider_id:
                 return n
         return None

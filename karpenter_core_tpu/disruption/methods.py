@@ -246,16 +246,17 @@ class MultiNodeConsolidation(ConsolidationBase):
         if order is None:
             return self._binary_search(candidates, max_n, deadline)
 
-        last = Command()
+        attempted_min = order[0]
         for k in order[:4]:  # bounded verification attempts
             if self.ctx.clock() > deadline:
                 break
             cmd = self._attempt(candidates[:k])
             if cmd is not None:
                 return cmd
-        # screen over-estimated; fall back to binary search below the
-        # screened sizes
-        return self._binary_search(candidates, min(max_n, (order[-1] if order else max_n)), deadline)
+            attempted_min = k
+        # screen over-estimated; binary search the untried sizes below the
+        # smallest prefix we actually attempted
+        return self._binary_search(candidates, min(max_n, attempted_min - 1), deadline)
 
     def _attempt(self, prefix: List[Candidate]) -> Optional[Command]:
         cmd = self.compute_consolidation(prefix)

@@ -45,11 +45,18 @@ def prefix_screen_kernel(
     the orphans must fit into the remaining fleet's free space — which
     includes the free space of the *not-removed* candidates — plus at
     most one replacement node."""
-    cum_load = jnp.cumsum(candidate_loads, axis=0)  # (N, R)
-    total_candidate_free = jnp.sum(candidate_free, axis=0)
-    cum_candidate_free = jnp.cumsum(candidate_free, axis=0)
-    surviving_candidate_free = total_candidate_free[None, :] - cum_candidate_free
-    headroom = fleet_free[None, :] + surviving_candidate_free + new_node_cap[None, :]
+    # float32 accumulators: int32 would overflow summing up to 100
+    # candidates of ~2^30 quantized units; the screen is a heuristic
+    # (verified by simulation after) so f32 precision is ample
+    loads = candidate_loads.astype(jnp.float32)
+    free = candidate_free.astype(jnp.float32)
+    cum_load = jnp.cumsum(loads, axis=0)  # (N, R)
+    surviving_candidate_free = jnp.sum(free, axis=0)[None, :] - jnp.cumsum(free, axis=0)
+    headroom = (
+        fleet_free.astype(jnp.float32)[None, :]
+        + surviving_candidate_free
+        + new_node_cap.astype(jnp.float32)[None, :]
+    )
     return jnp.all(cum_load <= headroom, axis=-1)
 
 

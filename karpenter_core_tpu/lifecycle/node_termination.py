@@ -18,6 +18,29 @@ class NodeDrainError(Exception):
     pass
 
 
+def pdb_disruptions_allowed(kube_client, pdb) -> int:
+    """Dynamic budget, like the real PDB controller: recomputed from the
+    current health of matching pods so evictions consume it and healthy
+    replacements replenish it. Falls back to the static field when neither
+    minAvailable nor maxUnavailable is set."""
+    matching = [
+        p
+        for p in kube_client.list("Pod", namespace=pdb.namespace)
+        if pdb.selector.matches(p.metadata.labels)
+    ]
+    healthy = sum(
+        1
+        for p in matching
+        if p.status.phase == "Running" and p.metadata.deletion_timestamp is None
+    )
+    if pdb.min_available is not None:
+        return healthy - pdb.min_available
+    if pdb.max_unavailable is not None:
+        unavailable = len(matching) - healthy
+        return pdb.max_unavailable - unavailable
+    return pdb.disruptions_allowed
+
+
 class EvictionQueue:
     """Rate-limited eviction queue honoring PDBs (ref
     terminator/eviction.go:65-150). Our in-memory PDB model exposes
@@ -41,18 +64,10 @@ class EvictionQueue:
         do-not-disrupt is NOT honored here: it gates voluntary disruption
         candidacy (disruption engine), not the termination drain — refusing
         would deadlock node finalization (ref terminator/eviction.go)."""
-        matched = [
-            pdb
-            for pdb in self.kube_client.list("PodDisruptionBudget", namespace=pod.namespace)
-            if pdb.selector.matches(pod.metadata.labels)
-        ]
-        if any(pdb.disruptions_allowed <= 0 for pdb in matched):
-            return False  # the PDB 429 path
-        # consume the budget like the eviction API does; the (simulated)
-        # disruption controller replenishes it as replacements go healthy
-        for pdb in matched:
-            pdb.disruptions_allowed -= 1
-            self.kube_client.apply(pdb)
+        for pdb in self.kube_client.list("PodDisruptionBudget", namespace=pod.namespace):
+            if pdb.selector.matches(pod.metadata.labels):
+                if pdb_disruptions_allowed(self.kube_client, pdb) <= 0:
+                    return False  # the PDB 429 path
         self.kube_client.delete(pod)
         if self.recorder is not None:
             from ..events import events as ev

@@ -39,7 +39,9 @@ class Counter:
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self.values.items()):
+        with self._mu:
+            items = sorted(self.values.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
@@ -65,7 +67,9 @@ class Gauge:
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self.values.items()):
+        with self._mu:
+            items = sorted(self.values.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
@@ -111,14 +115,17 @@ class Histogram:
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key in sorted(self.counts):
-            cumulative = 0
+        with self._mu:
+            snapshot = sorted(self.counts)
+            counts = {k: list(v) for k, v in self.counts.items()}
+            sums = dict(self.sums)
+            totals = dict(self.totals)
+        for key in snapshot:
             for i, b in enumerate(self.buckets):
-                cumulative = self.counts[key][i]
-                out.append(f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {cumulative}')
-            out.append(f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {self.totals[key]}')
-            out.append(f"{self.name}_sum{_fmt_labels(key)} {self.sums[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(key)} {self.totals[key]}")
+                out.append(f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {counts[key][i]}')
+            out.append(f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {totals[key]}')
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {totals[key]}")
         return out
 
 

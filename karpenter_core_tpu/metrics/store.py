@@ -64,6 +64,10 @@ class MetricsStore:
                 self.metrics.nodepool_limit.set(qty / NANO, nodepool=np_.name, resource=res)
             for res, qty in np_.status.resources.items():
                 self.metrics.nodepool_usage.set(qty / NANO, nodepool=np_.name, resource=res)
+        for stale in self._published_pools - seen:
+            for gauge in (self.metrics.nodepool_limit, self.metrics.nodepool_usage):
+                for key in [k for k in gauge.values if ("nodepool", stale) in k]:
+                    gauge.values.pop(key, None)
         self._published_pools = seen
 
     # -- pod scraper (metrics/pod/controller.go:59-71) ---------------------

@@ -81,6 +81,17 @@ def limits_ceiling(pod: Pod) -> ResourceList:
 
 def requests_for_pods(*pods: Pod) -> ResourceList:
     """Total requests incl. an implicit "pods" count (resources.go:27)."""
+    if len(pods) == 1:
+        # hot path: single pod, single plain container (the overwhelmingly
+        # common shape on the 50k-pod solve path)
+        p = pods[0]
+        spec = p.spec
+        if len(spec.containers) == 1 and not spec.init_containers and not spec.overhead:
+            c = spec.containers[0]
+            if not c.resources.limits:
+                merged = dict(c.resources.requests)
+                merged[RESOURCE_PODS] = NANO
+                return merged
     merged = merge(*(ceiling(p) for p in pods))
     merged[RESOURCE_PODS] = len(pods) * NANO
     return merged

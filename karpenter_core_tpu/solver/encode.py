@@ -76,13 +76,37 @@ def build_resource_axis(
     for it in instance_types:
         for k, v in it.capacity.items():
             maxima[ordered.index(k)] = max(maxima[ordered.index(k)], v)
-    divisors = np.ones(len(ordered), dtype=np.int64)
+    # divisors are 10^6 · 2^k (k ≥ 0): the quantized unit is a power-of-two
+    # multiple of 1 milli, so whole-milli requests and capacities quantize
+    # EXACTLY (ceil/floor agree with infinite precision) and exact-fit
+    # packings survive quantization
+    divisors = np.full(len(ordered), 10**6, dtype=np.int64)
     for i, m in enumerate(maxima):
-        d = 1
+        d = 10**6
         while m / d >= 2**30:
             d *= 2
         divisors[i] = d
     return ResourceAxis(ordered, divisors)
+
+
+def build_requests_matrix(all_requests: Sequence[Dict[str, int]], axis: ResourceAxis) -> np.ndarray:
+    """(P, R) int32 ceil-quantized request matrix — one python pass to a
+    milli-unit float64 matrix (exact: values < 2^53), then vectorized
+    power-of-two ceil-division. Sub-milli request precision is floored
+    (harmless: real requests are whole milli-units)."""
+    P = len(all_requests)
+    name_to_idx = {n: i for i, n in enumerate(axis.names)}
+    milli = np.zeros((P, axis.count), dtype=np.float64)
+    for p, requests in enumerate(all_requests):
+        row = milli[p]
+        for k, v in requests.items():
+            i = name_to_idx.get(k)
+            if i is not None:
+                row[i] = -(-v // 10**6)  # ceil: never let a pod look smaller
+    # axis divisors are nano-scale powers of two ≥ 2^20 in the large case;
+    # convert to milli-scale (may drop below 1 → clamp)
+    div = np.maximum(axis.divisors.astype(np.float64) / 10**6, 1.0)
+    return np.ceil(milli / div[None, :]).astype(np.int32)
 
 
 def quantize_requests(requests: Dict[str, int], axis: ResourceAxis) -> np.ndarray:
@@ -232,6 +256,15 @@ def pod_signature(pod: Pod, relevant_label_keys: Optional[Set[str]] = None) -> t
         labels_key = tuple(
             sorted((k, v) for k, v in pod.metadata.labels.items() if k in relevant_label_keys)
         )
+    # fast path: fully unconstrained pod (the common case at 50k scale)
+    spec = pod.spec
+    if (
+        spec.affinity is None
+        and not spec.node_selector
+        and not spec.tolerations
+        and not spec.topology_spread_constraints
+    ):
+        return (pod.namespace, labels_key, (), (), (), (), (), ())
     spreads = tuple(
         (c.topology_key, c.max_skew, c.when_unsatisfiable, _selector_key(c.label_selector), c.min_domains)
         for c in pod.spec.topology_spread_constraints

@@ -28,20 +28,25 @@ INT_INF = np.int32(2**31 - 1)
 
 def pareto_frontier(allocatable: np.ndarray) -> np.ndarray:
     """Maximal points of the viable types' allocatable vectors (F, R).
-    A usage vector fits some type iff it fits some frontier point."""
+    A usage vector fits some type iff it fits some frontier point.
+    Vectorized dominance: one (T, T, R) broadcast instead of a Python
+    pairwise loop."""
     if len(allocatable) == 0:
         return np.zeros((1, allocatable.shape[1] if allocatable.ndim == 2 else 0), dtype=np.int32)
-    pts = np.unique(allocatable, axis=0)
-    keep = []
-    for i, p in enumerate(pts):
-        dominated = False
-        for j, q in enumerate(pts):
-            if i != j and np.all(q >= p) and np.any(q > p):
-                dominated = True
-                break
-        if not dominated:
-            keep.append(p)
-    return np.stack(keep).astype(np.int32)
+    pts = np.unique(allocatable, axis=0)  # unique also sorts — ties deduped
+    # incremental scan sorted by total size desc: each point only needs a
+    # dominance check against the (small) kept frontier, O(T·F·R) instead
+    # of the O(T²·R) pairwise broadcast
+    order = np.argsort(-pts.sum(axis=1, dtype=np.int64))
+    kept: list = []
+    kept_arr = np.zeros((0, pts.shape[1]), dtype=pts.dtype)
+    for i in order:
+        p = pts[i]
+        if len(kept) and bool(np.any(np.all(kept_arr >= p, axis=1))):
+            continue  # dominated (strictness guaranteed: duplicates removed)
+        kept.append(p)
+        kept_arr = np.asarray(kept)
+    return kept_arr.astype(np.int32)
 
 
 @partial(jax.jit, static_argnames=("k_open",))
@@ -112,7 +117,8 @@ def ffd_pack(
             assigned,
         )
 
-    final, node_ids = jax.lax.scan(step, init, requests)
+    # unroll amortizes scan-machinery overhead over 8 tiny steps
+    final, node_ids = jax.lax.scan(step, init, requests, unroll=8)
     return node_ids, final["next_id"]
 
 
@@ -129,6 +135,65 @@ def assign_cheapest_types(
     best = np.argmin(priced, axis=1).astype(np.int32)
     best[~fits.any(axis=1)] = -1
     return best
+
+
+@partial(jax.jit, static_argnames=("k_open",))
+def ffd_pack_batched(
+    requests: jnp.ndarray,  # (G, P, R)
+    frontiers: jnp.ndarray,  # (G, F, R)
+    max_pods: jnp.ndarray,  # (G,)
+    k_open: int = 16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All groups' packs in one dispatch (one device sync per solve
+    instead of one per group)."""
+    return jax.vmap(lambda r, f, c: ffd_pack(r, f, c, k_open=k_open))(
+        requests, frontiers, max_pods
+    )
+
+
+def _pad_class(p: int) -> int:
+    """Scan-length size classes: powers of two up to 4096, then 4096
+    multiples — a small job must never inherit the biggest job's scan
+    length (scan cost is the padded length, vmap lanes are free)."""
+    if p <= 4096:
+        return max(128, 1 << (p - 1).bit_length())
+    return -(-p // 4096) * 4096
+
+
+def batch_pack(jobs: list) -> list:
+    """Run many (requests, frontier, max_per_node) packs as few padded,
+    vmapped device calls (one per size class). Each job's padding pods
+    exceed its own frontier max so they emit -1 without touching state.
+    Returns [(node_ids, node_count)] aligned with jobs."""
+    if not jobs:
+        return []
+    R = jobs[0][0].shape[1]
+    F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
+    classes: dict = {}
+    for g, job in enumerate(jobs):
+        classes.setdefault(_pad_class(job[0].shape[0]), []).append(g)
+
+    results: list = [None] * len(jobs)
+    for p_pad, members in classes.items():
+        G = len(members)
+        requests = np.zeros((G, p_pad, R), dtype=np.int32)
+        frontiers = np.zeros((G, F_pad, R), dtype=np.int32)
+        caps = np.zeros(G, dtype=np.int32)
+        for slot, g in enumerate(members):
+            reqs, frontier, cap = jobs[g]
+            fmax = frontier.max(axis=0)
+            requests[slot, :, :] = fmax + 1  # sentinel: unschedulable padding
+            requests[slot, : reqs.shape[0]] = reqs
+            frontiers[slot, : len(frontier)] = frontier
+            caps[slot] = cap
+        node_ids, counts = ffd_pack_batched(
+            jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
+        )
+        node_ids = np.asarray(node_ids)
+        counts = np.asarray(counts)
+        for slot, g in enumerate(members):
+            results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
+    return results
 
 
 def pad_for_pack(requests: np.ndarray, frontier: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:

@@ -33,6 +33,7 @@ from .encode import (
     EncodedInstanceTypes,
     PoolEncoding,
     SignatureGroup,
+    build_requests_matrix,
     build_resource_axis,
     encode_instance_types,
     encode_signature_for_pool,
@@ -43,9 +44,8 @@ from .encode import (
 from .kernels import build_compat_inputs, compat_kernel, offering_kernel, zone_ct_masks
 from .pack import (
     assign_cheapest_types,
-    ffd_pack,
+    batch_pack,
     node_usage_from_assignment,
-    pad_for_pack,
     pareto_frontier,
 )
 from .vocab import Vocab
@@ -115,6 +115,7 @@ class TPUScheduler:
         daemonset_pods: Optional[List[Pod]] = None,
     ) -> SolverResult:
         result = SolverResult()
+        self._frontier_cache: Dict[tuple, np.ndarray] = {}
         groups = group_pods(pods)
         relational = [g for g in groups if g.has_relational]
         tensor_groups = [g for g in groups if not g.has_relational]
@@ -209,8 +210,9 @@ class TPUScheduler:
             return
 
         all_requests = [resources.requests_for_pods(p) for p in pods]
+        self._all_requests = all_requests  # reused in finalize for NodePlan.requests
         axis = build_resource_axis(all_requests, [it for cat in pool_catalogs for it in cat])
-        requests_matrix = np.stack([quantize_requests(r, axis) for r in all_requests])
+        requests_matrix = build_requests_matrix(all_requests, axis)
 
         # daemonset overhead per pool, added to every planned node's load
         from ..scheduling.requirements import pod_requirements as _pod_reqs
@@ -264,37 +266,45 @@ class TPUScheduler:
             offering = np.asarray(offering_kernel(zone_ok, ct_ok, enc.offering_avail))
             allowed_per_pool.append((compat & offering, zone_ok, ct_ok))
 
-        # --- pack group by group ---------------------------------------
+        # --- pack: prepare every group/zone job, ONE batched device call,
+        # then finalize (single dispatch + single host sync per solve)
+        jobs: List[tuple] = []
+        metas: List[dict] = []
         for gi, group in enumerate(groups):
-            self._pack_group(
+            self._prepare_group_jobs(
                 gi,
                 group,
                 pods,
                 requests_matrix,
-                axis,
                 pools,
                 encoded,
                 sig_compats,
                 allowed_per_pool,
                 daemon_requests,
                 result,
+                jobs,
+                metas,
             )
+        packed = batch_pack(jobs)
+        for meta, (node_ids, node_count) in zip(metas, packed):
+            self._finalize_job(meta, node_ids, node_count, pods, result)
 
     # ------------------------------------------------------------------
 
-    def _pack_group(
+    def _prepare_group_jobs(
         self,
         gi: int,
         group: SignatureGroup,
         pods: List[Pod],
         requests_matrix: np.ndarray,
-        axis,
         pools: List[PoolEncoding],
         encoded: List[EncodedInstanceTypes],
         sig_compats,
         allowed_per_pool,
         daemon_requests,
         result: SolverResult,
+        jobs: List[tuple],
+        metas: List[dict],
     ) -> None:
         # first pool (weight order) whose template accepts the signature and
         # offers at least one viable type (scheduler.go:256-283)
@@ -353,18 +363,19 @@ class TPUScheduler:
             for z in zones:
                 if buckets[z]:
                     sel = np.array(buckets[z])
-                    self._pack_into_nodes(
+                    self._prepare_job(
                         idx[sel], reqs[sel], enc, zone_types[z], zone_ok, ct_ok, daemon,
-                        max_per_node, pool, pods, result, zone=z,
+                        max_per_node, pool, pods, result, jobs, metas, zone=z,
                     )
         else:
-            self._pack_into_nodes(
-                idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node, pool, pods, result
+            self._prepare_job(
+                idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node, pool,
+                pods, result, jobs, metas,
             )
 
     # ------------------------------------------------------------------
 
-    def _pack_into_nodes(
+    def _prepare_job(
         self,
         idx: np.ndarray,
         reqs: np.ndarray,
@@ -377,6 +388,8 @@ class TPUScheduler:
         pool: PoolEncoding,
         pods: List[Pod],
         result: SolverResult,
+        jobs: List[tuple],
+        metas: List[dict],
         zone: Optional[str] = None,
     ) -> None:
         viable_idx = np.flatnonzero(viable)
@@ -386,12 +399,33 @@ class TPUScheduler:
             return
         alloc = enc.allocatable[viable_idx] - daemon[None, :]  # daemon overhead off the top
         alloc = np.maximum(alloc, 0)
-        frontier = pareto_frontier(alloc)
+        # zone buckets of one group share viable sets — cache the frontier
+        cache_key = (id(enc), viable_idx.tobytes(), daemon.tobytes())
+        frontier = self._frontier_cache.get(cache_key)
+        if frontier is None:
+            frontier = pareto_frontier(alloc)
+            self._frontier_cache[cache_key] = frontier
+        jobs.append((reqs, frontier, np.int32(max_per_node)))
+        metas.append(
+            dict(
+                idx=idx,
+                reqs=reqs,
+                enc=enc,
+                viable_idx=viable_idx,
+                alloc=alloc,
+                zone_ok=zone_ok,
+                ct_ok=ct_ok,
+                pool=pool,
+                zone=zone,
+            )
+        )
 
-        padded_reqs, padded_frontier, true_p = pad_for_pack(reqs, frontier)
-        node_ids, node_count = ffd_pack(padded_reqs, padded_frontier, np.int32(max_per_node))
-        node_ids = np.asarray(node_ids)[:true_p]
-        node_count = int(node_count)
+    def _finalize_job(
+        self, meta: dict, node_ids: np.ndarray, node_count: int, pods: List[Pod], result: SolverResult
+    ) -> None:
+        idx, reqs, enc = meta["idx"], meta["reqs"], meta["enc"]
+        viable_idx, alloc = meta["viable_idx"], meta["alloc"]
+        zone_ok, ct_ok, pool, zone = meta["zone_ok"], meta["ct_ok"], meta["pool"], meta["zone"]
 
         unsched = node_ids < 0
         for i in idx[unsched]:
@@ -437,7 +471,7 @@ class TPUScheduler:
                     capacity_type=offering_ct,
                     price=offering_price,
                     pod_indices=members,
-                    requests=resources.requests_for_pods(*(pods[i] for i in members)),
+                    requests=resources.merge(*(self._all_requests[i] for i in members)),
                 )
             )
 

@@ -238,3 +238,15 @@ class TestRegressions:
         tpu = tpu_solve(pods, [make_nodepool()], provider)
         assert len(oracle.new_node_claims) == 1
         assert len(tpu.node_plans) == 1
+
+    def test_exact_fit_survives_quantization(self):
+        """Whole-milli exact-fit packings must not be broken by the solver's
+        int32 quantization (divisors are 10^6·2^k for exactness)."""
+        provider = FakeCloudProvider()
+        provider.instance_types = [
+            new_instance_type("exact", {"cpu": "4.1", "memory": "16Gi", "pods": 4})
+        ]
+        pods = [make_pod(requests={"cpu": "2"}) for _ in range(4)]
+        tpu = tpu_solve(pods, [make_nodepool()], provider)
+        assert len(tpu.node_plans) == 2
+        assert sorted(len(p.pod_indices) for p in tpu.node_plans) == [2, 2]
