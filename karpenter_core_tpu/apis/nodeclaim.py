@@ -43,6 +43,13 @@ class KubeletConfiguration:
     kube_reserved: ResourceList = field(default_factory=dict)
     eviction_hard: Dict[str, str] = field(default_factory=dict)
     eviction_soft: Dict[str, str] = field(default_factory=dict)
+    # seconds per eviction signal (nodeclaim.go:110, metav1.Duration map)
+    eviction_soft_grace_period: Dict[str, float] = field(default_factory=dict)
+    eviction_max_pod_grace_period: Optional[int] = None
+    image_gc_high_threshold_percent: Optional[int] = None  # nodeclaim.go:119-124
+    image_gc_low_threshold_percent: Optional[int] = None
+    cpu_cfs_quota: Optional[bool] = None  # nodeclaim.go:129-131
+    cluster_dns: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -42,6 +42,10 @@ class KubeClient:
         self._lock = threading.RLock()
         self._rv = 0
         self.clock = clock
+        # admission chain (defaulting + validating webhooks / CEL equivalent,
+        # ref pkg/webhooks/webhooks.go:57-87): callables run on create/update
+        # before the object is stored; they may mutate (defaults) or raise.
+        self.admission: List[Callable[[KubeObject], None]] = []
 
     # -- helpers -----------------------------------------------------------
 
@@ -56,6 +60,8 @@ class KubeClient:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: KubeObject) -> KubeObject:
+        for adm in self.admission:
+            adm(obj)
         with self._lock:
             kind = obj.kind
             key = self._key(obj)
@@ -89,6 +95,8 @@ class KubeClient:
         return objs
 
     def update(self, obj: KubeObject) -> KubeObject:
+        for adm in self.admission:
+            adm(obj)
         with self._lock:
             kind = obj.kind
             key = self._key(obj)

@@ -214,6 +214,7 @@ class Metrics:
         self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])
         self.node_pod_limits = r.gauge(f"{ns}_nodes_total_pod_limits", "Node pod limits", ["node", "resource"])
         self.node_daemon_requests = r.gauge(f"{ns}_nodes_total_daemon_requests", "Node daemon requests", ["node", "resource"])
+        self.node_daemon_limits = r.gauge(f"{ns}_nodes_total_daemon_limits", "Node daemon limits", ["node", "resource"])
         self.node_system_overhead = r.gauge(f"{ns}_nodes_system_overhead", "Node system overhead", ["node", "resource"])
         self.nodepool_limit = r.gauge(f"{ns}_nodepool_limit", "NodePool limit", ["nodepool", "resource"])
         self.nodepool_usage = r.gauge(f"{ns}_nodepool_usage", "NodePool usage", ["nodepool", "resource"])

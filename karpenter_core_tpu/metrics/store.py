@@ -19,6 +19,7 @@ class MetricsStore:
         self._published_nodes: Set[str] = set()
         self._published_pools: Set[str] = set()
         self._published_pods: Set[tuple] = set()
+        self._startup_observed: Set[tuple] = set()
 
     # -- node scraper (metrics/node/controller.go:48-96) -------------------
 
@@ -36,6 +37,8 @@ class MetricsStore:
                 self.metrics.node_pod_limits.set(qty / NANO, node=name, resource=res)
             for res, qty in sn.daemonset_request_total().items():
                 self.metrics.node_daemon_requests.set(qty / NANO, node=name, resource=res)
+            for res, qty in sn.daemonset_limit_total().items():
+                self.metrics.node_daemon_limits.set(qty / NANO, node=name, resource=res)
             overhead = resources.subtract(sn.capacity(), sn.allocatable())
             for res, qty in overhead.items():
                 self.metrics.node_system_overhead.set(qty / NANO, node=name, resource=res)
@@ -48,6 +51,7 @@ class MetricsStore:
                 self.metrics.node_pod_requests,
                 self.metrics.node_pod_limits,
                 self.metrics.node_daemon_requests,
+                self.metrics.node_daemon_limits,
                 self.metrics.node_system_overhead,
             ):
                 for key in [k for k in gauge.values if ("node", stale) in k]:
@@ -80,6 +84,17 @@ class MetricsStore:
             self.metrics.pod_state.set(
                 1.0, name=pod.name, namespace=pod.namespace, phase=pod.status.phase
             )
+            # startup = creation → Running, observed once per pod
+            # (metrics/pod/controller.go:63-71 pod_startup_time_seconds)
+            if (
+                pod.status.phase == "Running"
+                and pod.status.start_time is not None
+                and key not in self._startup_observed
+            ):
+                self._startup_observed.add(key)
+                self.metrics.pod_startup_time.observe(
+                    max(0.0, pod.status.start_time - pod.metadata.creation_timestamp)
+                )
         for stale in self._published_pods - seen:
             for k in [
                 k
@@ -88,3 +103,6 @@ class MetricsStore:
             ]:
                 self.metrics.pod_state.values.pop(k, None)
         self._published_pods = seen
+        # prune so deleted pods don't leak, and a recreated same-name pod
+        # gets its startup observed again
+        self._startup_observed &= seen

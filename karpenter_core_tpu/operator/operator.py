@@ -8,6 +8,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..apis.validation import install_admission
 from ..cloudprovider.metrics import MetricsDecorator
 from ..disruption import DisruptionController, NodeClaimDisruptionController, OrchestrationQueue
 from ..events import Recorder
@@ -47,6 +48,8 @@ class Operator:
         self.options = options or Options.from_env()
         self.logger = new_logger(self.options.log_level)
         self.kube_client = kube_client or KubeClient(clock=clock)
+        if not self.options.disable_webhook:
+            install_admission(self.kube_client)
         self.registry = Registry()
         self.metrics = Metrics(self.registry)
         self.cloud_provider = MetricsDecorator(cloud_provider, self.metrics)

@@ -58,6 +58,10 @@ class Options:
     batch_max_duration: float = 10.0  # options.go:96
     batch_idle_duration: float = 1.0  # options.go:97
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    # options.go:84 DISABLE_WEBHOOK — our admission chain (defaults +
+    # validation) replaces the knative webhook; enabled by default since
+    # there is no CEL layer in-process to fall back on.
+    disable_webhook: bool = False
     # TPU-native knobs
     use_tpu_solver: bool = True
     tpu_consolidation_screen: bool = True
@@ -76,6 +80,7 @@ class Options:
         opts.batch_max_duration = _env("BATCH_MAX_DURATION", opts.batch_max_duration)
         opts.batch_idle_duration = _env("BATCH_IDLE_DURATION", opts.batch_idle_duration)
         opts.feature_gates = FeatureGates.parse(_env("FEATURE_GATES", ""))
+        opts.disable_webhook = _env("DISABLE_WEBHOOK", opts.disable_webhook)
         opts.use_tpu_solver = _env("USE_TPU_SOLVER", opts.use_tpu_solver)
         opts.tpu_consolidation_screen = _env("TPU_CONSOLIDATION_SCREEN", opts.tpu_consolidation_screen)
         return opts

@@ -1,0 +1,306 @@
+"""Admission validation tests, modeled on the reference's CEL/webhook suites
+(ref pkg/apis/v1beta1/nodepool_validation_cel_test.go,
+nodeclaim_validation_cel_test.go)."""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as lbl
+from karpenter_core_tpu.apis.nodeclaim import (
+    KubeletConfiguration,
+    NodeClaim,
+    NodeClaimSpec,
+)
+from karpenter_core_tpu.apis.nodepool import (
+    Budget,
+    Disruption,
+    NodeClaimTemplateSpec,
+    NodePool,
+    NodePoolSpec,
+)
+from karpenter_core_tpu.apis.validation import (
+    ValidationError,
+    install_admission,
+    set_defaults,
+    validate_budget,
+    validate_disruption,
+    validate_kubelet,
+    validate_nodeclaim,
+    validate_nodepool,
+    validate_requirement,
+    validate_taints,
+)
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import (
+    NodeSelectorRequirement as Req,
+    ObjectMeta,
+    Taint,
+)
+
+
+def nodepool(**spec_kwargs) -> NodePool:
+    return NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(**spec_kwargs),
+    )
+
+
+# ---------------------------------------------------------------------------
+# requirements (nodeclaim_validation_cel_test.go "Requirements")
+
+
+class TestRequirements:
+    def test_well_known_label_ok(self):
+        assert validate_requirement(Req(key=lbl.LABEL_TOPOLOGY_ZONE, operator="In", values=["us-west-2a"])) == []
+
+    def test_custom_label_ok(self):
+        assert validate_requirement(Req(key="example.com/tier", operator="In", values=["gold"])) == []
+
+    def test_unsupported_operator(self):
+        errs = validate_requirement(Req(key="example.com/tier", operator="Bogus", values=["x"]))
+        assert any("unsupported operator" in e for e in errs)
+
+    def test_restricted_domain_rejected(self):
+        errs = validate_requirement(Req(key="kubernetes.io/custom", operator="Exists"))
+        assert any("restricted" in e for e in errs)
+
+    def test_restricted_domain_exception_allowed(self):
+        # node-restriction.kubernetes.io is carved out (labels.go:56-58)
+        assert validate_requirement(Req(key="node-restriction.kubernetes.io/team", operator="Exists")) == []
+
+    def test_in_requires_values(self):
+        errs = validate_requirement(Req(key="example.com/tier", operator="In", values=[]))
+        assert any("must have a value defined" in e for e in errs)
+
+    def test_gt_requires_single_nonneg_int(self):
+        ok = Req(key="example.com/cpu", operator="Gt", values=["4"])
+        assert validate_requirement(ok) == []
+        for bad_values in (["-1"], ["x"], ["1", "2"], []):
+            errs = validate_requirement(Req(key="example.com/cpu", operator="Gt", values=bad_values))
+            assert any("single positive integer" in e for e in errs), bad_values
+
+    def test_invalid_label_value(self):
+        errs = validate_requirement(Req(key="example.com/t", operator="In", values=["-bad-"]))
+        assert any("invalid value" in e for e in errs)
+
+    def test_normalized_key_validated_as_canonical(self):
+        # beta zone key normalizes to topology.kubernetes.io/zone, which is
+        # well-known and therefore allowed
+        assert validate_requirement(Req(key=lbl.LABEL_FAILURE_DOMAIN_BETA_ZONE, operator="In", values=["a"])) == []
+
+
+# ---------------------------------------------------------------------------
+# taints (nodeclaim_validation_cel_test.go "Taints")
+
+
+class TestTaints:
+    def _spec(self, taints=(), startup=()):
+        return NodeClaimSpec(taints=list(taints), startup_taints=list(startup))
+
+    def test_valid(self):
+        assert validate_taints(self._spec([Taint(key="a", value="b", effect="NoSchedule")])) == []
+
+    def test_missing_key(self):
+        errs = validate_taints(self._spec([Taint(key="", effect="NoSchedule")]))
+        assert errs
+
+    def test_bad_effect(self):
+        errs = validate_taints(self._spec([Taint(key="a", effect="Sideways")]))
+        assert any("invalid effect" in e for e in errs)
+
+    def test_duplicate_key_effect(self):
+        t = Taint(key="a", value="b", effect="NoSchedule")
+        errs = validate_taints(self._spec([t, Taint(key="a", value="c", effect="NoSchedule")]))
+        assert any("duplicate" in e for e in errs)
+
+    def test_duplicate_spans_startup_taints(self):
+        # dedupe set is shared across taints and startupTaints
+        # (nodeclaim_validation.go:91-96)
+        t = Taint(key="a", value="b", effect="NoSchedule")
+        errs = validate_taints(self._spec([t], [Taint(key="a", value="z", effect="NoSchedule")]))
+        assert any("duplicate" in e for e in errs)
+
+    def test_same_key_different_effect_ok(self):
+        errs = validate_taints(
+            self._spec([Taint(key="a", effect="NoSchedule"), Taint(key="a", effect="NoExecute")])
+        )
+        assert errs == []
+
+
+# ---------------------------------------------------------------------------
+# kubelet configuration (nodeclaim_validation_cel_test.go "KubeletConfiguration")
+
+
+class TestKubelet:
+    def test_none_ok(self):
+        assert validate_kubelet(None) == []
+
+    def test_unsupported_eviction_signal(self):
+        errs = validate_kubelet(KubeletConfiguration(eviction_hard={"disk.available": "10%"}))
+        assert any("unsupported eviction signal" in e for e in errs)
+
+    def test_percentage_bounds(self):
+        errs = validate_kubelet(KubeletConfiguration(eviction_hard={"memory.available": "110%"}))
+        assert any("greater than 100" in e for e in errs)
+        errs = validate_kubelet(KubeletConfiguration(eviction_hard={"memory.available": "-5%"}))
+        assert any("negative" in e for e in errs)
+
+    def test_quantity_value_ok(self):
+        assert validate_kubelet(KubeletConfiguration(
+            eviction_hard={"memory.available": "100Mi"})) == []
+
+    def test_bad_quantity(self):
+        errs = validate_kubelet(KubeletConfiguration(eviction_hard={"memory.available": "zoo"}))
+        assert any("could not be parsed" in e for e in errs)
+
+    def test_reserved_resource_keys(self):
+        errs = validate_kubelet(KubeletConfiguration(kube_reserved={"gpu": 1}))
+        assert any("unsupported reserved resource" in e for e in errs)
+        assert validate_kubelet(KubeletConfiguration(kube_reserved={"cpu": 1000})) == []
+
+    def test_negative_reserved(self):
+        errs = validate_kubelet(KubeletConfiguration(system_reserved={"cpu": -5}))
+        assert any("negative" in e for e in errs)
+
+    def test_eviction_soft_requires_grace_period_pair(self):
+        errs = validate_kubelet(KubeletConfiguration(eviction_soft={"memory.available": "5%"}))
+        assert any("matching evictionSoftGracePeriod" in e for e in errs)
+        errs = validate_kubelet(
+            KubeletConfiguration(eviction_soft_grace_period={"memory.available": 60.0})
+        )
+        assert any("matching evictionSoft threshold" in e for e in errs)
+        assert validate_kubelet(KubeletConfiguration(
+            eviction_soft={"memory.available": "5%"},
+            eviction_soft_grace_period={"memory.available": 60.0},
+        )) == []
+
+    def test_image_gc_threshold_ordering(self):
+        errs = validate_kubelet(KubeletConfiguration(
+            image_gc_high_threshold_percent=50, image_gc_low_threshold_percent=60))
+        assert any("imageGCHighThresholdPercent" in e for e in errs)
+        assert validate_kubelet(KubeletConfiguration(
+            image_gc_high_threshold_percent=85, image_gc_low_threshold_percent=80)) == []
+
+
+# ---------------------------------------------------------------------------
+# disruption / budgets (nodepool_validation_cel_test.go "Disruption")
+
+
+class TestDisruption:
+    def test_negative_expire(self):
+        errs = validate_disruption(Disruption(expire_after=-1))
+        assert any("expireAfter" in e for e in errs)
+
+    def test_consolidate_after_underutilized_conflict(self):
+        # nodepool.go:42 CEL rule
+        errs = validate_disruption(
+            Disruption(consolidate_after=30, consolidation_policy="WhenUnderutilized")
+        )
+        assert any("cannot be combined" in e for e in errs)
+
+    def test_when_empty_requires_consolidate_after(self):
+        # nodepool.go:43 CEL rule
+        errs = validate_disruption(Disruption(consolidation_policy="WhenEmpty"))
+        assert any("must be specified" in e for e in errs)
+        assert validate_disruption(
+            Disruption(consolidate_after=30, consolidation_policy="WhenEmpty")
+        ) == []
+
+    def test_budget_nodes_forms(self):
+        assert validate_budget(Budget(nodes="10")) == []
+        assert validate_budget(Budget(nodes="10%")) == []
+        assert validate_budget(Budget(nodes="100%")) == []
+        assert validate_budget(Budget(nodes="0")) == []
+        assert any("percentage" in e for e in validate_budget(Budget(nodes="110%")))
+        assert validate_budget(Budget(nodes="-3"))
+        assert validate_budget(Budget(nodes="zoo"))
+
+    def test_budget_crontab_duration_pairing(self):
+        # nodepool.go:88 CEL rule: crontab iff duration
+        assert any("crontab" in e for e in validate_budget(Budget(nodes="1", schedule="@daily")))
+        assert any("crontab" in e for e in validate_budget(Budget(nodes="1", duration=3600.0)))
+        assert validate_budget(Budget(nodes="1", schedule="@daily", duration=3600.0)) == []
+        assert validate_budget(Budget(nodes="1", schedule="30 6 * * 5", duration=3600.0)) == []
+
+    def test_max_50_budgets(self):
+        errs = validate_disruption(Disruption(budgets=[Budget(nodes="1")] * 51))
+        assert any("50" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# nodepool-level (nodepool_validation_cel_test.go)
+
+
+class TestNodePool:
+    def test_valid_default(self):
+        assert validate_nodepool(nodepool()) == []
+
+    def test_weight_bounds(self):
+        assert any("weight" in e for e in validate_nodepool(nodepool(weight=0)))
+        assert any("weight" in e for e in validate_nodepool(nodepool(weight=101)))
+        assert validate_nodepool(nodepool(weight=100)) == []
+
+    def test_template_label_restricted_nodepool_key(self):
+        np_ = nodepool()
+        np_.spec.template.metadata.labels = {lbl.NODEPOOL_LABEL_KEY: "self"}
+        assert any("restricted" in e for e in validate_nodepool(np_))
+
+    def test_template_requirement_nodepool_key_restricted(self):
+        np_ = nodepool(
+            template=NodeClaimTemplateSpec(
+                requirements=[Req(key=lbl.NODEPOOL_LABEL_KEY, operator="In", values=["x"])]
+            )
+        )
+        assert any("restricted" in e for e in validate_nodepool(np_))
+
+    def test_bad_name(self):
+        np_ = nodepool()
+        np_.metadata.name = "Not_A_DNS_Name"
+        assert any("metadata.name" in e for e in validate_nodepool(np_))
+
+    def test_negative_limits(self):
+        assert any("limits" in e for e in validate_nodepool(nodepool(limits={"cpu": -1})))
+
+
+class TestNodeClaim:
+    def test_valid(self):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc-1"))
+        assert validate_nodeclaim(nc) == []
+
+    def test_bad_requirement(self):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc-1"))
+        nc.spec.requirements = [Req(key="kubernetes.io/custom", operator="Exists")]
+        assert any("restricted" in e for e in validate_nodeclaim(nc))
+
+
+# ---------------------------------------------------------------------------
+# admission chain on the client
+
+
+class TestAdmission:
+    def test_defaults_budget_stamped(self):
+        np_ = nodepool()
+        set_defaults(np_)
+        assert np_.spec.disruption.budgets == [Budget(nodes="10%")]
+
+    def test_client_rejects_invalid_create(self):
+        client = KubeClient()
+        install_admission(client)
+        bad = nodepool(weight=500)
+        with pytest.raises(ValidationError):
+            client.create(bad)
+        assert client.get("NodePool", "default") is None
+
+    def test_client_accepts_and_defaults(self):
+        client = KubeClient()
+        install_admission(client)
+        client.create(nodepool())
+        got = client.get("NodePool", "default")
+        assert got.spec.disruption.budgets == [Budget(nodes="10%")]
+
+    def test_client_rejects_invalid_update(self):
+        client = KubeClient()
+        install_admission(client)
+        np_ = client.create(nodepool())
+        np_.spec.weight = 0
+        with pytest.raises(ValidationError):
+            client.update(np_)
