@@ -81,6 +81,16 @@ class MetricsStore:
         for pod in kube_client.list("Pod"):
             key = (pod.namespace, pod.name)
             seen.add(key)
+            # a pod is in exactly one phase: drop the series for any phase
+            # it moved out of, or a Pending→Running pod reports both
+            for k in [
+                k
+                for k in self.metrics.pod_state.values
+                if ("name", pod.name) in k
+                and ("namespace", pod.namespace) in k
+                and ("phase", pod.status.phase) not in k
+            ]:
+                self.metrics.pod_state.values.pop(k, None)
             self.metrics.pod_state.set(
                 1.0, name=pod.name, namespace=pod.namespace, phase=pod.status.phase
             )

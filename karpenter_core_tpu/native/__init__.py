@@ -1,0 +1,144 @@
+"""Native (C++) host-side solver components.
+
+The hybrid solver engine splits work by hardware affinity: the TPU runs
+the massively parallel tensor stages (signature x type compat matmuls,
+offering masks, vmapped consolidation repacks) while the inherently
+sequential FFD pack tail runs in C++ (see pack.cc). This mirrors the
+reference, whose hot loops are compiled Go (scheduler.go:140-285) —
+a Python-only pack would be neither faithful to that nor fast.
+
+The shared library is compiled on first use with g++ (cached next to
+the source); everything degrades gracefully to the TPU lax.scan path
+when a toolchain is unavailable or KARPENTER_TPU_NATIVE=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "pack.cc")
+_LIB = os.path.join(os.path.dirname(__file__), "_libpack.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The packer library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("KARPENTER_TPU_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.ffd_pack_native.restype = ctypes.c_int32
+        lib.ffd_pack_native.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # requests
+            ctypes.c_int64,  # P
+            ctypes.c_int64,  # R
+            ctypes.POINTER(ctypes.c_int32),  # frontier
+            ctypes.c_int64,  # F
+            ctypes.c_int32,  # max_pods_per_node
+            ctypes.c_int32,  # k_open
+            ctypes.POINTER(ctypes.c_int32),  # node_ids_out
+        ]
+        lib.cheapest_types_native.restype = None
+        lib.cheapest_types_native.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),  # usage
+            ctypes.c_int64,  # N
+            ctypes.c_int64,  # R
+            ctypes.POINTER(ctypes.c_int32),  # allocatable
+            ctypes.c_int64,  # T
+            ctypes.POINTER(ctypes.c_double),  # prices
+            ctypes.POINTER(ctypes.c_int32),  # out
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def ffd_pack_native(
+    requests: np.ndarray,  # (P, R) int32, sorted descending by primary
+    frontier: np.ndarray,  # (F, R) int32
+    max_pods_per_node: int,
+    k_open: int = 16,
+):
+    """→ (node_ids (P,) int32, node_count int). Exact semantic twin of
+    solver.pack.ffd_pack (asserted by tests/test_native_pack.py)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native packer unavailable")
+    requests = np.ascontiguousarray(requests, dtype=np.int32)
+    frontier = np.ascontiguousarray(frontier, dtype=np.int32)
+    P, R = requests.shape
+    node_ids = np.empty(P, dtype=np.int32)
+    count = lib.ffd_pack_native(
+        requests.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        P,
+        R,
+        frontier.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        frontier.shape[0],
+        np.int32(min(int(max_pods_per_node), 2**31 - 1)),
+        k_open,
+        node_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return node_ids, int(count)
+
+
+def cheapest_types_native(
+    usage: np.ndarray,  # (N, R) int
+    allocatable: np.ndarray,  # (T, R) int32
+    prices: np.ndarray,  # (T,) f64
+) -> np.ndarray:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native packer unavailable")
+    usage = np.ascontiguousarray(usage, dtype=np.int64)
+    allocatable = np.ascontiguousarray(allocatable, dtype=np.int32)
+    prices = np.ascontiguousarray(prices, dtype=np.float64)
+    N, R = usage.shape
+    out = np.empty(N, dtype=np.int32)
+    lib.cheapest_types_native(
+        usage.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        N,
+        R,
+        allocatable.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        allocatable.shape[0],
+        prices.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

@@ -59,23 +59,25 @@ class ResourceAxis:
             return None
 
 
-def build_resource_axis(
-    pods_requests: Sequence[Dict[str, int]], instance_types: Sequence[InstanceType]
-) -> ResourceAxis:
+def build_catalog_axis(instance_types: Sequence[InstanceType]) -> ResourceAxis:
+    """Resource axis determined by the catalog ALONE — stable across pod
+    batches, which is what lets the encoded catalog be cached solve over
+    solve. Pod-only extended resources are appended by ``extend_axis``;
+    pod request magnitudes are handled by clamping (quantized requests
+    saturate at 2^30, far above any capacity, so an oversized pod still
+    reads as unschedulable)."""
     names: Set[str] = set(BASE_RESOURCES)
-    for r in pods_requests:
-        names.update(r.keys())
     for it in instance_types:
         names.update(it.capacity.keys())
     ordered = BASE_RESOURCES + sorted(names - set(BASE_RESOURCES))
     # per-resource divisor: keep the max value under 2^30 after division
+    idx = {n: i for i, n in enumerate(ordered)}
     maxima = np.zeros(len(ordered), dtype=np.float64)
-    for r in pods_requests:
-        for k, v in r.items():
-            maxima[ordered.index(k)] = max(maxima[ordered.index(k)], v)
     for it in instance_types:
         for k, v in it.capacity.items():
-            maxima[ordered.index(k)] = max(maxima[ordered.index(k)], v)
+            i = idx[k]
+            if v > maxima[i]:
+                maxima[i] = v
     # divisors are 10^6 · 2^k (k ≥ 0): the quantized unit is a power-of-two
     # multiple of 1 milli, so whole-milli requests and capacities quantize
     # EXACTLY (ceil/floor agree with infinite precision) and exact-fit
@@ -87,6 +89,33 @@ def build_resource_axis(
             d *= 2
         divisors[i] = d
     return ResourceAxis(ordered, divisors)
+
+
+def extend_axis(
+    axis: ResourceAxis, pods_requests: Sequence[Dict[str, int]]
+) -> ResourceAxis:
+    """Append pod-only resource names after the catalog columns (cached
+    catalog tensors keep their column positions; their missing columns
+    read as zero capacity, i.e. unschedulable — the reference's fits
+    semantics for unregistered extended resources)."""
+    known = set(axis.names)
+    extra: Set[str] = set()
+    for r in pods_requests:
+        for k in r.keys():
+            if k not in known:
+                extra.add(k)
+    if not extra:
+        return axis
+    return ResourceAxis(
+        axis.names + sorted(extra),
+        np.concatenate([axis.divisors, np.full(len(extra), 10**6, dtype=np.int64)]),
+    )
+
+
+def build_resource_axis(
+    pods_requests: Sequence[Dict[str, int]], instance_types: Sequence[InstanceType]
+) -> ResourceAxis:
+    return extend_axis(build_catalog_axis(instance_types), pods_requests)
 
 
 def build_requests_matrix(all_requests: Sequence[Dict[str, int]], axis: ResourceAxis) -> np.ndarray:
@@ -104,9 +133,10 @@ def build_requests_matrix(all_requests: Sequence[Dict[str, int]], axis: Resource
             if i is not None:
                 row[i] = -(-v // 10**6)  # ceil: never let a pod look smaller
     # axis divisors are nano-scale powers of two ≥ 2^20 in the large case;
-    # convert to milli-scale (may drop below 1 → clamp)
+    # convert to milli-scale (may drop below 1 → clamp). Quantized values
+    # saturate at 2^30: beyond every capacity, so still unschedulable.
     div = np.maximum(axis.divisors.astype(np.float64) / 10**6, 1.0)
-    return np.ceil(milli / div[None, :]).astype(np.int32)
+    return np.minimum(np.ceil(milli / div[None, :]), 2.0**30).astype(np.int32)
 
 
 def quantize_requests(requests: Dict[str, int], axis: ResourceAxis) -> np.ndarray:
@@ -116,9 +146,9 @@ def quantize_requests(requests: Dict[str, int], axis: ResourceAxis) -> np.ndarra
     for k, v in requests.items():
         i = axis.index(k)
         if i is not None:
-            # python-int division: nanos can exceed int64 after ×, and the
-            # quantized result always fits int32
-            out[i] = -(-int(v) // int(axis.divisors[i]))
+            # python-int division: nanos can exceed int64 after ×; saturate
+            # at 2^30 (beyond every capacity) so the result fits int32
+            out[i] = min(-(-int(v) // int(axis.divisors[i])), 2**30)
     return out.astype(np.int32)
 
 
@@ -154,6 +184,10 @@ class EncodedInstanceTypes:
     capacity_types: List[str]
     offering_avail: np.ndarray
     offering_price: np.ndarray  # (T, Z, C) f64 (inf where unavailable)
+    # per key, the (type index, Requirement) pairs behind key_masks — kept
+    # so cached masks can be re-extended when the vocab grows (see
+    # extend_encoded_masks)
+    key_reqs: Dict[str, list] = field(default_factory=dict)
 
 
 def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis, vocab: Vocab) -> EncodedInstanceTypes:
@@ -176,6 +210,7 @@ def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis
     key_has = {k: np.zeros(T, dtype=bool) for k in keys}
     key_neg = {k: np.zeros(T, dtype=bool) for k in keys}
 
+    key_reqs: Dict[str, list] = {k: [] for k in keys}
     for t, it in enumerate(instance_types):
         allocatable[t] = quantize_capacity(it.allocatable(), axis)
         for o in it.offerings:
@@ -189,6 +224,7 @@ def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis
             key_masks[key][t] = vocab.encode_mask(req, kv.size)
             key_has[key][t] = True
             key_neg[key][t] = _is_neg(req)
+            key_reqs[key].append((t, req))
 
     return EncodedInstanceTypes(
         instance_types=instance_types,
@@ -202,7 +238,33 @@ def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis
         capacity_types=capacity_types,
         offering_avail=offering_avail,
         offering_price=offering_price,
+        key_reqs=key_reqs,
     )
+
+
+def extend_encoded_masks(enc: EncodedInstanceTypes, vocab: Vocab) -> None:
+    """Grow a cached encoding's masks to the vocab's current widths.
+
+    New slots stand for values interned after the encoding was built
+    (by later pod batches): an In-requirement never listed them (they
+    would have been interned at build time) so its mask extends with
+    False; complement requirements re-evaluate ``req.has`` so Gt/Lt
+    bounds stay exact. OTHER sits at slot 0, so existing slots never
+    move (vocab.py invariant)."""
+    for key, mask in enc.key_masks.items():
+        kv = vocab.key_vocab(key)
+        new = kv.size
+        old = mask.shape[1]
+        if new <= old:
+            continue
+        padded = np.zeros((mask.shape[0], new), dtype=bool)
+        padded[:, :old] = mask
+        new_values = kv.values[old - 1 :]  # slot i (i≥1) ↔ values[i-1]
+        for t, req in enc.key_reqs.get(key, ()):
+            if req.complement:
+                for j, v in enumerate(new_values):
+                    padded[t, old + j] = req.has(v)
+        enc.key_masks[key] = padded
 
 
 # ---------------------------------------------------------------------------

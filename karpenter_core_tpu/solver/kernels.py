@@ -84,6 +84,26 @@ def offering_kernel(
     return jnp.einsum("szc,tzc->st", pair_ok.astype(jnp.float32), avail.astype(jnp.float32)) > 0
 
 
+@partial(jax.jit, static_argnames=("keys",))
+def allowed_kernel(
+    sig_arrays: Dict[str, jnp.ndarray],
+    type_masks: Dict[str, jnp.ndarray],
+    type_has: Dict[str, jnp.ndarray],
+    type_neg: Dict[str, jnp.ndarray],
+    zone_ok: jnp.ndarray,  # (S, Z)
+    ct_ok: jnp.ndarray,  # (S, C)
+    avail: jnp.ndarray,  # (T, Z, C)
+    keys: Tuple[str, ...],
+) -> jnp.ndarray:
+    """Fused compat ∧ offering in ONE device dispatch → (S, T) bool.
+
+    The solve's only mandatory device round trip; fusing the two kernels
+    halves launch/transfer latency, which dominates at interactive batch
+    sizes (device RTT ≫ the matmul time for S ~ tens)."""
+    compat = compat_kernel(sig_arrays, type_masks, type_has, type_neg, keys)
+    return compat & offering_kernel(zone_ok, ct_ok, avail)
+
+
 def zone_ct_masks(compats, enc: EncodedInstanceTypes) -> Tuple[np.ndarray, np.ndarray]:
     """Signature-level zone / capacity-type admissibility from merged
     requirements (missing key ⇒ all allowed)."""

@@ -130,6 +130,10 @@ def assign_cheapest_types(
     """Per node, the cheapest viable type that holds its load — the launch
     decision the fake provider makes (fake/cloudprovider.go:105-110).
     → (N,) int32 index into the viable-type axis, -1 if none fits."""
+    from .. import native
+
+    if native.available() and node_usage.size and allocatable.size:
+        return native.cheapest_types_native(node_usage, allocatable, prices)
     fits = np.all(node_usage[:, None, :] <= allocatable[None, :, :], axis=-1)  # (N, T)
     priced = np.where(fits, prices[None, :], np.inf)
     best = np.argmin(priced, axis=1).astype(np.int32)
@@ -160,13 +164,28 @@ def _pad_class(p: int) -> int:
     return -(-p // 4096) * 4096
 
 
-def batch_pack(jobs: list) -> list:
-    """Run many (requests, frontier, max_per_node) packs as few padded,
-    vmapped device calls (one per size class). Each job's padding pods
-    exceed its own frontier max so they emit -1 without touching state.
+def batch_pack(jobs: list, engine: str = "auto") -> list:
+    """Run many (requests, frontier, max_per_node) packs.
+
+    engine="auto" prefers the native C++ packer (an exact semantic twin
+    of ffd_pack — the sequential pack tail is CPU work; see native/
+    pack.cc) and falls back to few padded, vmapped device calls (one per
+    size class). engine="device" forces the TPU scan; engine="native"
+    requires the C++ path. Each device job's padding pods exceed its own
+    frontier max so they emit -1 without touching state.
     Returns [(node_ids, node_count)] aligned with jobs."""
     if not jobs:
         return []
+    if engine in ("auto", "native"):
+        from .. import native
+
+        if native.available():
+            return [
+                native.ffd_pack_native(reqs, frontier, int(cap))
+                for reqs, frontier, cap in jobs
+            ]
+        if engine == "native":
+            raise RuntimeError("native packer requested but unavailable")
     R = jobs[0][0].shape[1]
     F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
     classes: dict = {}

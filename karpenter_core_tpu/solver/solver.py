@@ -32,16 +32,19 @@ from ..scheduling.requirements import node_selector_requirements
 from .encode import (
     EncodedInstanceTypes,
     PoolEncoding,
+    ResourceAxis,
     SignatureGroup,
+    build_catalog_axis,
     build_requests_matrix,
-    build_resource_axis,
     encode_instance_types,
     encode_signature_for_pool,
+    extend_axis,
+    extend_encoded_masks,
     finalize_signature_masks,
     group_pods,
     quantize_requests,
 )
-from .kernels import build_compat_inputs, compat_kernel, offering_kernel, zone_ct_masks
+from .kernels import allowed_kernel, build_compat_inputs, zone_ct_masks
 from .pack import (
     assign_cheapest_types,
     batch_pack,
@@ -49,6 +52,65 @@ from .pack import (
     pareto_frontier,
 )
 from .vocab import Vocab
+
+
+@dataclass
+class _CatalogEntry:
+    """Cross-solve cache entry: one catalog generation's tensorization.
+
+    Keyed by the catalog's object identity (the strong `catalog` ref
+    keeps ids stable) plus an offering fingerprint that catches in-place
+    availability/price mutation. The vocab grows monotonically as pod
+    batches intern new values; cached masks are re-extended in place
+    (encode.extend_encoded_masks) — SURVEY §6's "persistent solver
+    process, vocab interning maintained incrementally"."""
+
+    catalog: List[InstanceType]
+    fingerprint: int
+    vocab: Vocab
+    axis: ResourceAxis
+    enc: EncodedInstanceTypes
+
+
+_CATALOG_CACHE: Dict[tuple, _CatalogEntry] = {}
+_CATALOG_CACHE_MAX = 8
+
+
+def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
+    """Cheap content fingerprint catching in-place mutation of the fields
+    the encoding depends on: capacity and the full offering tuples.
+    (In-place mutation of a Requirement object itself is assumed not to
+    happen — requirements are treated as immutable catalog data.)"""
+    return hash(
+        tuple(
+            (
+                it.name,
+                id(it.requirements),
+                tuple(sorted(it.capacity.items())),
+                tuple(
+                    (o.zone, o.capacity_type, o.available, o.price)
+                    for o in it.offerings
+                ),
+            )
+            for it in catalog
+        )
+    )
+
+
+def _catalog_entry(catalog: List[InstanceType]) -> _CatalogEntry:
+    key = tuple(map(id, catalog))
+    fp = _catalog_fingerprint(catalog)
+    entry = _CATALOG_CACHE.get(key)
+    if entry is not None and entry.fingerprint == fp:
+        return entry
+    vocab = Vocab()
+    axis = build_catalog_axis(catalog)
+    enc = encode_instance_types(list(catalog), axis, vocab)
+    entry = _CatalogEntry(list(catalog), fp, vocab, axis, enc)
+    if key not in _CATALOG_CACHE and len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
+        _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
+    _CATALOG_CACHE[key] = entry
+    return entry
 
 
 @dataclass
@@ -61,8 +123,17 @@ class NodePlan:
     capacity_type: str
     price: float
     pod_indices: List[int]  # into the solve batch
-    requests: Optional[dict] = None  # summed pod requests (nanos)
     pods: Optional[List[Pod]] = None  # resolved by the provisioner for events
+    # this plan's pods' exact request dicts (nanos) — merged lazily off
+    # the solve's critical path (only read at NodeClaim-creation time)
+    _pod_requests: Optional[list] = field(default=None, repr=False)
+    _requests: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def requests(self) -> Optional[dict]:
+        if self._requests is None and self._pod_requests is not None:
+            self._requests = resources.merge(*self._pod_requests)
+        return self._requests
 
 
 @dataclass
@@ -209,16 +280,56 @@ class TPUScheduler:
                     result.pod_errors[pods[i].uid] = "no nodepool found"
             return
 
-        all_requests = [resources.requests_for_pods(p) for p in pods]
-        self._all_requests = all_requests  # reused in finalize for NodePlan.requests
-        axis = build_resource_axis(all_requests, [it for cat in pool_catalogs for it in cat])
-        requests_matrix = build_requests_matrix(all_requests, axis)
+        # --- per-pool encoding + compat kernels -------------------------
+        # catalog tensors come from the cross-solve cache (encode once per
+        # catalog generation, extend masks as pod batches grow the vocab)
+        pool_entries = [_catalog_entry(cat) for cat in pool_catalogs]
+        sig_compats: List[List] = [
+            [encode_signature_for_pool(g, pool, e.vocab) for g in groups]
+            for pool, e in zip(pools, pool_entries)
+        ]
+        for e in {id(e): e for e in pool_entries}.values():
+            extend_encoded_masks(e.enc, e.vocab)
+        for compats, e in zip(sig_compats, pool_entries):
+            finalize_signature_masks(compats, e.vocab)
+        encoded: List[EncodedInstanceTypes] = [e.enc for e in pool_entries]
 
-        # daemonset overhead per pool, added to every planned node's load
+        # ONE fused device dispatch per pool (compat ∧ offering), all pools
+        # dispatched before any sync so the per-pod host encoding below
+        # overlaps with device compute
+        pending = []
+        for e, compats in zip(pool_entries, sig_compats):
+            enc = e.enc
+            sig_arrays = build_compat_inputs(compats, enc, e.vocab)
+            keys = tuple(sorted(enc.key_masks.keys()))
+            zone_ok, ct_ok = zone_ct_masks(compats, enc)
+            fut = allowed_kernel(
+                {k: np.asarray(v) for k, v in sig_arrays.items()},
+                enc.key_masks,
+                enc.key_has,
+                enc.key_neg,
+                zone_ok,
+                ct_ok,
+                enc.offering_avail,
+                keys,
+            )
+            pending.append((fut, zone_ok, ct_ok))
+
+        # --- per-pod encoding (overlapped with the device dispatch) -----
+        all_requests = [resources.requests_for_pods(p) for p in pods]
+        self._all_requests = all_requests  # reused for lazy NodePlan.requests
         from ..scheduling.requirements import pod_requirements as _pod_reqs
 
+        # per unique catalog: extended axis + quantized request matrix
+        matrices: Dict[int, tuple] = {}
+        for e in {id(e): e for e in pool_entries}.values():
+            axis_ext = extend_axis(e.axis, all_requests)
+            matrices[id(e)] = (axis_ext, build_requests_matrix(all_requests, axis_ext))
+
+        # daemonset overhead per pool, added to every planned node's load
         daemon_requests = {}
-        for pool in pools:
+        for pool, e in zip(pools, pool_entries):
+            axis_ext = matrices[id(e)][0]
             daemons = [
                 p
                 for p in daemonset_pods
@@ -229,42 +340,12 @@ class TPUScheduler:
                 is None
             ]
             daemon_requests[pool.nodepool.name] = quantize_requests(
-                resources.requests_for_pods(*daemons) if daemons else {}, axis
+                resources.requests_for_pods(*daemons) if daemons else {}, axis_ext
             )
 
-        # --- per-pool encoding + compat kernels -------------------------
-        # pass 1: intern every value (catalog + merged signature reqs) so
-        # mask widths are final; pass 2: build the actual mask tensors
-        vocab = Vocab()
-        for catalog in pool_catalogs:
-            for it in catalog:
-                for req in it.requirements.values():
-                    vocab.observe_requirement(req)
-        sig_compats: List[List] = [
-            [encode_signature_for_pool(g, pool, vocab) for g in groups] for pool in pools
+        allowed_per_pool = [
+            (np.asarray(fut), zone_ok, ct_ok) for fut, zone_ok, ct_ok in pending
         ]
-        encoded: List[EncodedInstanceTypes] = [
-            encode_instance_types(catalog, axis, vocab) for catalog in pool_catalogs
-        ]
-        for compats in sig_compats:
-            finalize_signature_masks(compats, vocab)
-
-        allowed_per_pool = []
-        for enc, compats in zip(encoded, sig_compats):
-            sig_arrays = build_compat_inputs(compats, enc, vocab)
-            keys = tuple(sorted(enc.key_masks.keys()))
-            compat = np.asarray(
-                compat_kernel(
-                    {k: np.asarray(v) for k, v in sig_arrays.items()},
-                    enc.key_masks,
-                    enc.key_has,
-                    enc.key_neg,
-                    keys,
-                )
-            )
-            zone_ok, ct_ok = zone_ct_masks(compats, enc)
-            offering = np.asarray(offering_kernel(zone_ok, ct_ok, enc.offering_avail))
-            allowed_per_pool.append((compat & offering, zone_ok, ct_ok))
 
         # --- pack: prepare every group/zone job, ONE batched device call,
         # then finalize (single dispatch + single host sync per solve)
@@ -275,7 +356,8 @@ class TPUScheduler:
                 gi,
                 group,
                 pods,
-                requests_matrix,
+                matrices,
+                pool_entries,
                 pools,
                 encoded,
                 sig_compats,
@@ -296,7 +378,8 @@ class TPUScheduler:
         gi: int,
         group: SignatureGroup,
         pods: List[Pod],
-        requests_matrix: np.ndarray,
+        matrices: Dict[int, tuple],
+        pool_entries: List["_CatalogEntry"],
         pools: List[PoolEncoding],
         encoded: List[EncodedInstanceTypes],
         sig_compats,
@@ -329,6 +412,7 @@ class TPUScheduler:
         zone_ok = allowed_per_pool[chosen][1][gi]  # (Z,)
         ct_ok = allowed_per_pool[chosen][2][gi]  # (C,)
         daemon = daemon_requests[pool.nodepool.name]
+        requests_matrix = matrices[id(pool_entries[chosen])][1]
 
         idx = np.array(group.pod_indices, dtype=np.int64)
         reqs = requests_matrix[idx]
@@ -397,8 +481,15 @@ class TPUScheduler:
             for i in idx:
                 result.pod_errors[pods[i].uid] = "no viable instance type"
             return
-        alloc = enc.allocatable[viable_idx] - daemon[None, :]  # daemon overhead off the top
-        alloc = np.maximum(alloc, 0)
+        alloc = enc.allocatable[viable_idx]
+        if alloc.shape[1] < daemon.shape[0]:
+            # pod-only extended resources: zero capacity columns (pods
+            # requesting them are unschedulable — reference fits semantics)
+            alloc = np.concatenate(
+                [alloc, np.zeros((alloc.shape[0], daemon.shape[0] - alloc.shape[1]), np.int32)],
+                axis=1,
+            )
+        alloc = np.maximum(alloc - daemon[None, :], 0)  # daemon overhead off the top
         # zone buckets of one group share viable sets — cache the frontier
         cache_key = (id(enc), viable_idx.tobytes(), daemon.tobytes())
         frontier = self._frontier_cache.get(cache_key)
@@ -451,9 +542,15 @@ class TPUScheduler:
             )
 
         chosen_types = assign_cheapest_types(usage, alloc, prices)
+        # group pod indices by node in one argsort pass (not O(N·P) masks)
+        valid = node_ids >= 0
+        order = np.argsort(node_ids[valid], kind="stable")
+        sorted_ids = node_ids[valid][order]
+        sorted_idx = idx[valid][order]
+        bounds = np.searchsorted(sorted_ids, np.arange(node_count + 1))
         for n in range(node_count):
             ti = chosen_types[n]
-            members = [int(i) for i in idx[node_ids == n]]
+            members = [int(i) for i in sorted_idx[bounds[n] : bounds[n + 1]]]
             if ti < 0:
                 for i in members:
                     result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
@@ -471,7 +568,7 @@ class TPUScheduler:
                     capacity_type=offering_ct,
                     price=offering_price,
                     pod_indices=members,
-                    requests=resources.merge(*(self._all_requests[i] for i in members)),
+                    _pod_requests=[self._all_requests[i] for i in members],
                 )
             )
 

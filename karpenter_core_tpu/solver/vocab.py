@@ -6,6 +6,15 @@ standing in for every value not observed in the batch. Complement sets
 OTHER=0. Set-intersection nonemptiness is then exactly mask overlap —
 the contract the compat kernel relies on.
 
+OTHER lives at slot 0 and interned values at 1.. so vocabularies can
+grow *incrementally across solves* (SURVEY §6: "vocab interning
+maintained incrementally with cluster state"): a mask encoded at an
+older, narrower width stays valid at every later width — new slots are
+values the requirement never listed, so In-masks extend with False and
+complement masks extend per `Requirement.has` (see
+encode.extend_encoded_masks). This is what makes the cached catalog
+encoding reusable batch over batch.
+
 Gt/Lt bounds are resolved against the observed vocab host-side (values
 are filtered by the bound); OTHER stays 1 for bounded complements since
 unseen integers satisfying the bound always exist.
@@ -13,11 +22,13 @@ unseen integers satisfying the bound always exist.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, List
 
 import numpy as np
 
 from ..scheduling.requirement import Requirement
+
+OTHER_SLOT = 0
 
 
 class KeyVocab:
@@ -26,28 +37,28 @@ class KeyVocab:
     def __init__(self, key: str):
         self.key = key
         self.values: List[str] = []
-        self.index: Dict[str, int] = {}
+        self.index: Dict[str, int] = {}  # value → slot (1-based; 0 is OTHER)
 
     def intern(self, value: str) -> int:
         idx = self.index.get(value)
         if idx is None:
-            idx = len(self.values)
             self.values.append(value)
+            idx = len(self.values)  # slot 0 is OTHER
             self.index[value] = idx
         return idx
 
     @property
     def size(self) -> int:
-        """Mask width: observed values + OTHER."""
+        """Mask width: OTHER + observed values."""
         return len(self.values) + 1
 
     @property
     def other_slot(self) -> int:
-        return len(self.values)
+        return OTHER_SLOT
 
 
 class Vocab:
-    """All key vocabularies for one solve batch."""
+    """All key vocabularies for one catalog lineage (grows across solves)."""
 
     def __init__(self) -> None:
         self.keys: Dict[str, KeyVocab] = {}
@@ -77,8 +88,8 @@ class Vocab:
             # NotIn/Exists (incl. Gt/Lt bounds): everything allowed except
             # excluded values, filtered by bounds; OTHER allowed
             for i, v in enumerate(kv.values):
-                mask[i] = req.has(v)
-            mask[kv.other_slot] = True
+                mask[i + 1] = req.has(v)
+            mask[OTHER_SLOT] = True
         else:
             # In/DoesNotExist: only listed values within bounds
             for v in req.values:

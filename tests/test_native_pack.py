@@ -1,0 +1,102 @@
+"""Native C++ packer ≡ TPU ffd_pack scan, bit for bit.
+
+The hybrid engine routes the sequential pack tail to native/pack.cc;
+this suite is the "sanitizer" for that seam (SURVEY §5: CPU/TPU parity
+oracle): randomized request/frontier cases must produce identical
+node-id sequences and node counts on both engines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu import native
+from karpenter_core_tpu.solver.pack import (
+    assign_cheapest_types,
+    batch_pack,
+    ffd_pack,
+    pareto_frontier,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _random_case(rng, P, F, R=4, cap=None):
+    requests = np.stack(
+        [rng.randint(1, 50, P) * 10 for _ in range(R - 1)] + [np.ones(P, dtype=np.int64)],
+        axis=1,
+    ).astype(np.int32)
+    requests = requests[np.argsort(-requests[:, 0], kind="stable")]
+    frontier = pareto_frontier(
+        np.stack(
+            [rng.randint(100, 2000, F) for _ in range(R - 1)]
+            + [rng.randint(4, 120, F)],
+            axis=1,
+        ).astype(np.int32)
+    )
+    cap = cap if cap is not None else 1 << 30
+    return requests, frontier, cap
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_native_matches_device_scan(seed):
+    rng = np.random.RandomState(seed)
+    P = int(rng.randint(5, 400))
+    F = int(rng.randint(1, 6))
+    cap = int(rng.choice([1, 3, 29, 1 << 30]))
+    requests, frontier, cap = _random_case(rng, P, F, cap=cap)
+
+    dev_ids, dev_count = ffd_pack(requests, frontier, np.int32(cap))
+    nat_ids, nat_count = native.ffd_pack_native(requests, frontier, cap)
+
+    np.testing.assert_array_equal(np.asarray(dev_ids), nat_ids)
+    assert int(dev_count) == nat_count
+
+
+def test_native_unschedulable_pods_get_minus_one():
+    requests = np.array([[100, 100, 1, 0], [5000, 100, 1, 0]], dtype=np.int32)
+    requests = requests[np.argsort(-requests[:, 0])]
+    frontier = np.array([[1000, 1000, 10, 0]], dtype=np.int32)
+    ids, count = native.ffd_pack_native(requests, frontier, 1 << 30)
+    assert ids[0] == -1  # the 5000-cpu pod fits nowhere
+    assert ids[1] == 0
+    assert count == 1
+
+
+def test_native_respects_max_pods_per_node():
+    requests = np.full((10, 4), [10, 10, 1, 0], dtype=np.int32)
+    frontier = np.array([[10000, 10000, 1000, 0]], dtype=np.int32)
+    ids, count = native.ffd_pack_native(requests, frontier, 3)
+    assert count == 4  # ceil(10 / 3)
+    _, counts = np.unique(ids, return_counts=True)
+    assert counts.max() == 3
+
+
+def test_batch_pack_auto_prefers_native_and_matches_device():
+    rng = np.random.RandomState(7)
+    jobs = []
+    for _ in range(5):
+        P = int(rng.randint(3, 200))
+        requests, frontier, _ = _random_case(rng, P, 3)
+        jobs.append((requests, frontier, np.int32(1 << 30)))
+    auto = batch_pack(jobs, engine="auto")
+    dev = batch_pack(jobs, engine="device")
+    for (a_ids, a_n), (d_ids, d_n) in zip(auto, dev):
+        np.testing.assert_array_equal(np.asarray(a_ids), np.asarray(d_ids))
+        assert int(a_n) == int(d_n)
+
+
+def test_cheapest_types_native_matches_numpy():
+    rng = np.random.RandomState(3)
+    usage = rng.randint(0, 500, (40, 4)).astype(np.int64)
+    alloc = rng.randint(100, 800, (30, 4)).astype(np.int32)
+    prices = rng.rand(30)
+    nat = native.cheapest_types_native(usage, alloc, prices)
+    fits = np.all(usage[:, None, :] <= alloc[None, :, :], axis=-1)
+    priced = np.where(fits, prices[None, :], np.inf)
+    ref = np.argmin(priced, axis=1).astype(np.int32)
+    ref[~fits.any(axis=1)] = -1
+    np.testing.assert_array_equal(nat, ref)
