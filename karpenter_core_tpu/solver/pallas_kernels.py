@@ -1,0 +1,206 @@
+"""Pallas TPU kernel: fused signature×type requirement-compat.
+
+The XLA path (kernels.compat_kernel) emits one (S×Vk)·(Vk×T) matmul plus
+three elementwise combines PER KEY, each materializing an (S, T)
+intermediate in HBM. This kernel fuses the whole key loop: per-key masks
+are packed into 128-lane-aligned chunks of one wide (S, W) / (T, W)
+matrix, the kernel walks the (static) key offsets doing one MXU matmul
+per key, and the running AND lives in VMEM — the (S, T) result is
+written to HBM exactly once. This is the "vocab-sparse mask" case
+SURVEY §7 (step 4) flags as the place XLA fuses badly.
+
+Semantics are identical to kernels.compat_kernel (asserted by
+tests/test_pallas_compat.py, which runs the kernel in interpret mode on
+CPU): per key, compatible ⇔ ¬(both sides constrain the key) ∨ the value
+sets overlap ∨ both sides are complements (requirements.go:241-255
+Intersects with the both-negative carve-out).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANE = 128  # TPU lane width; per-key chunks are padded to this
+TILE_S = 128
+TILE_T = 128
+
+
+def pack_masks(
+    key_masks: Dict[str, np.ndarray],  # key → (N, Vk) bool
+    key_has: Dict[str, np.ndarray],  # key → (N,) bool
+    key_neg: Dict[str, np.ndarray],  # key → (N,) bool
+    keys: Sequence[str],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Tuple[int, ...], Tuple[int, ...]]:
+    """Concatenate per-key masks into a lane-aligned (N, W) f32 matrix
+    plus (N, K) has/neg planes. Returns (packed, has, neg, offsets,
+    widths); offsets[k]/widths[k] are key k's static lane-aligned chunk
+    bounds (pad lanes are zero in both operands so they never add
+    overlap)."""
+    n = next(iter(key_masks.values())).shape[0] if key_masks else 0
+    chunks: List[np.ndarray] = []
+    offsets: List[int] = []
+    widths: List[int] = []
+    w = 0
+    for key in keys:
+        m = key_masks[key]
+        vk = m.shape[1]
+        pad = (-vk) % LANE if vk else LANE
+        chunks.append(np.pad(m.astype(np.float32), ((0, 0), (0, pad))))
+        offsets.append(w)
+        widths.append(vk + pad)
+        w += vk + pad
+    packed = np.concatenate(chunks, axis=1) if chunks else np.zeros((n, 0), np.float32)
+    has = np.stack([key_has[k] for k in keys], axis=1).astype(np.float32) if keys else np.zeros((n, 0), np.float32)
+    neg = np.stack([key_neg[k] for k in keys], axis=1).astype(np.float32) if keys else np.zeros((n, 0), np.float32)
+    return packed, has, neg, tuple(offsets), tuple(widths)
+
+
+def _compat_tile_kernel(
+    sig_ref,  # (TILE_S, W) f32
+    typ_ref,  # (TILE_T, W) f32
+    sh_ref,  # (TILE_S, Kp) f32
+    sn_ref,  # (TILE_S, Kp) f32
+    th_ref,  # (TILE_T, Kp) f32
+    tn_ref,  # (TILE_T, Kp) f32
+    out_ref,  # (TILE_S, TILE_T) f32
+    *,
+    offsets: Tuple[int, ...],
+    widths: Tuple[int, ...],
+):
+    ok = jnp.ones((TILE_S, TILE_T), dtype=jnp.bool_)
+    # static unroll over keys: one MXU matmul per key, combines on VPU,
+    # accumulator never leaves VMEM
+    for k, (start, width) in enumerate(zip(offsets, widths)):
+        q = sig_ref[:, start : start + width]
+        t = typ_ref[:, start : start + width]
+        overlap = (
+            jax.lax.dot_general(
+                q,
+                t,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            > 0.0
+        )
+        both_has = (sh_ref[:, k : k + 1] * th_ref[:, k : k + 1].T) > 0.0
+        both_neg = (sn_ref[:, k : k + 1] * tn_ref[:, k : k + 1].T) > 0.0
+        ok = ok & (~both_has | overlap | both_neg)
+    out_ref[:] = ok.astype(jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("offsets", "widths", "interpret")
+)
+def compat_pallas(
+    sig_packed: jnp.ndarray,  # (S, W) f32
+    typ_packed: jnp.ndarray,  # (T, W) f32
+    sig_has: jnp.ndarray,  # (S, K) f32
+    sig_neg: jnp.ndarray,
+    typ_has: jnp.ndarray,  # (T, K) f32
+    typ_neg: jnp.ndarray,
+    offsets: Tuple[int, ...],
+    widths: Tuple[int, ...],
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """→ (S, T) bool compat matrix, one fused pallas_call."""
+    from jax.experimental import pallas as pl
+
+    S, W = sig_packed.shape
+    T = typ_packed.shape[0]
+    K = sig_has.shape[1]
+    # pad every axis to its tile multiple (lane/sublane alignment)
+    Sp = -(-S // TILE_S) * TILE_S
+    Tp = -(-T // TILE_T) * TILE_T
+    Kp = -(-max(K, 1) // LANE) * LANE
+    Wp = max(W, LANE)
+    sig_packed = jnp.pad(sig_packed, ((0, Sp - S), (0, Wp - W)))
+    typ_packed = jnp.pad(typ_packed, ((0, Tp - T), (0, Wp - W)))
+    sig_has = jnp.pad(sig_has, ((0, Sp - S), (0, Kp - K)))
+    sig_neg = jnp.pad(sig_neg, ((0, Sp - S), (0, Kp - K)))
+    typ_has = jnp.pad(typ_has, ((0, Tp - T), (0, Kp - K)))
+    typ_neg = jnp.pad(typ_neg, ((0, Tp - T), (0, Kp - K)))
+
+    kernel = functools.partial(
+        _compat_tile_kernel, offsets=offsets, widths=widths
+    )
+    grid = (Sp // TILE_S, Tp // TILE_T)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_S, Wp), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_T, Wp), lambda i, j: (j, 0)),
+            pl.BlockSpec((TILE_S, Kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_S, Kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_T, Kp), lambda i, j: (j, 0)),
+            pl.BlockSpec((TILE_T, Kp), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_S, TILE_T), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Sp, Tp), jnp.float32),
+        interpret=interpret,
+    )(sig_packed, typ_packed, sig_has, sig_neg, typ_has, typ_neg)
+    return out[:S, :T] > 0.0
+
+
+@functools.partial(jax.jit, static_argnames=("offsets", "widths", "interpret"))
+def allowed_pallas(
+    sig_packed: jnp.ndarray,  # (S, W) f32
+    sig_has: jnp.ndarray,  # (S, K) f32
+    sig_neg: jnp.ndarray,
+    valid: jnp.ndarray,  # (S,) bool
+    typ_packed: jnp.ndarray,  # (T, W) f32 — device-resident catalog side
+    typ_has: jnp.ndarray,
+    typ_neg: jnp.ndarray,
+    zone_ok: jnp.ndarray,  # (S, Z) bool
+    ct_ok: jnp.ndarray,  # (S, C) bool
+    avail: jnp.ndarray,  # (T, Z, C) bool — device-resident
+    offsets: Tuple[int, ...],
+    widths: Tuple[int, ...],
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Large-S twin of kernels.allowed_kernel: fused pallas compat ∧
+    offering in one dispatch, catalog tensors already on device."""
+    from .kernels import offering_kernel
+
+    compat = compat_pallas(
+        sig_packed, typ_packed, sig_has, sig_neg, typ_has, typ_neg,
+        offsets, widths, interpret=interpret,
+    )
+    compat = compat & valid[:, None]
+    return compat & offering_kernel(zone_ok, ct_ok, avail)
+
+
+def compat_via_pallas(
+    sig_arrays: Dict[str, np.ndarray],
+    type_masks: Dict[str, np.ndarray],
+    type_has: Dict[str, np.ndarray],
+    type_neg: Dict[str, np.ndarray],
+    keys: Tuple[str, ...],
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for kernels.compat_kernel taking the same host inputs.
+    Callers must route keys == () to the XLA path (no work to fuse)."""
+    assert keys, "compat_via_pallas requires at least one key"
+    sig_masks = {k: sig_arrays[f"mask:{k}"] for k in keys}
+    sig_has = {k: sig_arrays[f"has:{k}"] for k in keys}
+    sig_neg = {k: sig_arrays[f"neg:{k}"] for k in keys}
+    sp, sh, sn, offsets, widths = pack_masks(sig_masks, sig_has, sig_neg, keys)
+    tp, th, tn, t_offsets, t_widths = pack_masks(type_masks, type_has, type_neg, keys)
+    assert offsets == t_offsets and widths == t_widths, "sig/type chunk layouts must agree"
+    ok = compat_pallas(
+        jnp.asarray(sp),
+        jnp.asarray(tp),
+        jnp.asarray(sh),
+        jnp.asarray(sn),
+        jnp.asarray(th),
+        jnp.asarray(tn),
+        offsets,
+        widths,
+        interpret=interpret,
+    )
+    return ok & jnp.asarray(sig_arrays["valid"])[:, None]

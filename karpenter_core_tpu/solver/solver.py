@@ -18,6 +18,7 @@ exposes node count and total price for comparison.
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -70,6 +71,10 @@ class _CatalogEntry:
     vocab: Vocab
     axis: ResourceAxis
     enc: EncodedInstanceTypes
+    # device-resident packed type masks for the pallas compat path,
+    # keyed by a vocab-width snapshot so vocab growth triggers repack:
+    # (snapshot, (keys, tp, th, tn, offsets, widths, avail_dev))
+    device_packed: Optional[tuple] = None
 
 
 _CATALOG_CACHE: Dict[tuple, _CatalogEntry] = {}
@@ -111,6 +116,45 @@ def _catalog_entry(catalog: List[InstanceType]) -> _CatalogEntry:
         _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
     _CATALOG_CACHE[key] = entry
     return entry
+
+
+# signature count at which the fused pallas compat path pays for itself
+# (below it, dispatch latency dominates and the XLA path's smaller
+# transfers win; above it, the one-HBM-write fused kernel is ~2x the
+# XLA path device-side — see tests/test_pallas_compat.py). TPU-only:
+# other backends take the XLA path unless tests force interpret mode.
+_PALLAS_MIN_S = int(os.environ.get("KARPENTER_TPU_PALLAS_MIN_S", "256"))
+_PALLAS_INTERPRET_OK = os.environ.get("KARPENTER_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _entry_device_packed(entry: _CatalogEntry):
+    """Packed, device-resident type-side mask tensors for `entry`,
+    re-uploaded only when the vocab grew (pinned-buffer design from
+    SURVEY §6's latency-budget note)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .pallas_kernels import pack_masks
+
+    enc = entry.enc
+    snapshot = tuple(
+        (k, entry.vocab.key_vocab(k).size) for k in sorted(enc.key_masks.keys())
+    )
+    if entry.device_packed is not None and entry.device_packed[0] == snapshot:
+        return entry.device_packed[1]
+    keys = tuple(sorted(enc.key_masks.keys()))
+    tp, th, tn, offsets, widths = pack_masks(enc.key_masks, enc.key_has, enc.key_neg, keys)
+    data = (
+        keys,
+        jax.device_put(jnp.asarray(tp)),
+        jax.device_put(jnp.asarray(th)),
+        jax.device_put(jnp.asarray(tn)),
+        offsets,
+        widths,
+        jax.device_put(jnp.asarray(enc.offering_avail)),
+    )
+    entry.device_packed = (snapshot, data)
+    return data
 
 
 @dataclass
@@ -303,16 +347,56 @@ class TPUScheduler:
             sig_arrays = build_compat_inputs(compats, enc, e.vocab)
             keys = tuple(sorted(enc.key_masks.keys()))
             zone_ok, ct_ok = zone_ct_masks(compats, enc)
-            fut = allowed_kernel(
-                {k: np.asarray(v) for k, v in sig_arrays.items()},
-                enc.key_masks,
-                enc.key_has,
-                enc.key_neg,
-                zone_ok,
-                ct_ok,
-                enc.offering_avail,
-                keys,
-            )
+            import jax
+
+            backend = jax.default_backend()
+            if (
+                len(compats) >= _PALLAS_MIN_S
+                and keys
+                and (backend == "tpu" or _PALLAS_INTERPRET_OK)
+            ):
+                # large-S regime: fused pallas kernel against the
+                # device-resident packed catalog (sig side is the only
+                # per-solve transfer)
+                from .pallas_kernels import allowed_pallas, pack_masks
+
+                p_keys, tp, th, tn, offsets, widths, avail_dev = _entry_device_packed(e)
+                sp, sh, sn, s_offsets, s_widths = pack_masks(
+                    {k: sig_arrays[f"mask:{k}"] for k in p_keys},
+                    {k: sig_arrays[f"has:{k}"] for k in p_keys},
+                    {k: sig_arrays[f"neg:{k}"] for k in p_keys},
+                    p_keys,
+                )
+                assert s_offsets == offsets and s_widths == widths, (
+                    "sig/type chunk layouts diverged — vocab grew between "
+                    "snapshot and pack"
+                )
+                fut = allowed_pallas(
+                    sp,
+                    sh,
+                    sn,
+                    sig_arrays["valid"],
+                    tp,
+                    th,
+                    tn,
+                    zone_ok,
+                    ct_ok,
+                    avail_dev,
+                    offsets,
+                    widths,
+                    interpret=backend != "tpu",
+                )
+            else:
+                fut = allowed_kernel(
+                    {k: np.asarray(v) for k, v in sig_arrays.items()},
+                    enc.key_masks,
+                    enc.key_has,
+                    enc.key_neg,
+                    zone_ok,
+                    ct_ok,
+                    enc.offering_avail,
+                    keys,
+                )
             pending.append((fut, zone_ok, ct_ok))
 
         # --- per-pod encoding (overlapped with the device dispatch) -----
