@@ -28,6 +28,10 @@ CONSOLIDATION_TTL = 15.0  # consolidation.go:25
 MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:34
 SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0  # singlenodeconsolidation.go:29
 MAX_PARALLEL = 100  # multinodeconsolidation.go:58
+# with the TPU prefix screen, the O(log N) per-probe simulations that
+# forced the reference's 100-candidate cap become one batched dispatch;
+# the cap rises to bound only the post-screen oracle verification
+MAX_PARALLEL_TPU_SCREEN = 1000
 
 
 class Method:
@@ -217,7 +221,8 @@ class MultiNodeConsolidation(ConsolidationBase):
         if self.is_consolidated():
             return Command()
         candidates = self.sort_and_filter(candidates)
-        max_parallel = min(len(candidates), MAX_PARALLEL)
+        cap = MAX_PARALLEL_TPU_SCREEN if self.use_tpu_screen else MAX_PARALLEL
+        max_parallel = min(len(candidates), cap)
         cmd = self.first_n_consolidation(candidates, max_parallel)
         if cmd.action() == ACTION_NOOP:
             self.mark_consolidated()
@@ -244,7 +249,10 @@ class MultiNodeConsolidation(ConsolidationBase):
                 # try the screened k first, then fall down
                 order = list(range(k, 1, -1))
         if order is None:
-            return self._binary_search(candidates, max_n, deadline)
+            # no usable screen result: the raised TPU cap would make each
+            # binary-search probe a near-1000-candidate simulation — fall
+            # back to the reference's bound (multinodeconsolidation.go:58)
+            return self._binary_search(candidates, min(max_n, MAX_PARALLEL), deadline)
 
         attempted_min = order[0]
         for k in order[:4]:  # bounded verification attempts
@@ -255,8 +263,11 @@ class MultiNodeConsolidation(ConsolidationBase):
                 return cmd
             attempted_min = k
         # screen over-estimated; binary search the untried sizes below the
-        # smallest prefix we actually attempted
-        return self._binary_search(candidates, min(max_n, attempted_min - 1), deadline)
+        # smallest prefix we actually attempted, capped so each probe's
+        # simulation stays reference-sized
+        return self._binary_search(
+            candidates, min(max_n, attempted_min - 1, MAX_PARALLEL), deadline
+        )
 
     def _attempt(self, prefix: List[Candidate]) -> Optional[Command]:
         cmd = self.compute_consolidation(prefix)

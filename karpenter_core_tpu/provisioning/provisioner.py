@@ -244,11 +244,26 @@ class Provisioner:
         nc.spec = NodeClaimSpec(
             taints=list(template.taints),
             startup_taints=list(template.startup_taints),
-            requirements=[
-                NodeSelectorRequirement(wk.LABEL_INSTANCE_TYPE, "In", [plan.instance_type.name]),
-                NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", [plan.zone]),
-                NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [plan.capacity_type]),
-            ],
+            requirements=(
+                [
+                    NodeSelectorRequirement(wk.LABEL_INSTANCE_TYPE, "In", [plan.instance_type.name]),
+                    NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", [plan.zone]),
+                    NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [plan.capacity_type]),
+                ]
+                # the solver's merged (template ∩ pods) requirements —
+                # the launched node must carry every label the member
+                # pods select on (nodeclaimtemplate.go:55 stamping)
+                + [
+                    r.to_node_selector_requirement()
+                    for r in (plan.requirements.values() if plan.requirements else [])
+                    if r.key
+                    not in (
+                        wk.LABEL_INSTANCE_TYPE,
+                        wk.LABEL_TOPOLOGY_ZONE,
+                        wk.CAPACITY_TYPE_LABEL_KEY,
+                    )
+                ]
+            ),
             kubelet=template.kubelet,
             node_class_ref=template.node_class_ref,
         )

@@ -28,7 +28,7 @@ from ..apis import labels as wk
 from ..apis.nodepool import NodePool, order_by_weight
 from ..cloudprovider.types import CloudProvider, InstanceType
 from ..kube.objects import Pod
-from ..scheduling import Taints, resources
+from ..scheduling import Requirements, Taints, resources
 from ..scheduling.requirements import node_selector_requirements
 from .encode import (
     EncodedInstanceTypes,
@@ -79,6 +79,19 @@ class _CatalogEntry:
 
 _CATALOG_CACHE: Dict[tuple, _CatalogEntry] = {}
 _CATALOG_CACHE_MAX = 8
+
+
+def _requirements_fingerprint(reqs) -> tuple:
+    """Canonical identity of a merged Requirements set (full algebra:
+    operator polarity, values, Gt/Lt bounds) for class-merge equality."""
+    if reqs is None:
+        return ()
+    return tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in reqs.values()
+        )
+    )
 
 
 def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
@@ -168,6 +181,10 @@ class NodePlan:
     price: float
     pod_indices: List[int]  # into the solve batch
     pods: Optional[List[Pod]] = None  # resolved by the provisioner for events
+    # merged (template ∩ pods) requirement set for the node — stamped
+    # onto the NodeClaim so the launched node carries every label the
+    # member pods select on (nodeclaimtemplate.go:55)
+    requirements: Optional[object] = None
     # this plan's pods' exact request dicts (nanos) — merged lazily off
     # the solve's critical path (only read at NodeClaim-creation time)
     _pod_requests: Optional[list] = field(default=None, repr=False)
@@ -231,6 +248,7 @@ class TPUScheduler:
     ) -> SolverResult:
         result = SolverResult()
         self._frontier_cache: Dict[tuple, np.ndarray] = {}
+        self._alloc_full_cache: Dict[tuple, np.ndarray] = {}
         groups = group_pods(pods)
         relational = [g for g in groups if g.has_relational]
         tensor_groups = [g for g in groups if not g.has_relational]
@@ -435,46 +453,61 @@ class TPUScheduler:
         # then finalize (single dispatch + single host sync per solve)
         jobs: List[tuple] = []
         metas: List[dict] = []
+        # pass 1: pool choice per signature group (scheduler.go:256-283)
+        infos: List[dict] = []
         for gi, group in enumerate(groups):
-            self._prepare_group_jobs(
-                gi,
-                group,
-                pods,
-                matrices,
-                pool_entries,
-                pools,
-                encoded,
-                sig_compats,
-                allowed_per_pool,
-                daemon_requests,
-                result,
-                jobs,
-                metas,
+            info = self._choose_pool(
+                gi, group, pods, pools, encoded, sig_compats, allowed_per_pool, result
             )
+            if info is not None:
+                infos.append(info)
+        # pass 2: class-merged jobs — groups with identical pool/mask
+        # fingerprints pack TOGETHER, and unpinned pods ride along into
+        # zone-spread buckets (the oracle mixes compatible pods onto
+        # shared nodes; per-group packing alone makes strictly more
+        # nodes whenever a batch must fan out across zones anyway)
+        self._prepare_class_jobs(
+            infos,
+            pods,
+            matrices,
+            pool_entries,
+            pools,
+            encoded,
+            daemon_requests,
+            result,
+            jobs,
+            metas,
+        )
         packed = batch_pack(jobs)
+        records: List[dict] = []
+        # small plans: every (uncapped) node joins the merge pass — the
+        # oracle also back-fills leftover space on full nodes. Large
+        # plans: only underfull tails (bounds the O(N·K·T) merge cost).
+        total_nodes = sum(int(c) for _, c in packed)
+        merge_all = total_nodes <= 256
         for meta, (node_ids, node_count) in zip(metas, packed):
-            self._finalize_job(meta, node_ids, node_count, pods, result)
+            self._finalize_job(meta, node_ids, node_count, pods, result, records, merge_all)
+        # cross-group consolidation: merge underfull tail nodes whose
+        # requirement/offering intersections still admit a shared type
+        # (the oracle mixes compatible pods freely — scheduler.go:143-147's
+        # alternating-A,B canary; per-group packing alone can't)
+        self._merge_and_emit(records, pods, result)
 
     # ------------------------------------------------------------------
 
-    def _prepare_group_jobs(
+    def _choose_pool(
         self,
         gi: int,
         group: SignatureGroup,
         pods: List[Pod],
-        matrices: Dict[int, tuple],
-        pool_entries: List["_CatalogEntry"],
         pools: List[PoolEncoding],
         encoded: List[EncodedInstanceTypes],
         sig_compats,
         allowed_per_pool,
-        daemon_requests,
         result: SolverResult,
-        jobs: List[tuple],
-        metas: List[dict],
-    ) -> None:
-        # first pool (weight order) whose template accepts the signature and
-        # offers at least one viable type (scheduler.go:256-283)
+    ) -> Optional[dict]:
+        """First pool (weight order) whose template accepts the signature
+        and offers at least one viable type (scheduler.go:256-283)."""
         chosen = None
         for pi, pool in enumerate(pools):
             compat_row = allowed_per_pool[pi][0][gi]
@@ -488,21 +521,7 @@ class TPUScheduler:
             )
             for i in group.pod_indices:
                 result.pod_errors[pods[i].uid] = err
-            return
-
-        pool = pools[chosen]
-        enc = encoded[chosen]
-        viable = allowed_per_pool[chosen][0][gi]  # (T,) bool
-        zone_ok = allowed_per_pool[chosen][1][gi]  # (Z,)
-        ct_ok = allowed_per_pool[chosen][2][gi]  # (C,)
-        daemon = daemon_requests[pool.nodepool.name]
-        requests_matrix = matrices[id(pool_entries[chosen])][1]
-
-        idx = np.array(group.pod_indices, dtype=np.int64)
-        reqs = requests_matrix[idx]
-        # descending by primary resource then memory (queue.go:76 ordering)
-        order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
-        idx, reqs = idx[order], reqs[order]
+            return None
 
         # per-pod max-pods-per-node from hostname spread / self anti-affinity
         max_per_node = np.int32(2**31 - 1)
@@ -512,9 +531,81 @@ class TPUScheduler:
         if group.hostname_isolated:
             max_per_node = np.int32(1)
 
-        zone_spread = group.zone_spread()
-        if zone_spread is not None:
-            # zone sub-batches, balanced round-robin = min-skew assignment
+        return dict(
+            group=group,
+            chosen=chosen,
+            viable=allowed_per_pool[chosen][0][gi],  # (T,) bool
+            zone_ok=allowed_per_pool[chosen][1][gi],  # (Z,)
+            ct_ok=allowed_per_pool[chosen][2][gi],  # (C,)
+            max_per_node=max_per_node,
+            merged=sig_compats[chosen][gi].merged,  # template ∩ pod reqs
+        )
+
+    def _prepare_class_jobs(
+        self,
+        infos: List[dict],
+        pods: List[Pod],
+        matrices: Dict[int, tuple],
+        pool_entries: List["_CatalogEntry"],
+        pools: List[PoolEncoding],
+        encoded: List[EncodedInstanceTypes],
+        daemon_requests,
+        result: SolverResult,
+        jobs: List[tuple],
+        metas: List[dict],
+    ) -> None:
+        # groups are interchangeable for packing only when their FULL
+        # merged requirement sets agree — the (viable, zone, ct) masks
+        # alone miss requirement keys that don't project onto catalog
+        # dimensions (e.g. custom node labels: team=a vs team=b yield
+        # identical masks but can never share a node). Hostname-capped
+        # groups stay solo (their cap is enforced per job).
+        classes: Dict[tuple, List[dict]] = {}
+        for info in infos:
+            if int(info["max_per_node"]) < 2**31 - 1:
+                key = ("solo", id(info["group"]))
+            else:
+                key = (
+                    info["chosen"],
+                    info["viable"].tobytes(),
+                    info["zone_ok"].tobytes(),
+                    info["ct_ok"].tobytes(),
+                    _requirements_fingerprint(info["merged"]),
+                )
+            classes.setdefault(key, []).append(info)
+
+        for members in classes.values():
+            chosen = members[0]["chosen"]
+            pool, enc = pools[chosen], encoded[chosen]
+            viable = members[0]["viable"]
+            zone_ok, ct_ok = members[0]["zone_ok"], members[0]["ct_ok"]
+            max_per_node = members[0]["max_per_node"]
+            merged = members[0]["merged"]
+            daemon = daemon_requests[pool.nodepool.name]
+            requests_matrix = matrices[id(pool_entries[chosen])][1]
+
+            spread = [m for m in members if m["group"].zone_spread() is not None]
+            plain = [m for m in members if m["group"].zone_spread() is None]
+
+            def sorted_idx(groups_pods: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+                idx = np.asarray(groups_pods, dtype=np.int64)
+                reqs = requests_matrix[idx]
+                # descending by primary then memory (queue.go:76 ordering)
+                order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+                return idx[order], reqs[order]
+
+            if not spread:
+                idx, reqs = sorted_idx([i for m in members for i in m["group"].pod_indices])
+                self._prepare_job(
+                    idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
+                    pool, pods, result, jobs, metas, merged=merged,
+                )
+                continue
+
+            # zone buckets: every spread GROUP round-robins its own pods
+            # (per-group balance = min-skew, topologygroup.go:93); plain
+            # pods of the class ride along round-robin — they must land
+            # somewhere, and these nodes already exist
             zones = [z for zi, z in enumerate(enc.zones) if zone_ok[zi]]
             zone_types = {
                 z: viable & enc.offering_avail[:, enc.zones.index(z), :][:, ct_ok].any(axis=1)
@@ -522,24 +613,48 @@ class TPUScheduler:
             }
             zones = [z for z in zones if zone_types[z].any()]
             if not zones:
-                for i in group.pod_indices:
-                    result.pod_errors[pods[i].uid] = "no zone with viable offering for topology spread"
-                return
-            buckets = {z: [] for z in zones}
-            for j, i in enumerate(idx):
-                buckets[zones[j % len(zones)]].append(j)
+                for m in spread:
+                    for i in m["group"].pod_indices:
+                        result.pod_errors[pods[i].uid] = (
+                            "no zone with viable offering for topology spread"
+                        )
+                if plain:
+                    idx, reqs = sorted_idx([i for m in plain for i in m["group"].pod_indices])
+                    self._prepare_job(
+                        idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
+                        pool, pods, result, jobs, metas, merged=merged,
+                    )
+                continue
+
+            buckets: Dict[str, List[int]] = {z: [] for z in zones}
+            for m in spread:
+                g_idx, _ = sorted_idx(m["group"].pod_indices)
+                for j, i in enumerate(g_idx):
+                    buckets[zones[j % len(zones)]].append(int(i))
+            # plain pods ride along only when zone choice doesn't shrink
+            # the viable set — otherwise a pod needing a type offered in
+            # one zone could be round-robined into a bucket without it
+            ride_along = plain and all(
+                bool(np.array_equal(zone_types[z], viable)) for z in zones
+            )
+            if ride_along:
+                p_idx, _ = sorted_idx([i for m in plain for i in m["group"].pod_indices])
+                for j, i in enumerate(p_idx):
+                    buckets[zones[j % len(zones)]].append(int(i))
+            elif plain:
+                idx, reqs = sorted_idx([i for m in plain for i in m["group"].pod_indices])
+                self._prepare_job(
+                    idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
+                    pool, pods, result, jobs, metas, merged=merged,
+                )
             for z in zones:
                 if buckets[z]:
-                    sel = np.array(buckets[z])
+                    idx, reqs = sorted_idx(buckets[z])
                     self._prepare_job(
-                        idx[sel], reqs[sel], enc, zone_types[z], zone_ok, ct_ok, daemon,
+                        idx, reqs, enc, zone_types[z], zone_ok, ct_ok, daemon,
                         max_per_node, pool, pods, result, jobs, metas, zone=z,
+                        merged=merged,
                     )
-        else:
-            self._prepare_job(
-                idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node, pool,
-                pods, result, jobs, metas,
-            )
 
     # ------------------------------------------------------------------
 
@@ -559,21 +674,16 @@ class TPUScheduler:
         jobs: List[tuple],
         metas: List[dict],
         zone: Optional[str] = None,
+        merged=None,
     ) -> None:
         viable_idx = np.flatnonzero(viable)
         if len(viable_idx) == 0:
             for i in idx:
                 result.pod_errors[pods[i].uid] = "no viable instance type"
             return
-        alloc = enc.allocatable[viable_idx]
-        if alloc.shape[1] < daemon.shape[0]:
-            # pod-only extended resources: zero capacity columns (pods
-            # requesting them are unschedulable — reference fits semantics)
-            alloc = np.concatenate(
-                [alloc, np.zeros((alloc.shape[0], daemon.shape[0] - alloc.shape[1]), np.int32)],
-                axis=1,
-            )
-        alloc = np.maximum(alloc - daemon[None, :], 0)  # daemon overhead off the top
+        # daemon-adjusted allocatable (shared with the merge pass so the
+        # pack-time and merge-time capacity views can't diverge)
+        alloc = self._alloc_full(enc, daemon)[viable_idx].astype(np.int32)
         # zone buckets of one group share viable sets — cache the frontier
         cache_key = (id(enc), viable_idx.tobytes(), daemon.tobytes())
         frontier = self._frontier_cache.get(cache_key)
@@ -592,11 +702,21 @@ class TPUScheduler:
                 ct_ok=ct_ok,
                 pool=pool,
                 zone=zone,
+                daemon=daemon,
+                max_per_node=int(max_per_node),
+                merged=merged,
             )
         )
 
     def _finalize_job(
-        self, meta: dict, node_ids: np.ndarray, node_count: int, pods: List[Pod], result: SolverResult
+        self,
+        meta: dict,
+        node_ids: np.ndarray,
+        node_count: int,
+        pods: List[Pod],
+        result: SolverResult,
+        records: List[dict],
+        merge_all: bool = False,
     ) -> None:
         idx, reqs, enc = meta["idx"], meta["reqs"], meta["enc"]
         viable_idx, alloc = meta["viable_idx"], meta["alloc"]
@@ -626,6 +746,11 @@ class TPUScheduler:
             )
 
         chosen_types = assign_cheapest_types(usage, alloc, prices)
+        # underfull ⇔ half the elementwise-max viable allocatable still
+        # holds the load — those tail nodes go to the merge pass
+        alloc_cap = alloc.max(axis=0)
+        viable_bool = np.zeros(len(enc.instance_types), dtype=bool)
+        viable_bool[viable_idx] = True
         # group pod indices by node in one argsort pass (not O(N·P) masks)
         valid = node_ids >= 0
         order = np.argsort(node_ids[valid], kind="stable")
@@ -638,6 +763,29 @@ class TPUScheduler:
             if ti < 0:
                 for i in members:
                     result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
+                continue
+            # hostname-spread / anti-affinity capped groups never merge:
+            # collapsing their nodes would re-concentrate the very pods
+            # the constraint spreads (max 1-per-node etc.)
+            mergeable = meta["max_per_node"] >= 2**31 - 1
+            if mergeable and (
+                merge_all or np.all(usage[n].astype(np.int64) * 2 <= alloc_cap)
+            ):
+                records.append(
+                    dict(
+                        enc=enc,
+                        pool=pool,
+                        zone=zone,
+                        zone_ok=zone_ok.copy(),
+                        ct_ok=ct_ok.copy(),
+                        viable=viable_bool,
+                        usage=usage[n].astype(np.int64),
+                        members=members,
+                        daemon=meta["daemon"],
+                        alloc_cap=alloc_cap,
+                        merged=meta["merged"],
+                    )
+                )
                 continue
             it = enc.instance_types[int(viable_idx[ti])]
             # concrete offering: cheapest allowed for that type (zone-pinned)
@@ -652,9 +800,139 @@ class TPUScheduler:
                     capacity_type=offering_ct,
                     price=offering_price,
                     pod_indices=members,
+                    requirements=meta["merged"],
                     _pod_requests=[self._all_requests[i] for i in members],
                 )
             )
+
+    # ------------------------------------------------------------------
+
+    _MERGE_SCAN_CAP = 64  # K-open bound on the first-fit merge scan
+
+    def _alloc_full(self, enc: EncodedInstanceTypes, daemon: np.ndarray) -> np.ndarray:
+        """(T, R_ext) daemon-adjusted allocatable over the whole catalog."""
+        key = (id(enc), daemon.tobytes())
+        cached = self._alloc_full_cache.get(key)
+        if cached is not None:
+            return cached
+        alloc = enc.allocatable.astype(np.int64)
+        if alloc.shape[1] < daemon.shape[0]:
+            alloc = np.concatenate(
+                [alloc, np.zeros((alloc.shape[0], daemon.shape[0] - alloc.shape[1]), np.int64)],
+                axis=1,
+            )
+        alloc = np.maximum(alloc - daemon[None, :].astype(np.int64), 0)
+        self._alloc_full_cache[key] = alloc
+        return alloc
+
+    def _merge_and_emit(self, records: List[dict], pods: List[Pod], result: SolverResult) -> None:
+        """Greedy first-fit merge of underfull planned nodes across
+        signature groups. A merge is legal when the nodes share a pool,
+        their zone pins agree (pods never change zones, so topology-
+        spread counts are untouched), the intersected zone/capacity-type
+        masks stay nonempty, and some commonly-viable instance type
+        holds the combined load with an available offering."""
+        if not records:
+            return
+        records.sort(key=lambda r: -int(r["usage"][0]))
+        merged: List[dict] = []
+        for r in records:
+            placed = False
+            for m in merged[: self._MERGE_SCAN_CAP]:
+                if m["enc"] is not r["enc"] or m["pool"] is not r["pool"]:
+                    continue
+                if m["zone"] is not None and r["zone"] is not None and m["zone"] != r["zone"]:
+                    continue
+                enc = r["enc"]
+                zone = m["zone"] if m["zone"] is not None else r["zone"]
+                zone_ok = m["zone_ok"] & r["zone_ok"]
+                ct_ok = m["ct_ok"] & r["ct_ok"]
+                if not zone_ok.any() or not ct_ok.any():
+                    continue
+                if zone is not None and not zone_ok[enc.zones.index(zone)]:
+                    continue
+                viable = m["viable"] & r["viable"]
+                if not viable.any():
+                    continue
+                # the full requirement sets must intersect per key — the
+                # mask projections miss custom node-label keys (team=a
+                # vs team=b pods can never share a node)
+                if (
+                    m["merged"] is None
+                    or r["merged"] is None
+                    or m["merged"].intersects(r["merged"]) is not None
+                ):
+                    continue
+                usage = m["usage"] + r["usage"]
+                # cheap reject: combined load exceeds even the elementwise
+                # max of both sides' viable capacities
+                if np.any(usage > np.minimum(m["alloc_cap"], r["alloc_cap"])):
+                    continue
+                alloc = self._alloc_full(enc, r["daemon"])
+                fits = viable & np.all(usage[None, :] <= alloc, axis=1)
+                if not fits.any():
+                    continue
+                zmask = zone_ok
+                if zone is not None:
+                    zmask = np.zeros(len(enc.zones), dtype=bool)
+                    zmask[enc.zones.index(zone)] = True
+                off_ok = enc.offering_avail[:, zmask][:, :, ct_ok].any(axis=(1, 2))
+                if not (fits & off_ok).any():
+                    continue
+                combined = Requirements(*m["merged"].values_list())
+                combined.add(*r["merged"].values_list())
+                m.update(
+                    usage=usage,
+                    zone=zone,
+                    zone_ok=zone_ok,
+                    ct_ok=ct_ok,
+                    viable=viable,
+                    merged=combined,
+                )
+                m["members"].extend(r["members"])
+                placed = True
+                break
+            if not placed:
+                merged.append(dict(r, members=list(r["members"])))
+        for m in merged:
+            self._emit_record(m, pods, result)
+
+    def _emit_record(self, m: dict, pods: List[Pod], result: SolverResult) -> None:
+        enc, zone_ok, ct_ok, zone = m["enc"], m["zone_ok"], m["ct_ok"], m["zone"]
+        usage = m["usage"]
+        alloc = self._alloc_full(enc, m["daemon"])
+        fits = m["viable"] & np.all(usage[None, :] <= alloc, axis=1)
+        zmask = zone_ok
+        if zone is not None:
+            zmask = np.zeros(len(enc.zones), dtype=bool)
+            zmask[enc.zones.index(zone)] = True
+        prices = enc.offering_price[:, zmask][:, :, ct_ok].reshape(len(fits), -1)
+        p = (
+            np.where(np.isfinite(prices), prices, np.inf).min(axis=1)
+            if prices.size
+            else np.full(len(fits), np.inf)
+        )
+        p = np.where(fits, p, np.inf)
+        t = int(np.argmin(p))
+        if not np.isfinite(p[t]):
+            for i in m["members"]:
+                result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
+            return
+        offering_zone, offering_ct, offering_price = self._cheapest_offering(
+            enc, t, zone_ok, ct_ok, zone
+        )
+        result.node_plans.append(
+            NodePlan(
+                nodepool_name=m["pool"].nodepool.name,
+                instance_type=enc.instance_types[t],
+                zone=offering_zone,
+                capacity_type=offering_ct,
+                price=offering_price,
+                pod_indices=m["members"],
+                requirements=m["merged"],
+                _pod_requests=[self._all_requests[i] for i in m["members"]],
+            )
+        )
 
     @staticmethod
     def _cheapest_offering(

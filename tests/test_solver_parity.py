@@ -250,3 +250,85 @@ class TestRegressions:
         tpu = tpu_solve(pods, [make_nodepool()], provider)
         assert len(tpu.node_plans) == 2
         assert sorted(len(p.pod_indices) for p in tpu.node_plans) == [2, 2]
+
+
+class TestCrossGroupPacking:
+    """Class-merged packing + cross-group node merge (the alternating
+    A,B canary, scheduler.go:143-147) must mix only truly-compatible
+    groups."""
+
+    def test_disjoint_custom_labels_never_share_a_node(self):
+        from karpenter_core_tpu.kube.objects import NodeSelectorRequirement as NSR
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        np_ = make_nodepool()
+        np_.spec.template.requirements = [NSR("team", "In", ["a", "b"])]
+        pods = [
+            make_pod(requests={"cpu": "100m"}, node_selector={"team": "a"})
+            for _ in range(3)
+        ] + [
+            make_pod(requests={"cpu": "100m"}, node_selector={"team": "b"})
+            for _ in range(3)
+        ]
+        tpu = tpu_solve(pods, [np_], provider)
+        assert not tpu.pod_errors
+        assert tpu.node_count == 2  # one per team; never merged
+        for plan in tpu.node_plans:
+            teams = set()
+            for i in plan.pod_indices:
+                teams.add(pods[i].spec.node_selector["team"])
+            assert len(teams) == 1
+            # the stamped requirements pin the node's team label
+            assert plan.requirements is not None
+            req = plan.requirements.get_req("team")
+            assert req.values == teams
+
+    def test_compatible_groups_do_share_a_node(self):
+        """Alternating A,B with compatible constraints packs together
+        (the canary: per-group packing alone would make 2 nodes)."""
+        from karpenter_core_tpu.kube.objects import Toleration
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        nodepools = [make_nodepool()]
+        pods = []
+        for i in range(8):
+            if i % 2:
+                pods.append(make_pod(requests={"cpu": "100m"},
+                                     tolerations=[Toleration(key="x", operator="Exists")]))
+            else:
+                pods.append(make_pod(requests={"cpu": "100m"}))
+        tpu = tpu_solve(pods, nodepools, provider)
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        assert not tpu.pod_errors
+        assert tpu.node_count == len(oracle.new_node_claims) == 1
+
+    def test_constrained_mix_matches_oracle_node_count(self):
+        """The config-3-style mix (selectors + tolerations + zone spread)
+        packs to the oracle's node count exactly."""
+        from karpenter_core_tpu.kube.objects import LabelSelector, Toleration
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(50)
+        nodepools = [make_nodepool()]
+        rng = np.random.RandomState(4)
+        pods = []
+        for i in range(450):
+            sel = tol = topo = None
+            labels = {"app": f"svc-{i % 9}"}
+            r = i % 9
+            if r < 3:
+                sel = {wk.CAPACITY_TYPE_LABEL_KEY: ["spot", "on-demand"][i % 2]}
+            elif r < 5:
+                tol = [Toleration(key="dedicated", operator="Exists")]
+            elif r < 7:
+                topo = [spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": labels["app"]})]
+            cpu = ["100m", "250m", "500m", "1"][rng.randint(4)]
+            pods.append(make_pod(requests={"cpu": cpu}, node_selector=sel,
+                                 tolerations=tol, topology_spread=topo, labels=labels))
+        tpu = tpu_solve(pods, nodepools, provider)
+        oracle = oracle_solve(list(pods), nodepools, provider)
+        assert not tpu.pod_errors
+        o_nodes = len(oracle.new_node_claims)
+        assert abs(tpu.node_count - o_nodes) <= max(1, round(0.01 * o_nodes))
