@@ -253,9 +253,12 @@ def config5() -> None:
     """Spot-price-weighted packing: 2k types x 6 zones, cost objective."""
     from karpenter_core_tpu.apis import labels as wk
     from karpenter_core_tpu.apis.nodepool import NodePool
-    from karpenter_core_tpu.cloudprovider.fake import new_instance_type, price_from_resources
+    from karpenter_core_tpu.cloudprovider.fake import (
+        FakeCloudProvider,
+        new_instance_type,
+        price_from_resources,
+    )
     from karpenter_core_tpu.cloudprovider.types import Offering
-    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
     from karpenter_core_tpu.kube.quantity import parse_quantity
     from karpenter_core_tpu.solver import TPUScheduler
 

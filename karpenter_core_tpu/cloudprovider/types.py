@@ -18,6 +18,7 @@ from ..apis.nodeclaim import NodeClaim
 from ..apis.nodepool import NodePool
 from ..kube.objects import ResourceList
 from ..scheduling import Requirements, resources
+from ..utils.atomic import Lazy
 
 
 @dataclass
@@ -86,12 +87,14 @@ class InstanceType:
         self.offerings = Offerings(offerings)
         self.capacity = capacity
         self.overhead = overhead or InstanceTypeOverhead()
-        self._allocatable: Optional[ResourceList] = None
+        # thread-safe memoization (the cluster-state scrapers and solver
+        # read catalogs from concurrent reconcilers)
+        self._allocatable = Lazy(
+            lambda: resources.subtract(self.capacity, self.overhead.total())
+        )
 
     def allocatable(self) -> ResourceList:
-        if self._allocatable is None:
-            self._allocatable = resources.subtract(self.capacity, self.overhead.total())
-        return dict(self._allocatable)
+        return dict(self._allocatable.get())
 
     def __repr__(self) -> str:
         return f"InstanceType({self.name})"

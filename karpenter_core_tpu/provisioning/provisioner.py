@@ -10,6 +10,7 @@ same NodeClaim CRs the oracle path stamps, keeping everything downstream
 from __future__ import annotations
 
 import concurrent.futures
+import logging
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -23,6 +24,7 @@ from ..scheduler.scheduler import Results, SchedulerOptions
 from ..scheduler.volumetopology import VolumeTopology
 from ..state.cluster import Cluster
 from ..utils import pod as podutils
+from ..utils.pretty import ChangeMonitor
 from .batcher import Batcher
 
 
@@ -56,6 +58,7 @@ class Provisioner:
         self.batcher = batcher or Batcher()
         self.use_tpu_solver = use_tpu_solver
         self.metrics = metrics
+        self._change_monitor = ChangeMonitor()
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -129,6 +132,12 @@ class Provisioner:
             if np_.metadata.deletion_timestamp is None
         ]
         if not nodepools:
+            # once-per-hour dedup'd warning (provisioner.go:182-199 via
+            # pretty.ChangeMonitor)
+            if self._change_monitor.has_changed("no-nodepools", True):
+                logging.getLogger("karpenter").warning(
+                    "no nodepools found; provisioning is disabled until one is created"
+                )
             return Results()
         # pure pending-pod batches go straight to the TPU path — building
         # the greedy scheduler would duplicate all of its setup work

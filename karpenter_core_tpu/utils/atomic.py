@@ -1,0 +1,41 @@
+"""Thread-safe lazy value (ref pkg/utils/atomic Lazy).
+
+The reference caches expensive lookups (e.g. resolved kubelet configs)
+behind atomic.Lazy (atomic/lazy.go). Python equivalent: double-checked
+lock around a resolve callable, with explicit Set/Reset for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+_UNSET = object()
+
+
+class Lazy(Generic[T]):
+    def __init__(self, resolve: Optional[Callable[[], T]] = None):
+        self._resolve = resolve
+        self._value: object = _UNSET
+        self._lock = threading.Lock()
+
+    def get(self, resolve: Optional[Callable[[], T]] = None) -> T:
+        if self._value is not _UNSET:
+            return self._value  # type: ignore[return-value]
+        with self._lock:
+            if self._value is _UNSET:
+                fn = resolve or self._resolve
+                if fn is None:
+                    raise ValueError("Lazy has no resolver")
+                self._value = fn()
+        return self._value  # type: ignore[return-value]
+
+    def set(self, value: T) -> None:
+        with self._lock:
+            self._value = value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = _UNSET

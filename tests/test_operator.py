@@ -119,3 +119,29 @@ class TestOperator:
         op.kube_client.create(nc)
         assert not op.healthy()  # claim without provider id
         op.stop()
+
+
+class TestUtils:
+    def test_change_monitor_dedupes_within_window(self):
+        from karpenter_core_tpu.utils.pretty import ChangeMonitor
+
+        t = [0.0]
+        cm = ChangeMonitor(window_seconds=10.0, clock=lambda: t[0])
+        assert cm.has_changed("k", "v")
+        assert not cm.has_changed("k", "v")  # same value, inside window
+        assert cm.has_changed("k", "w")  # changed value logs
+        t[0] = 20.0
+        assert cm.has_changed("k", "w")  # window expired
+
+    def test_lazy_resolves_once(self):
+        from karpenter_core_tpu.utils.atomic import Lazy
+
+        calls = []
+        lz = Lazy(lambda: calls.append(1) or "x")
+        assert lz.get() == "x"
+        assert lz.get() == "x"
+        assert len(calls) == 1
+        lz.set("y")
+        assert lz.get() == "y"
+        lz.reset()
+        assert lz.get() == "x"

@@ -1,0 +1,325 @@
+"""Scheduler behavior suite, second batch — ports of reference specs our
+first batch skipped (suite_test.go, topology_test.go,
+instance_selection_test.go): min-domains, combined spreads, host ports
+on open claims, volume zone injection + CSI limits, preferred
+pod-affinity relaxation, weighted-pool fallback, in-flight claim reuse,
+selector operators, startup-taint scheduling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod, spread
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+    new_instance_type,
+)
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import (
+    LabelSelector,
+    NodeSelectorRequirement,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    StorageClass,
+    Taint,
+    Volume,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+
+
+def solve(pods, nodepools, provider, kube=None, state_nodes=None, daemonsets=None):
+    s = build_scheduler(
+        kube, None, nodepools, provider, pods,
+        state_nodes=state_nodes, daemonset_pods=daemonsets,
+    )
+    return s.solve(pods)
+
+
+@pytest.fixture
+def provider():
+    p = FakeCloudProvider()
+    p.instance_types = instance_types(10)
+    return p
+
+
+class TestMinDomains:
+    def test_min_domains_spreads_beyond_needed(self, provider):
+        """minDomains forces at least N zone domains even when one node
+        would hold every pod (topologygroup.go minDomains handling)."""
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "a"}),
+            min_domains=3,
+        )
+        pods = [
+            make_pod(labels={"app": "a"}, requests={"cpu": "100m"}, topology_spread=[c])
+            for _ in range(3)
+        ]
+        res = solve(pods, [make_nodepool()], provider)
+        assert not res.pod_errors
+        zones = set()
+        for nc in res.new_node_claims:
+            req = nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE)
+            zones.update(req.values)
+        assert len(zones) >= 3
+
+
+class TestCombinedSpreads:
+    def test_zone_and_hostname_spread_together(self, provider):
+        """The benchmark's own pod shape: zone spread AND hostname spread
+        on one pod (scheduling_benchmark_test.go:184-196)."""
+        pods = [
+            make_pod(
+                labels={"app": "a"},
+                requests={"cpu": "100m"},
+                topology_spread=[
+                    spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": "a"}),
+                    spread(wk.LABEL_HOSTNAME, labels={"app": "a"}),
+                ],
+            )
+            for _ in range(6)
+        ]
+        res = solve(pods, [make_nodepool()], provider)
+        assert not res.pod_errors
+        # hostname skew 1 → six nodes; zones balanced 2/2/2
+        assert len(res.new_node_claims) == 6
+        zone_counts = {}
+        for nc in res.new_node_claims:
+            z = next(iter(nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values))
+            zone_counts[z] = zone_counts.get(z, 0) + 1
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+class TestHostPorts:
+    def test_host_port_conflict_forces_second_node(self, provider):
+        pods = [
+            make_pod(requests={"cpu": "100m"}, host_ports=[8080]) for _ in range(2)
+        ]
+        res = solve(pods, [make_nodepool()], provider)
+        assert not res.pod_errors
+        assert len(res.new_node_claims) == 2
+
+    def test_distinct_host_ports_share_node(self, provider):
+        pods = [
+            make_pod(requests={"cpu": "100m"}, host_ports=[8080]),
+            make_pod(requests={"cpu": "100m"}, host_ports=[8081]),
+        ]
+        res = solve(pods, [make_nodepool()], provider)
+        assert not res.pod_errors
+        assert len(res.new_node_claims) == 1
+
+
+class TestVolumeTopology:
+    def _kube_with_pvc(self, zones_on_pv=None, zones_on_sc=None):
+        kube = KubeClient()
+        sc = StorageClass()
+        sc.metadata.name = "standard"
+        sc.provisioner = "ebs.csi.aws.com"
+        sc.zones = zones_on_sc or []
+        kube.create(sc)
+        pvc = PersistentVolumeClaim()
+        pvc.metadata.name = "data"
+        pvc.storage_class_name = "standard"
+        if zones_on_pv:
+            pv = PersistentVolume()
+            pv.metadata.name = "pv-1"
+            pv.zones = zones_on_pv
+            pv.driver = "ebs.csi.aws.com"
+            kube.create(pv)
+            pvc.volume_name = "pv-1"
+        kube.create(pvc)
+        return kube
+
+    def test_bound_pv_zone_pins_pod(self, provider):
+        kube = self._kube_with_pvc(zones_on_pv=["test-zone-2"])
+        pod = make_pod(requests={"cpu": "100m"})
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="data")]
+        res = solve([pod], [make_nodepool()], provider, kube=kube)
+        assert not res.pod_errors
+        nc = res.new_node_claims[0]
+        assert nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values == {"test-zone-2"}
+
+    def test_storage_class_topology_restricts(self, provider):
+        kube = self._kube_with_pvc(zones_on_sc=["test-zone-3"])
+        pod = make_pod(requests={"cpu": "100m"})
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="data")]
+        res = solve([pod], [make_nodepool()], provider, kube=kube)
+        assert not res.pod_errors
+        nc = res.new_node_claims[0]
+        assert nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values == {"test-zone-3"}
+
+
+class TestPreferredAffinityRelaxation:
+    def test_preferred_pod_affinity_relaxes_when_unsatisfiable(self, provider):
+        """Preferred pod affinity to a nonexistent anchor must relax and
+        schedule anyway (preferences.go:38 relaxation ladder)."""
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            labels={"app": "web"},
+        )
+        pod.spec.affinity = __import__(
+            "karpenter_core_tpu.kube.objects", fromlist=["Affinity"]
+        ).Affinity(
+            pod_affinity=__import__(
+                "karpenter_core_tpu.kube.objects", fromlist=["PodAffinity"]
+            ).PodAffinity(
+                preferred=[
+                    WeightedPodAffinityTerm(
+                        weight=100,
+                        pod_affinity_term=PodAffinityTerm(
+                            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "ghost"}),
+                        ),
+                    )
+                ]
+            )
+        )
+        res = solve([pod], [make_nodepool()], provider)
+        assert not res.pod_errors
+        assert len(res.new_node_claims) == 1
+
+    def test_preferred_node_affinity_honored_when_possible(self, provider):
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            preferred_node_affinity=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=__import__(
+                        "karpenter_core_tpu.kube.objects", fromlist=["NodeSelectorTerm"]
+                    ).NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2"]
+                            )
+                        ]
+                    ),
+                )
+            ],
+        )
+        res = solve([pod], [make_nodepool()], provider)
+        assert not res.pod_errors
+        nc = res.new_node_claims[0]
+        assert nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values == {"test-zone-2"}
+
+
+class TestWeightedPoolFallback:
+    def test_incompatible_heavy_pool_falls_through(self, provider):
+        heavy = make_nodepool("heavy")
+        heavy.spec.weight = 100
+        heavy.spec.template.taints = [Taint(key="gpu", value="true", effect="NoSchedule")]
+        light = make_nodepool("light")
+        light.spec.weight = 1
+        pod = make_pod(requests={"cpu": "100m"})
+        res = solve([pod], [heavy, light], provider)
+        assert not res.pod_errors
+        assert res.new_node_claims[0].nodepool_name == "light"
+
+    def test_tolerating_pod_lands_on_heavy_pool(self, provider):
+        heavy = make_nodepool("heavy")
+        heavy.spec.weight = 100
+        heavy.spec.template.taints = [Taint(key="gpu", value="true", effect="NoSchedule")]
+        light = make_nodepool("light")
+        light.spec.weight = 1
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            tolerations=[Toleration(key="gpu", operator="Exists")],
+        )
+        res = solve([pod], [heavy, light], provider)
+        assert not res.pod_errors
+        assert res.new_node_claims[0].nodepool_name == "heavy"
+
+
+class TestSelectorOperators:
+    def test_not_in_excludes_zone(self, provider):
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            required_node_affinity=[
+                NodeSelectorRequirement(
+                    wk.LABEL_TOPOLOGY_ZONE, "NotIn", ["test-zone-1", "test-zone-2"]
+                )
+            ],
+        )
+        res = solve([pod], [make_nodepool()], provider)
+        assert not res.pod_errors
+        nc = res.new_node_claims[0]
+        assert nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).has("test-zone-3")
+        assert not nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).has("test-zone-1")
+
+    def test_does_not_exist_on_custom_pool_label(self, provider):
+        labeled = make_nodepool("labeled")
+        labeled.spec.template.metadata.labels["tier"] = "x"
+        labeled.spec.weight = 100
+        plain = make_nodepool("plain")
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            required_node_affinity=[NodeSelectorRequirement("tier", "DoesNotExist", [])],
+        )
+        res = solve([pod], [labeled, plain], provider)
+        assert not res.pod_errors
+        assert res.new_node_claims[0].nodepool_name == "plain"
+
+    def test_lt_operator(self, provider):
+        from karpenter_core_tpu.cloudprovider.fake import INTEGER_INSTANCE_LABEL_KEY
+
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            required_node_affinity=[
+                NodeSelectorRequirement(INTEGER_INSTANCE_LABEL_KEY, "Lt", ["3"])
+            ],
+        )
+        res = solve([pod], [make_nodepool()], provider)
+        assert not res.pod_errors
+        its = res.new_node_claims[0].instance_type_options
+        assert its and all(int(next(iter(it.requirements.get_req(INTEGER_INSTANCE_LABEL_KEY).values))) < 3 for it in its)
+
+
+class TestStartupTaints:
+    def test_startup_taints_do_not_block_scheduling(self, provider):
+        """Startup taints are transient; pods schedule without tolerating
+        them (they gate Initialization, not scheduling decisions on new
+        claims — nodeclaim.go:68 only enforces pool taints)."""
+        np_ = make_nodepool()
+        np_.spec.template.startup_taints = [
+            Taint(key="cilium", value="uninitialized", effect="NoSchedule")
+        ]
+        pod = make_pod(requests={"cpu": "100m"})
+        res = solve([pod], [np_], provider)
+        assert not res.pod_errors
+
+
+class TestInFlightReuse:
+    def test_second_reconcile_reuses_inflight_capacity(self, provider):
+        """Nodes launched but not yet registered count as existing
+        capacity in the next scheduling round (scheduler existing-node
+        path over state nodes)."""
+        from karpenter_core_tpu.state.statenode import StateNode
+
+        nc_res = solve([make_pod(requests={"cpu": "1"})], [make_nodepool()], provider)
+        assert len(nc_res.new_node_claims) == 1
+        # materialize the in-flight claim as a state node
+        claim = nc_res.new_node_claims[0].to_node_claim(make_nodepool())
+        it = nc_res.new_node_claims[0].instance_type_options[0]
+        claim.status.capacity = dict(it.capacity)
+        claim.status.allocatable = it.allocatable()
+        claim.status.provider_id = "fake:///inflight-1"
+        sn = StateNode(node_claim=claim)
+        res2 = solve(
+            [make_pod(requests={"cpu": "1"})],
+            [make_nodepool()],
+            provider,
+            state_nodes=[sn],
+        )
+        assert not res2.pod_errors
+        assert len(res2.new_node_claims) == 0
+        assert len(res2.existing_nodes) == 1
