@@ -79,7 +79,7 @@ class DisruptionController:
             Emptiness(self.ctx),
             EmptyNodeConsolidation(self.ctx),
             MultiNodeConsolidation(self.ctx, use_tpu_screen=use_tpu_screen),
-            SingleNodeConsolidation(self.ctx),
+            SingleNodeConsolidation(self.ctx, use_tpu_screen=use_tpu_screen),
         ]
 
     def reconcile(self) -> Optional[str]:

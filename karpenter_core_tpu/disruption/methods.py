@@ -299,14 +299,27 @@ class MultiNodeConsolidation(ConsolidationBase):
 
 
 class SingleNodeConsolidation(ConsolidationBase):
-    """singlenodeconsolidation.go: linear scan, first success wins."""
+    """singlenodeconsolidation.go: linear scan, first success wins — with
+    a one-dispatch TPU feasibility screen pruning the scan."""
 
     consolidation_type = "single"
+
+    def __init__(self, ctx, use_tpu_screen: bool = True):
+        super().__init__(ctx)
+        self.use_tpu_screen = use_tpu_screen
 
     def compute_command(self, candidates: List[Candidate]) -> Command:
         if self.is_consolidated():
             return Command()
         candidates = self.sort_and_filter(candidates)
+        if self.use_tpu_screen and len(candidates) > 1:
+            # capacity screen for ALL candidates in one device dispatch;
+            # screen-infeasible ones cannot consolidate, so the linear
+            # simulation scan (the 3-minute budget) skips them entirely
+            from .tpu_repack import screen_singles
+
+            feasible = screen_singles(self.ctx, candidates)
+            candidates = [c for c, ok in zip(candidates, feasible) if ok]
         deadline = self.ctx.clock() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
         for candidate in candidates:
             if self.ctx.clock() > deadline:

@@ -60,21 +60,77 @@ def prefix_screen_kernel(
     return jnp.all(cum_load <= headroom, axis=-1)
 
 
-def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
-    """Largest prefix size (≥0) that passes the capacity screen."""
-    if len(candidates) < 2:
-        return 0
-    candidate_names = {c.name() for c in candidates}
+@jax.jit
+def single_screen_kernel(
+    candidate_loads: jnp.ndarray,  # (N, R) int32 — per-candidate pod request sums
+    candidate_free: jnp.ndarray,  # (N, R) int32 — per-candidate free capacity
+    fleet_free: jnp.ndarray,  # (R,) int32 — free capacity of the rest of the fleet
+    new_node_cap: jnp.ndarray,  # (R,) int32 — largest launchable instance
+) -> jnp.ndarray:
+    """→ (N,) bool: removing candidate i ALONE is capacity-feasible.
 
-    all_requests = [resources.requests_for_pods(*c.pods) if c.pods else {} for c in candidates]
+    The single-node analogue of ``prefix_screen_kernel``: candidate i's
+    orphaned pods must fit the surviving fleet (all other candidates
+    stay, so their free capacity counts) plus one replacement node. One
+    dispatch screens every candidate — the reference instead pays a full
+    scheduling simulation per candidate in its linear scan
+    (singlenodeconsolidation.go:42-89, 3 min budget)."""
+    loads = candidate_loads.astype(jnp.float32)
+    free = candidate_free.astype(jnp.float32)
+    others_free = jnp.sum(free, axis=0)[None, :] - free  # all candidates but i
+    headroom = (
+        fleet_free.astype(jnp.float32)[None, :]
+        + others_free
+        + new_node_cap.astype(jnp.float32)[None, :]
+    )
+    return jnp.all(loads <= headroom, axis=-1)
+
+
+def _encode_candidates(candidates: List[Candidate]):
+    """Shared screen encoding: (names, axis, loads, free). Loads count
+    ONLY reschedulable pods — daemonset/node-owned pods die with the
+    node and the oracle simulation doesn't reschedule them
+    (helpers.py simulate_scheduling / utils.pod.is_reschedulable);
+    counting them would make the screens falsely reject."""
+    from ..utils import pod as podutils
+
+    candidate_names = {c.name() for c in candidates}
+    all_requests = [
+        resources.requests_for_pods(*(p for p in c.pods if podutils.is_reschedulable(p)))
+        if c.pods
+        else {}
+        for c in candidates
+    ]
     instance_types = [c.instance_type for c in candidates]
     axis = build_resource_axis(all_requests, instance_types)
-
     loads = np.stack([quantize_requests(r, axis) for r in all_requests])
     free = np.stack(
         [quantize_capacity(c.state_node.available(), axis) for c in candidates]
     )
+    return candidate_names, axis, loads, free
 
+
+def screen_singles(ctx, candidates: List[Candidate]) -> np.ndarray:
+    """(N,) bool feasibility screen for single-candidate consolidation.
+    Screen-infeasible candidates cannot consolidate (capacity is a
+    necessary condition); feasible ones still go through the oracle
+    simulation."""
+    if not candidates:
+        return np.zeros(0, dtype=bool)
+    candidate_names, axis, loads, free = _encode_candidates(candidates)
+    fleet_free = _fleet_free(ctx, axis, candidate_names)
+    new_node_cap = _largest_launchable(ctx, axis)
+    return np.asarray(
+        single_screen_kernel(
+            jnp.asarray(loads),
+            jnp.asarray(free),
+            jnp.asarray(fleet_free),
+            jnp.asarray(new_node_cap),
+        )
+    )
+
+
+def _fleet_free(ctx, axis, candidate_names) -> np.ndarray:
     fleet_free = np.zeros(axis.count, dtype=np.int64)
     for node in ctx.cluster.deep_copy_nodes():
         if node.marked_for_deletion or node.name() in candidate_names:
@@ -82,10 +138,10 @@ def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
         if not node.initialized():
             continue
         fleet_free += quantize_capacity(node.available(), axis)
-    fleet_free = np.minimum(fleet_free, 2**30).astype(np.int32)
+    return np.minimum(fleet_free, 2**30).astype(np.int32)
 
-    # the largest instance a replacement could be (upper bound; the oracle
-    # verification enforces the real price/compat constraints)
+
+def _largest_launchable(ctx, axis) -> np.ndarray:
     new_node_cap = np.zeros(axis.count, dtype=np.int32)
     for np_ in ctx.kube_client.list("NodePool"):
         try:
@@ -93,6 +149,19 @@ def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
                 new_node_cap = np.maximum(new_node_cap, quantize_capacity(it.allocatable(), axis))
         except Exception:
             continue
+    return new_node_cap
+
+
+def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
+    """Largest prefix size (≥0) that passes the capacity screen."""
+    if len(candidates) < 2:
+        return 0
+    candidate_names, axis, loads, free = _encode_candidates(candidates)
+
+    fleet_free = _fleet_free(ctx, axis, candidate_names)
+    # the largest instance a replacement could be (upper bound; the oracle
+    # verification enforces the real price/compat constraints)
+    new_node_cap = _largest_launchable(ctx, axis)
 
     feasible = np.asarray(
         prefix_screen_kernel(

@@ -59,6 +59,64 @@ def sharded_batch_pack(
     return jax.jit(shard(per_device))(requests, frontiers, max_per_node)
 
 
+def sharded_prefix_screen(
+    mesh: Mesh,
+    candidate_loads: jnp.ndarray,  # (N, R) int32, N divisible by mesh size
+    candidate_free: jnp.ndarray,  # (N, R) int32
+    fleet_free_local: jnp.ndarray,  # (D, R) int32 — per-device fleet shard
+    new_node_cap: jnp.ndarray,  # (R,) int32
+) -> jnp.ndarray:
+    """Fleet-scale consolidation screen for multi-host fleets (SURVEY §5:
+    "fleet-level repacking sharded over DCN for >1 host").
+
+    Each device holds one shard of the fleet's per-node free capacity
+    (a host's worth of state nodes); the total frees come from a real
+    psum collective, then every device evaluates its candidate shard's
+    prefixes. Returns (N,) bool like prefix_screen_kernel.
+
+    Prefix sums over the candidate axis need the *global* running sum —
+    computed from a psum of shard totals plus an exclusive scan of
+    shard-prefix offsets (log-depth, collective-friendly)."""
+    axis = mesh.axis_names[0]
+    D = mesh.devices.size
+
+    def per_device(loads, free, fleet_local, cap):
+        # loads/free: (N/D, R) local shard; fleet_local: (1, R)
+        fleet_total = jax.lax.psum(jnp.sum(fleet_local, axis=0), axis_name=axis)
+        free_total = jax.lax.psum(jnp.sum(free, axis=0), axis_name=axis)
+        local_cum = jnp.cumsum(loads.astype(jnp.float32), axis=0)
+        local_free_cum = jnp.cumsum(free.astype(jnp.float32), axis=0)
+        # exclusive prefix offset across devices for both running sums
+        idx = jax.lax.axis_index(axis)
+        shard_load = local_cum[-1]
+        shard_free = local_free_cum[-1]
+        # all-gather shard totals, mask to devices before this one
+        all_loads = jax.lax.all_gather(shard_load, axis_name=axis)  # (D, R)
+        all_frees = jax.lax.all_gather(shard_free, axis_name=axis)
+        mask = (jnp.arange(D) < idx).astype(jnp.float32)[:, None]
+        offset_load = jnp.sum(all_loads * mask, axis=0)
+        offset_free = jnp.sum(all_frees * mask, axis=0)
+        cum_load = local_cum + offset_load[None, :]
+        cum_free = local_free_cum + offset_free[None, :]
+        surviving_candidate_free = free_total.astype(jnp.float32)[None, :] - cum_free
+        headroom = (
+            fleet_total.astype(jnp.float32)[None, :]
+            + surviving_candidate_free
+            + cap.astype(jnp.float32)[None, :]
+        )
+        return jnp.all(cum_load <= headroom, axis=-1)
+
+    shard = partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P()),
+        out_specs=P(axis),
+    )
+    return jax.jit(shard(per_device))(
+        candidate_loads, candidate_free, fleet_free_local, new_node_cap
+    )
+
+
 def sharded_compat(
     mesh: Mesh,
     sig_masks: jnp.ndarray,  # (S, W) f32 — flattened key masks

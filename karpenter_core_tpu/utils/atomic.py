@@ -22,15 +22,20 @@ class Lazy(Generic[T]):
         self._lock = threading.Lock()
 
     def get(self, resolve: Optional[Callable[[], T]] = None) -> T:
-        if self._value is not _UNSET:
-            return self._value  # type: ignore[return-value]
+        # read into a local once: a racing reset() must not turn an
+        # already-checked slot back into the sentinel mid-return
+        value = self._value
+        if value is not _UNSET:
+            return value  # type: ignore[return-value]
         with self._lock:
-            if self._value is _UNSET:
+            value = self._value
+            if value is _UNSET:
                 fn = resolve or self._resolve
                 if fn is None:
                     raise ValueError("Lazy has no resolver")
-                self._value = fn()
-        return self._value  # type: ignore[return-value]
+                value = fn()
+                self._value = value
+        return value  # type: ignore[return-value]
 
     def set(self, value: T) -> None:
         with self._lock:

@@ -299,3 +299,33 @@ class TestOrchestration:
         assert not state.marked_for_deletion
         node = env.kube.get("Node", node.name)
         assert not any(t.key == wk.DISRUPTION_TAINT_KEY for t in node.spec.taints)
+
+
+class TestTpuScreens:
+    def test_daemonset_pods_do_not_block_single_screen(self, env):
+        """Daemonset pods die with the node; the capacity screen must not
+        count them or it falsely rejects candidates the simulation would
+        consolidate (is_reschedulable filter parity)."""
+        from karpenter_core_tpu.disruption.tpu_repack import screen_singles
+
+        # two nodes: one nearly-empty except a huge daemonset pod, one
+        # with reschedulable room for the small app pod
+        big_ds = make_pod(requests={"cpu": "4"}, owner_kind="DaemonSet")
+        small = running_pod(cpu="100m")
+        env.make_initialized_node(instance_type_name="fake-it-4", pods=[big_ds, small])
+        env.make_initialized_node(instance_type_name="fake-it-4", pods=[running_pod(cpu="100m")])
+        env.now += 3600.0
+        assert env.cluster.synced()
+        from karpenter_core_tpu.disruption.helpers import get_candidates
+        from karpenter_core_tpu.disruption.methods import SingleNodeConsolidation
+
+        method = SingleNodeConsolidation(env.controller.ctx)
+        candidates = get_candidates(
+            env.cluster, env.kube, env.recorder, env.clock, env.provider,
+            method.should_disrupt,
+        )
+        assert len(candidates) == 2
+        feasible = screen_singles(env.controller.ctx, candidates)
+        # the 4-cpu daemonset load must not be counted: both candidates'
+        # RESCHEDULABLE load (100m) fits the other node's free capacity
+        assert feasible.all(), feasible

@@ -20,7 +20,10 @@ from karpenter_core_tpu.cloudprovider.fake import (
 )
 from karpenter_core_tpu.kube.client import KubeClient
 from karpenter_core_tpu.kube.objects import (
+    Affinity,
     LabelSelector,
+    NodeSelectorTerm,
+    PodAffinity,
     NodeSelectorRequirement,
     PersistentVolume,
     PersistentVolumeClaim,
@@ -168,12 +171,8 @@ class TestPreferredAffinityRelaxation:
             requests={"cpu": "100m"},
             labels={"app": "web"},
         )
-        pod.spec.affinity = __import__(
-            "karpenter_core_tpu.kube.objects", fromlist=["Affinity"]
-        ).Affinity(
-            pod_affinity=__import__(
-                "karpenter_core_tpu.kube.objects", fromlist=["PodAffinity"]
-            ).PodAffinity(
+        pod.spec.affinity = Affinity(
+            pod_affinity=PodAffinity(
                 preferred=[
                     WeightedPodAffinityTerm(
                         weight=100,
@@ -195,9 +194,7 @@ class TestPreferredAffinityRelaxation:
             preferred_node_affinity=[
                 PreferredSchedulingTerm(
                     weight=1,
-                    preference=__import__(
-                        "karpenter_core_tpu.kube.objects", fromlist=["NodeSelectorTerm"]
-                    ).NodeSelectorTerm(
+                    preference=NodeSelectorTerm(
                         match_expressions=[
                             NodeSelectorRequirement(
                                 wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2"]
