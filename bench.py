@@ -13,6 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -21,9 +22,18 @@ import numpy as np
 
 def main() -> None:
     # import inside main so the JSON line is the only stdout on success
-    import os
-
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    # resolve the JAX backend up front via the solver's hardened policy
+    # (out-of-process probe with timeout + CPU fallback, one home in
+    # solver.backend); BENCH_* env vars map onto the KARPENTER_TPU_* ones
+    from karpenter_core_tpu.solver import backend as backend_mod
+
+    if os.environ.get("BENCH_BACKEND"):
+        os.environ["KARPENTER_TPU_BACKEND"] = os.environ["BENCH_BACKEND"]
+    if os.environ.get("BENCH_PROBE_TIMEOUT"):
+        os.environ["KARPENTER_TPU_PROBE_TIMEOUT"] = os.environ["BENCH_PROBE_TIMEOUT"]
+    backend = backend_mod.default_backend()
 
     from karpenter_core_tpu.apis import labels as wk
     from karpenter_core_tpu.apis.nodepool import NodePool
@@ -101,6 +111,7 @@ def main() -> None:
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / 100.0, 2),
+                "backend": backend,
             }
         )
     )

@@ -1,0 +1,107 @@
+"""Backend resolution hardened against broken TPU plugins.
+
+The deployment image may register a TPU PJRT plugin (e.g. the ``axon``
+tunnel) at interpreter startup and pin ``jax_platforms`` to it. When the
+chip is unreachable, ``jax.default_backend()`` raises — or hangs — instead
+of falling back. The reference's design for this failure class is
+"solver-sidecar healthcheck + automatic fallback to the CPU oracle path"
+(SURVEY §5 failure-detection bullet), so the solver must degrade to the
+CPU/XLA path rather than crash or block the provisioning loop.
+
+This module is the single home for that logic: ``pin_cpu`` (env var alone
+does not override a sitecustomize platform pin), ``probe_backend`` (an
+in-process hang cannot be interrupted, so probe in a subprocess with a
+timeout), and ``default_backend`` (cached resolution with fallback).
+``KARPENTER_TPU_BACKEND`` forces a platform and skips probing.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_BACKEND: Optional[str] = None
+
+
+def pin_cpu() -> None:
+    """Pin this process's JAX platform to CPU, overriding any plugin pin."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def probe_backend(timeout: float = 120.0) -> Optional[str]:
+    """Which backend does a fresh interpreter get? None on failure/hang.
+
+    Runs ``jax.default_backend()`` in a subprocess so a hanging PJRT init
+    (dead TPU tunnel) costs a bounded timeout instead of blocking the
+    caller forever.
+    """
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if probe.returncode == 0 and probe.stdout.strip():
+            return probe.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def default_backend() -> str:
+    """``jax.default_backend()`` with automatic CPU fallback.
+
+    On TPU-plugin init failure (raise or hang) the platform is re-pinned
+    to ``cpu`` and the failure is remembered, so every subsequent solve
+    takes the CPU path without re-probing the dead plugin.
+    """
+    global _BACKEND
+    if _BACKEND is not None:
+        return _BACKEND
+    forced = os.environ.get("KARPENTER_TPU_BACKEND")
+    import jax
+
+    if forced:
+        jax.config.update("jax_platforms", forced)
+        _BACKEND = jax.default_backend()
+        return _BACKEND
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # already pinned (tests, bench fallback) — CPU init can't hang
+        jax.config.update("jax_platforms", "cpu")
+        _BACKEND = jax.default_backend()
+        return _BACKEND
+    # an unpinned process may get a broken TPU plugin whose init hangs;
+    # probe out-of-process first so the hang mode costs a timeout, not
+    # a stuck provisioning loop
+    timeout = float(os.environ.get("KARPENTER_TPU_PROBE_TIMEOUT", "120"))
+    if probe_backend(timeout) is None:
+        _log_fallback("probe failed or timed out")
+        pin_cpu()
+        _BACKEND = jax.default_backend()
+        return _BACKEND
+    try:
+        _BACKEND = jax.default_backend()
+    except RuntimeError as e:  # plugin raced from probe-ok to unreachable
+        _log_fallback(str(e))
+        pin_cpu()
+        _BACKEND = jax.default_backend()
+    return _BACKEND
+
+
+def _log_fallback(reason: str) -> None:
+    import logging
+
+    logging.getLogger("karpenter.solver").warning(
+        "TPU backend unavailable (%s); falling back to CPU/XLA path", reason
+    )
+
+
+def reset_for_tests() -> None:
+    global _BACKEND
+    _BACKEND = None

@@ -365,9 +365,9 @@ class TPUScheduler:
             sig_arrays = build_compat_inputs(compats, enc, e.vocab)
             keys = tuple(sorted(enc.key_masks.keys()))
             zone_ok, ct_ok = zone_ct_masks(compats, enc)
-            import jax
+            from .backend import default_backend
 
-            backend = jax.default_backend()
+            backend = default_backend()
             if (
                 len(compats) >= _PALLAS_MIN_S
                 and keys
