@@ -7,7 +7,9 @@ types, scheduling_benchmark_test.go:57-77) at 10k pods with the same
 (constraint kernels + FFD scan). Baseline = the reference's test-enforced
 100 pods/sec floor (scheduling_benchmark_test.go:51,177-181).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"backend"} — backend records the platform the solve actually ran on so a
+CPU fallback is never mistaken for a TPU number.
 """
 
 from __future__ import annotations

@@ -15,6 +15,7 @@ when a toolchain is unavailable or KARPENTER_TPU_NATIVE=0.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -23,23 +24,42 @@ from typing import Optional
 import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "pack.cc")
-_LIB = os.path.join(os.path.dirname(__file__), "_libpack.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _lib_path() -> str:
+    """Build artifact named by the source's content hash — a binary is
+    reused only when it provably matches pack.cc (mtimes don't survive
+    git checkouts, so an mtime staleness check would silently prefer a
+    stale binary on fresh clones)."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(os.path.dirname(__file__), f"_libpack-{digest}.so")
+
+
+def _build(lib_path: str) -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", lib_path],
             check=True,
             capture_output=True,
             timeout=120,
         )
-        return True
     except Exception:
         return False
+    # drop build artifacts of older pack.cc revisions (gitignored, so
+    # they'd otherwise accumulate invisibly across source edits)
+    import glob
+
+    for stale in glob.glob(os.path.join(os.path.dirname(__file__), "_libpack-*.so")):
+        if stale != lib_path:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+    return True
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -55,11 +75,12 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("KARPENTER_TPU_NATIVE", "1") == "0":
             return None
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _build():
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            if not _build(lib_path):
                 return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_path)
         except OSError:
             return None
         lib.ffd_pack_native.restype = ctypes.c_int32

@@ -79,7 +79,7 @@ def default_backend() -> str:
     # an unpinned process may get a broken TPU plugin whose init hangs;
     # probe out-of-process first so the hang mode costs a timeout, not
     # a stuck provisioning loop
-    timeout = float(os.environ.get("KARPENTER_TPU_PROBE_TIMEOUT", "120"))
+    timeout = float(os.environ.get("KARPENTER_TPU_PROBE_TIMEOUT", "60"))
     if probe_backend(timeout) is None:
         _log_fallback("probe failed or timed out")
         pin_cpu()

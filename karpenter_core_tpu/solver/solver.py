@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -79,6 +80,11 @@ class _CatalogEntry:
 
 _CATALOG_CACHE: Dict[tuple, _CatalogEntry] = {}
 _CATALOG_CACHE_MAX = 8
+# guards the cache dict AND in-place mutation of cached entries (vocab
+# interning, extend_encoded_masks, device_packed): solve() is normally
+# called only by the provisioner singleton, but concurrent reconcilers
+# (disruption simulations) may share catalog entries
+_CATALOG_LOCK = threading.RLock()
 
 
 def _requirements_fingerprint(reqs) -> tuple:
@@ -95,15 +101,15 @@ def _requirements_fingerprint(reqs) -> tuple:
 
 
 def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
-    """Cheap content fingerprint catching in-place mutation of the fields
-    the encoding depends on: capacity and the full offering tuples.
-    (In-place mutation of a Requirement object itself is assumed not to
-    happen — requirements are treated as immutable catalog data.)"""
+    """Content fingerprint catching mutation of the fields the encoding
+    depends on: requirements (by value — an id() check would alias a
+    replaced object onto a freed one's recycled id and serve stale
+    masks), capacity, and the full offering tuples."""
     return hash(
         tuple(
             (
                 it.name,
-                id(it.requirements),
+                _requirements_fingerprint(it.requirements),
                 tuple(sorted(it.capacity.items())),
                 tuple(
                     (o.zone, o.capacity_type, o.available, o.price)
@@ -118,17 +124,18 @@ def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
 def _catalog_entry(catalog: List[InstanceType]) -> _CatalogEntry:
     key = tuple(map(id, catalog))
     fp = _catalog_fingerprint(catalog)
-    entry = _CATALOG_CACHE.get(key)
-    if entry is not None and entry.fingerprint == fp:
+    with _CATALOG_LOCK:
+        entry = _CATALOG_CACHE.get(key)
+        if entry is not None and entry.fingerprint == fp:
+            return entry
+        vocab = Vocab()
+        axis = build_catalog_axis(catalog)
+        enc = encode_instance_types(list(catalog), axis, vocab)
+        entry = _CatalogEntry(list(catalog), fp, vocab, axis, enc)
+        if key not in _CATALOG_CACHE and len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
+            _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
+        _CATALOG_CACHE[key] = entry
         return entry
-    vocab = Vocab()
-    axis = build_catalog_axis(catalog)
-    enc = encode_instance_types(list(catalog), axis, vocab)
-    entry = _CatalogEntry(list(catalog), fp, vocab, axis, enc)
-    if key not in _CATALOG_CACHE and len(_CATALOG_CACHE) >= _CATALOG_CACHE_MAX:
-        _CATALOG_CACHE.pop(next(iter(_CATALOG_CACHE)))
-    _CATALOG_CACHE[key] = entry
-    return entry
 
 
 # signature count at which the fused pallas compat path pays for itself
@@ -343,79 +350,85 @@ class TPUScheduler:
             return
 
         # --- per-pool encoding + compat kernels -------------------------
+        # backend resolution can block on a subprocess probe (broken TPU
+        # plugin) — resolve it before taking the catalog lock so a slow
+        # first probe can't stall concurrent solvers
+        from .backend import default_backend
+
+        backend = default_backend()
         # catalog tensors come from the cross-solve cache (encode once per
-        # catalog generation, extend masks as pod batches grow the vocab)
-        pool_entries = [_catalog_entry(cat) for cat in pool_catalogs]
-        sig_compats: List[List] = [
-            [encode_signature_for_pool(g, pool, e.vocab) for g in groups]
-            for pool, e in zip(pools, pool_entries)
-        ]
-        for e in {id(e): e for e in pool_entries}.values():
-            extend_encoded_masks(e.enc, e.vocab)
-        for compats, e in zip(sig_compats, pool_entries):
-            finalize_signature_masks(compats, e.vocab)
-        encoded: List[EncodedInstanceTypes] = [e.enc for e in pool_entries]
+        # catalog generation, extend masks as pod batches grow the vocab);
+        # the lock covers every in-place mutation of shared cache entries
+        # (vocab interning, mask extension, device repack)
+        with _CATALOG_LOCK:
+            pool_entries = [_catalog_entry(cat) for cat in pool_catalogs]
+            sig_compats: List[List] = [
+                [encode_signature_for_pool(g, pool, e.vocab) for g in groups]
+                for pool, e in zip(pools, pool_entries)
+            ]
+            for e in {id(e): e for e in pool_entries}.values():
+                extend_encoded_masks(e.enc, e.vocab)
+            for compats, e in zip(sig_compats, pool_entries):
+                finalize_signature_masks(compats, e.vocab)
+            encoded: List[EncodedInstanceTypes] = [e.enc for e in pool_entries]
 
-        # ONE fused device dispatch per pool (compat ∧ offering), all pools
-        # dispatched before any sync so the per-pod host encoding below
-        # overlaps with device compute
-        pending = []
-        for e, compats in zip(pool_entries, sig_compats):
-            enc = e.enc
-            sig_arrays = build_compat_inputs(compats, enc, e.vocab)
-            keys = tuple(sorted(enc.key_masks.keys()))
-            zone_ok, ct_ok = zone_ct_masks(compats, enc)
-            from .backend import default_backend
+            # ONE fused device dispatch per pool (compat ∧ offering), all
+            # pools dispatched before any sync so the per-pod host encoding
+            # below overlaps with device compute
+            pending = []
+            for e, compats in zip(pool_entries, sig_compats):
+                enc = e.enc
+                sig_arrays = build_compat_inputs(compats, enc, e.vocab)
+                keys = tuple(sorted(enc.key_masks.keys()))
+                zone_ok, ct_ok = zone_ct_masks(compats, enc)
+                if (
+                    len(compats) >= _PALLAS_MIN_S
+                    and keys
+                    and (backend == "tpu" or _PALLAS_INTERPRET_OK)
+                ):
+                    # large-S regime: fused pallas kernel against the
+                    # device-resident packed catalog (sig side is the only
+                    # per-solve transfer)
+                    from .pallas_kernels import allowed_pallas, pack_masks
 
-            backend = default_backend()
-            if (
-                len(compats) >= _PALLAS_MIN_S
-                and keys
-                and (backend == "tpu" or _PALLAS_INTERPRET_OK)
-            ):
-                # large-S regime: fused pallas kernel against the
-                # device-resident packed catalog (sig side is the only
-                # per-solve transfer)
-                from .pallas_kernels import allowed_pallas, pack_masks
-
-                p_keys, tp, th, tn, offsets, widths, avail_dev = _entry_device_packed(e)
-                sp, sh, sn, s_offsets, s_widths = pack_masks(
-                    {k: sig_arrays[f"mask:{k}"] for k in p_keys},
-                    {k: sig_arrays[f"has:{k}"] for k in p_keys},
-                    {k: sig_arrays[f"neg:{k}"] for k in p_keys},
-                    p_keys,
-                )
-                assert s_offsets == offsets and s_widths == widths, (
-                    "sig/type chunk layouts diverged — vocab grew between "
-                    "snapshot and pack"
-                )
-                fut = allowed_pallas(
-                    sp,
-                    sh,
-                    sn,
-                    sig_arrays["valid"],
-                    tp,
-                    th,
-                    tn,
-                    zone_ok,
-                    ct_ok,
-                    avail_dev,
-                    offsets,
-                    widths,
-                    interpret=backend != "tpu",
-                )
-            else:
-                fut = allowed_kernel(
-                    {k: np.asarray(v) for k, v in sig_arrays.items()},
-                    enc.key_masks,
-                    enc.key_has,
-                    enc.key_neg,
-                    zone_ok,
-                    ct_ok,
-                    enc.offering_avail,
-                    keys,
-                )
-            pending.append((fut, zone_ok, ct_ok))
+                    p_keys, tp, th, tn, offsets, widths, avail_dev = _entry_device_packed(e)
+                    sp, sh, sn, s_offsets, s_widths = pack_masks(
+                        {k: sig_arrays[f"mask:{k}"] for k in p_keys},
+                        {k: sig_arrays[f"has:{k}"] for k in p_keys},
+                        {k: sig_arrays[f"neg:{k}"] for k in p_keys},
+                        p_keys,
+                    )
+                    assert s_offsets == offsets and s_widths == widths, (
+                        "sig/type chunk layouts diverged — vocab grew between "
+                        "snapshot and pack"
+                    )
+                    fut = allowed_pallas(
+                        sp,
+                        sh,
+                        sn,
+                        sig_arrays["valid"],
+                        tp,
+                        th,
+                        tn,
+                        zone_ok,
+                        ct_ok,
+                        avail_dev,
+                        offsets,
+                        widths,
+                        interpret=backend != "tpu",
+                    )
+                else:
+                    fut = allowed_kernel(
+                        {k: np.asarray(v) for k, v in sig_arrays.items()},
+                        enc.key_masks,
+                        enc.key_has,
+                        enc.key_neg,
+                        zone_ok,
+                        ct_ok,
+                        enc.offering_avail,
+                        keys,
+                    )
+                pending.append((fut, zone_ok, ct_ok))
 
         # --- per-pod encoding (overlapped with the device dispatch) -----
         all_requests = [resources.requests_for_pods(p) for p in pods]
