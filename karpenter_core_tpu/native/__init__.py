@@ -94,6 +94,18 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,  # k_open
             ctypes.POINTER(ctypes.c_int32),  # node_ids_out
         ]
+        lib.pack_existing_native.restype = ctypes.c_int64
+        lib.pack_existing_native.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # requests
+            ctypes.c_int64,  # P
+            ctypes.c_int64,  # R
+            ctypes.POINTER(ctypes.c_int32),  # sig_ids
+            ctypes.POINTER(ctypes.c_uint8),  # compat
+            ctypes.c_int64,  # S
+            ctypes.POINTER(ctypes.c_int32),  # free_caps (in-out)
+            ctypes.c_int64,  # M
+            ctypes.POINTER(ctypes.c_int32),  # assign_out
+        ]
         lib.cheapest_types_native.restype = None
         lib.cheapest_types_native.argtypes = [
             ctypes.POINTER(ctypes.c_int64),  # usage
@@ -138,6 +150,39 @@ def ffd_pack_native(
         node_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return node_ids, int(count)
+
+
+def pack_existing_native(
+    requests: np.ndarray,  # (P, R) int32, sorted descending by primary
+    sig_ids: np.ndarray,  # (P,) int32
+    compat: np.ndarray,  # (S, M) uint8/bool
+    free_caps: np.ndarray,  # (M, R) int32 — MUTATED in place
+):
+    """First-fit pods onto existing nodes in fixed node order; semantic
+    twin of solver.pack.pack_existing (the lax.scan device variant).
+    → (assign (P,) int32 node index or -1, n_assigned int)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native packer unavailable")
+    requests = np.ascontiguousarray(requests, dtype=np.int32)
+    sig_ids = np.ascontiguousarray(sig_ids, dtype=np.int32)
+    compat = np.ascontiguousarray(compat, dtype=np.uint8)
+    assert free_caps.dtype == np.int32 and free_caps.flags.c_contiguous
+    P, R = requests.shape
+    S, M = compat.shape
+    assign = np.empty(P, dtype=np.int32)
+    n = lib.pack_existing_native(
+        requests.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        P,
+        R,
+        sig_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        compat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        S,
+        free_caps.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        M,
+        assign.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return assign, int(n)
 
 
 def cheapest_types_native(

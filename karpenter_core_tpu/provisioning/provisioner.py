@@ -139,10 +139,11 @@ class Provisioner:
                     "no nodepools found; provisioning is disabled until one is created"
                 )
             return Results()
-        # pure pending-pod batches go straight to the TPU path — building
-        # the greedy scheduler would duplicate all of its setup work
-        if self.use_tpu_solver and not active:
-            return self._schedule_tpu(pods, nodepools)
+        # the TPU path handles existing capacity itself (packs onto free
+        # space before opening nodes) and falls back to the oracle only
+        # for the constraint classes it can't tensorize
+        if self.use_tpu_solver:
+            return self._schedule_tpu(pods, nodepools, active)
         try:
             scheduler = build_scheduler(
                 self.kube_client,
@@ -159,33 +160,55 @@ class Provisioner:
             return Results()
         return scheduler.solve(pods)
 
-    def _schedule_tpu(self, pods: List[Pod], nodepools) -> Results:
+    def _schedule_tpu(self, pods: List[Pod], nodepools, state_nodes=None) -> Results:
         """TPU path: solve plans, then re-express them as scheduler results
         via single-claim templates so CreateNodeClaims is uniform."""
         from ..solver import TPUScheduler
 
         solver = TPUScheduler(
-            nodepools, self.cloud_provider, kube_client=self.kube_client, cluster=self.cluster
+            nodepools,
+            self.cloud_provider,
+            kube_client=self.kube_client,
+            cluster=self.cluster,
+            recorder=self.recorder,
         )
-        sr = solver.solve(pods, daemonset_pods=self.cluster.get_daemonset_pods())
+        sr = solver.solve(
+            pods,
+            state_nodes=state_nodes,
+            daemonset_pods=self.cluster.get_daemonset_pods(),
+        )
         results = sr.oracle_results or Results()
         results.pod_errors.update(sr.pod_errors)
-        # the oracle path publishes these inside solve(); mirror it here so
-        # the event stream is backend-agnostic
+        by_uid = {p.uid: p for p in pods}
+        # the oracle fallback publishes its own failure events inside
+        # solve(); mirror only the tensor-path errors here so the event
+        # stream is backend-agnostic without duplicates
         if self.recorder is not None and sr.pod_errors:
             from ..events import events as ev
 
-            by_uid = {p.uid: p for p in pods}
+            oracle_errs = (
+                sr.oracle_results.pod_errors if sr.oracle_results is not None else {}
+            )
             for uid, err in sr.pod_errors.items():
                 pod = by_uid.get(uid)
-                if pod is not None:
+                if pod is not None and uid not in oracle_errs:
                     self.recorder.publish(ev.pod_failed_to_schedule(pod, err))
-        by_uid = {p.uid: p for p in pods}
         results._pods_by_uid.update(by_uid)
         if sr.node_plans:
             for plan in sr.node_plans:
                 plan.pods = [pods[i] for i in plan.pod_indices]
             results.tpu_plans = sr.node_plans  # consumed by reconcile
+        # tensor-path placements onto existing nodes are nominations —
+        # mirror the oracle's _record_results (nominate + event, no claim)
+        for plan in sr.existing_plans:
+            plan.pods = [pods[i] for i in plan.pod_indices]
+            if self.cluster is not None:
+                self.cluster.nominate_node_for_pod(plan.state_node.provider_id())
+            if self.recorder is not None:
+                from ..events import events as ev
+
+                for pod in plan.pods:
+                    self.recorder.publish(ev.nominate_pod(pod, plan.state_node.name()))
         return results
 
     # -- create (provisioner.go:141-153, 341-367) --------------------------

@@ -59,22 +59,18 @@ class ResourceAxis:
             return None
 
 
-def build_catalog_axis(instance_types: Sequence[InstanceType]) -> ResourceAxis:
-    """Resource axis determined by the catalog ALONE — stable across pod
-    batches, which is what lets the encoded catalog be cached solve over
-    solve. Pod-only extended resources are appended by ``extend_axis``;
-    pod request magnitudes are handled by clamping (quantized requests
-    saturate at 2^30, far above any capacity, so an oversized pod still
-    reads as unschedulable)."""
+def build_axis_from_capacities(capacities: Sequence[Dict[str, int]]) -> ResourceAxis:
+    """Resource axis over arbitrary capacity dicts (instance types or
+    existing nodes)."""
     names: Set[str] = set(BASE_RESOURCES)
-    for it in instance_types:
-        names.update(it.capacity.keys())
+    for cap in capacities:
+        names.update(cap.keys())
     ordered = BASE_RESOURCES + sorted(names - set(BASE_RESOURCES))
     # per-resource divisor: keep the max value under 2^30 after division
     idx = {n: i for i, n in enumerate(ordered)}
     maxima = np.zeros(len(ordered), dtype=np.float64)
-    for it in instance_types:
-        for k, v in it.capacity.items():
+    for cap in capacities:
+        for k, v in cap.items():
             i = idx[k]
             if v > maxima[i]:
                 maxima[i] = v
@@ -89,6 +85,16 @@ def build_catalog_axis(instance_types: Sequence[InstanceType]) -> ResourceAxis:
             d *= 2
         divisors[i] = d
     return ResourceAxis(ordered, divisors)
+
+
+def build_catalog_axis(instance_types: Sequence[InstanceType]) -> ResourceAxis:
+    """Resource axis determined by the catalog ALONE — stable across pod
+    batches, which is what lets the encoded catalog be cached solve over
+    solve. Pod-only extended resources are appended by ``extend_axis``;
+    pod request magnitudes are handled by clamping (quantized requests
+    saturate at 2^30, far above any capacity, so an oversized pod still
+    reads as unschedulable)."""
+    return build_axis_from_capacities([it.capacity for it in instance_types])
 
 
 def extend_axis(

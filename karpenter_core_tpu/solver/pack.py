@@ -122,6 +122,60 @@ def ffd_pack(
     return node_ids, final["next_id"]
 
 
+@jax.jit
+def pack_existing(
+    requests: jnp.ndarray,  # (P, R) int32, pre-sorted descending by primary
+    sig_ids: jnp.ndarray,  # (P,) int32
+    compat: jnp.ndarray,  # (S, M) bool
+    free: jnp.ndarray,  # (M, R) int32 remaining capacity
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """First-fit pods onto existing nodes in fixed node order — the
+    reference tries in-flight/real nodes before any new claim
+    (scheduler.go:241-246); node order encodes initialized-then-name.
+    → (assign (P,) int32 node index or -1, free' (M, R))."""
+
+    def step(free, x):
+        req, sig = x
+        fits = compat[sig] & jnp.all(free >= req[None, :], axis=1)
+        m = jnp.argmax(fits)  # first True in node order
+        found = fits[m]
+        free = jnp.where(found, free.at[m].add(-req), free)
+        return free, jnp.where(found, m.astype(jnp.int32), jnp.int32(-1))
+
+    free, assign = jax.lax.scan(step, free, (requests, sig_ids), unroll=4)
+    return assign, free
+
+
+def run_pack_existing(
+    requests: np.ndarray,
+    sig_ids: np.ndarray,
+    compat: np.ndarray,
+    free: np.ndarray,
+    engine: str = "auto",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Dispatch the existing-node pack: native C++ loop when available
+    (sequential scalar work, same split as batch_pack), else the device
+    scan. → (assign (P,), remaining free (M, R))."""
+    if requests.shape[0] == 0 or free.shape[0] == 0:
+        return np.full(requests.shape[0], -1, dtype=np.int32), free
+    if engine in ("auto", "native"):
+        from .. import native
+
+        if native.available():
+            free = np.ascontiguousarray(free, dtype=np.int32)
+            assign, _ = native.pack_existing_native(requests, sig_ids, compat, free)
+            return assign, free
+        if engine == "native":
+            raise RuntimeError("native packer requested but unavailable")
+    assign, free_out = pack_existing(
+        jnp.asarray(requests),
+        jnp.asarray(sig_ids),
+        jnp.asarray(compat.astype(bool)),
+        jnp.asarray(free),
+    )
+    return np.asarray(assign), np.asarray(free_out)
+
+
 def assign_cheapest_types(
     node_usage: np.ndarray,  # (N, R) int32 summed requests per node
     allocatable: np.ndarray,  # (T, R) int32 (viable types only)

@@ -36,6 +36,7 @@ from .encode import (
     PoolEncoding,
     ResourceAxis,
     SignatureGroup,
+    build_axis_from_capacities,
     build_catalog_axis,
     build_requests_matrix,
     encode_instance_types,
@@ -44,6 +45,7 @@ from .encode import (
     extend_encoded_masks,
     finalize_signature_masks,
     group_pods,
+    quantize_capacity,
     quantize_requests,
 )
 from .kernels import allowed_kernel, build_compat_inputs, zone_ct_masks
@@ -52,6 +54,7 @@ from .pack import (
     batch_pack,
     node_usage_from_assignment,
     pareto_frontier,
+    run_pack_existing,
 )
 from .vocab import Vocab
 
@@ -205,8 +208,20 @@ class NodePlan:
 
 
 @dataclass
+class ExistingNodePlan:
+    """Pods the solver placed onto an already-existing/in-flight node —
+    nominations, not NodeClaim creations (scheduler.go:241-246 tries
+    existing capacity before opening claims)."""
+
+    state_node: object  # StateNode
+    pod_indices: List[int]  # into the solve batch
+    pods: Optional[List[Pod]] = None  # resolved by the provisioner for events
+
+
+@dataclass
 class SolverResult:
     node_plans: List[NodePlan] = field(default_factory=list)
+    existing_plans: List[ExistingNodePlan] = field(default_factory=list)
     pod_errors: Dict[str, str] = field(default_factory=dict)  # pod uid → error
     oracle_results: Optional[object] = None  # scheduler.Results for fallback pods
 
@@ -224,6 +239,7 @@ class SolverResult:
     @property
     def pods_scheduled(self) -> int:
         n = sum(len(p.pod_indices) for p in self.node_plans)
+        n += sum(len(p.pod_indices) for p in self.existing_plans)
         if self.oracle_results is not None:
             n += sum(len(c.pods) for c in self.oracle_results.new_node_claims)
             n += sum(len(e.pods) for e in self.oracle_results.existing_nodes)
@@ -237,6 +253,7 @@ class TPUScheduler:
         cloud_provider: CloudProvider,
         kube_client=None,
         cluster=None,
+        recorder=None,
     ):
         self.nodepools = order_by_weight(
             [np_ for np_ in nodepools if np_.metadata.deletion_timestamp is None]
@@ -244,6 +261,7 @@ class TPUScheduler:
         self.cloud_provider = cloud_provider
         self.kube_client = kube_client
         self.cluster = cluster
+        self.recorder = recorder
 
     # ------------------------------------------------------------------
 
@@ -279,17 +297,56 @@ class TPUScheduler:
             if any(sel.matches(g.exemplar.metadata.labels) for sel in selectors)
         ]
         tensor_groups = [g for g in tensor_groups if g not in pulled]
-        oracle_pods: List[Pod] = [
-            pods[i] for g in relational + pulled for i in g.pod_indices
-        ]
-        # existing capacity is packed by the oracle path for now
+        oracle_groups = relational + pulled
         if state_nodes:
-            oracle_pods = list(pods)
-            tensor_groups = []
+            # topology-bearing groups need existing per-domain counts to
+            # seed skew balancing — those route to the oracle when the
+            # cluster has capacity; plain groups pack onto existing nodes
+            # on the tensor path (scheduler.go:241-246 order)
+            spreadish = [
+                g
+                for g in tensor_groups
+                if g.zone_spread() is not None
+                or g.hostname_spread() is not None
+                or g.hostname_isolated
+            ]
+            tensor_groups = [g for g in tensor_groups if g not in spreadish]
+            oracle_groups = oracle_groups + spreadish
+        # plain groups whose labels match an oracle-routed group's spread
+        # selector must schedule in the same (oracle) world, or the
+        # topology skew counts would miss their placements
+        spread_sels = [
+            c.label_selector
+            for g in oracle_groups
+            for c in g.exemplar.spec.topology_spread_constraints
+            if c.label_selector is not None
+        ]
+        if spread_sels:
+            pulled_spread = [
+                g
+                for g in tensor_groups
+                if any(s.matches(g.exemplar.metadata.labels) for s in spread_sels)
+            ]
+            tensor_groups = [g for g in tensor_groups if g not in pulled_spread]
+            oracle_groups = oracle_groups + pulled_spread
+        oracle_pods: List[Pod] = [
+            pods[i] for g in oracle_groups for i in g.pod_indices
+        ]
 
         if tensor_groups:
-            self._solve_tensor(pods, tensor_groups, daemonset_pods or [], result)
+            self._solve_tensor(
+                pods,
+                tensor_groups,
+                daemonset_pods or [],
+                result,
+                state_nodes=list(state_nodes or ()),
+            )
         if oracle_pods:
+            # the oracle must see capacity net of tensor-path placements:
+            # commit them onto the (already deep-copied) state nodes
+            for plan in result.existing_plans:
+                for i in plan.pod_indices:
+                    plan.state_node.update_for_pod(pods[i])
             self._solve_oracle(oracle_pods, state_nodes, daemonset_pods, result)
         return result
 
@@ -306,11 +363,166 @@ class TPUScheduler:
             pods,
             state_nodes=state_nodes,
             daemonset_pods=daemonset_pods,
+            recorder=self.recorder,
         )
         res = scheduler.solve(pods)
         result.oracle_results = res
         for uid, err in res.pod_errors.items():
             result.pod_errors[uid] = err
+
+    # ------------------------------------------------------------------
+
+    def _pack_existing(
+        self,
+        pods: List[Pod],
+        groups: List[SignatureGroup],
+        daemonset_pods: List[Pod],
+        state_nodes: list,
+        leftover: Dict[int, List[int]],
+        result: SolverResult,
+    ) -> None:
+        """Pack signature groups onto existing/in-flight capacity before
+        opening any new node (scheduler.go:241-246; existingnode.go:64-120
+        semantics: taints → node-label/requirement compat → resource fits;
+        host-port/volume bookkeeping is committed via update_for_pod when
+        the oracle runs after us).
+
+        Encoding: nodes become an (M, R) free-capacity matrix (available
+        minus remaining daemon overhead) in the oracle's try-order
+        (initialized first, then name); signature × node admissibility is
+        computed once per node CLASS (labels minus hostname + taints) —
+        fleets have few classes, so the host set algebra is O(S·classes),
+        and the pack itself is the native/scan first-fit."""
+        from ..kube.objects import OP_IN
+        from ..scheduling import Requirement
+        from ..scheduling.hostports import get_host_ports
+        from ..scheduling.requirements import label_requirements
+        from ..scheduling.requirements import pod_requirements as _pod_reqs
+
+        nodes = sorted(state_nodes, key=lambda n: (not n.initialized(), n.name()))
+        M = len(nodes)
+        if M == 0 or not groups:
+            return
+
+        def _needs_oracle_checks(pod: Pod) -> bool:
+            """Host-port conflicts and CSI volume limits are per-node
+            stateful checks (existingnode.go:64-82) the pack matrix
+            doesn't model yet — pods carrying either stay out of the
+            existing-node pack (conservative: they open new nodes rather
+            than risk an invalid nomination)."""
+            if get_host_ports(pod):
+                return True
+            for v in pod.spec.volumes:
+                if v.persistent_volume_claim is not None or v.ephemeral:
+                    return True
+            return False
+        if self._all_requests is None:
+            self._all_requests = [resources.requests_for_pods(p) for p in pods]
+        all_requests = self._all_requests
+        batch_requests = [all_requests[i] for g in groups for i in g.pod_indices]
+        axis = extend_axis(
+            build_axis_from_capacities([n.allocatable() for n in nodes]),
+            batch_requests,
+        )
+
+        # free capacity: available minus REMAINING daemon overhead
+        # (expected daemons that fit the node, less those already present,
+        # floored at zero — existingnode.go:43-52)
+        free = np.zeros((M, axis.count), dtype=np.int32)
+        for m, node in enumerate(nodes):
+            node_taints = Taints(node.taints())
+            node_label_reqs = label_requirements(node.labels())
+            daemons = [
+                p
+                for p in daemonset_pods
+                if node_taints.tolerates(p) is None
+                and node_label_reqs.compatible(_pod_reqs(p)) is None
+            ]
+            expected = resources.requests_for_pods(*daemons) if daemons else {}
+            remaining_daemon = {
+                k: v
+                for k, v in resources.subtract(
+                    expected, node.daemonset_request_total()
+                ).items()
+                if v > 0
+            }
+            avail = resources.subtract(node.available(), remaining_daemon)
+            # an overcommitted node (any negative axis) rejects every pod
+            # in the oracle (resources.fits: 0 ≤ negative is false) — a
+            # zero row reproduces that, since every pod requests pods≥1
+            if not any(v < 0 for v in avail.values()):
+                free[m] = quantize_capacity(avail, axis)
+
+        # signature × node admissibility, cached per node class
+        S = len(groups)
+        sig_reqs = [_pod_reqs(g.exemplar) for g in groups]
+        hostname_sigs = {s for s, r in enumerate(sig_reqs) if wk.LABEL_HOSTNAME in r}
+        compat = np.zeros((S, M), dtype=np.uint8)
+        class_cols: Dict[tuple, np.ndarray] = {}
+        for m, node in enumerate(nodes):
+            labels = node.labels()
+            ckey = (
+                tuple(sorted((k, v) for k, v in labels.items() if k != wk.LABEL_HOSTNAME)),
+                tuple(sorted((t.key, t.value, t.effect) for t in node.taints())),
+            )
+            col = class_cols.get(ckey)
+            if col is None:
+                node_taints = Taints(node.taints())
+                node_reqs = label_requirements(
+                    {k: v for k, v in labels.items() if k != wk.LABEL_HOSTNAME}
+                )
+                col = np.zeros(S, dtype=np.uint8)
+                for s, g in enumerate(groups):
+                    if s in hostname_sigs:
+                        continue  # resolved per node below
+                    col[s] = (
+                        node_taints.tolerates(g.exemplar) is None
+                        and node_reqs.compatible(sig_reqs[s]) is None
+                    )
+                class_cols[ckey] = col
+            compat[:, m] = col
+        for s in hostname_sigs:
+            g = groups[s]
+            for m, node in enumerate(nodes):
+                node_reqs = label_requirements(node.labels())
+                node_reqs.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [node.hostname()]))
+                compat[s, m] = (
+                    Taints(node.taints()).tolerates(g.exemplar) is None
+                    and node_reqs.compatible(sig_reqs[s]) is None
+                )
+        if not compat.any():
+            return
+
+        # global pack in the oracle's pod order: all pods descending by
+        # (primary, memory) — queue.go:76; host-port/volume-bearing pods
+        # are held back for per-node stateful checks
+        pairs = [
+            (i, s)
+            for s, g in enumerate(groups)
+            for i in g.pod_indices
+            if not _needs_oracle_checks(pods[i])
+        ]
+        if not pairs:
+            return
+        pod_idx = np.array([i for i, _ in pairs], dtype=np.int64)
+        sig_ids = np.array([s for _, s in pairs], dtype=np.int32)
+        reqs = build_requests_matrix([all_requests[i] for i, _ in pairs], axis)
+        order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+        pod_idx, sig_ids, reqs = pod_idx[order], sig_ids[order], reqs[order]
+        assign, _ = run_pack_existing(reqs, sig_ids, compat, free)
+
+        by_node: Dict[int, List[int]] = {}
+        for j in np.flatnonzero(assign >= 0):
+            by_node.setdefault(int(assign[j]), []).append(int(pod_idx[j]))
+        if not by_node:
+            return
+        assigned = {i for members in by_node.values() for i in members}
+        for gi, g in enumerate(groups):
+            leftover[gi] = [i for i in g.pod_indices if i not in assigned]
+        for m in sorted(by_node):
+            result.existing_plans.append(
+                ExistingNodePlan(state_node=nodes[m], pod_indices=by_node[m])
+            )
 
     # ------------------------------------------------------------------
 
@@ -320,7 +532,20 @@ class TPUScheduler:
         groups: List[SignatureGroup],
         daemonset_pods: List[Pod],
         result: SolverResult,
+        state_nodes: Optional[list] = None,
     ) -> None:
+        # --- existing capacity first (scheduler.go:241-246) -------------
+        # per-group indices still needing placement after the existing-
+        # node pack; starts as every pod in the group
+        self._all_requests = None
+        leftover: Dict[int, List[int]] = {
+            gi: list(g.pod_indices) for gi, g in enumerate(groups)
+        }
+        if state_nodes:
+            self._pack_existing(pods, groups, daemonset_pods, state_nodes, leftover, result)
+            if not any(leftover.values()):
+                return
+
         # --- encode catalog per pool -----------------------------------
         pools: List[PoolEncoding] = []
         pool_catalogs: List[List[InstanceType]] = []
@@ -344,8 +569,8 @@ class TPUScheduler:
             )
             pool_catalogs.append(its)
         if not pools:
-            for g in groups:
-                for i in g.pod_indices:
+            for gi in range(len(groups)):
+                for i in leftover[gi]:
                     result.pod_errors[pods[i].uid] = "no nodepool found"
             return
 
@@ -431,8 +656,9 @@ class TPUScheduler:
                 pending.append((fut, zone_ok, ct_ok))
 
         # --- per-pod encoding (overlapped with the device dispatch) -----
-        all_requests = [resources.requests_for_pods(p) for p in pods]
-        self._all_requests = all_requests  # reused for lazy NodePlan.requests
+        if self._all_requests is None:
+            self._all_requests = [resources.requests_for_pods(p) for p in pods]
+        all_requests = self._all_requests  # reused for lazy NodePlan.requests
         from ..scheduling.requirements import pod_requirements as _pod_reqs
 
         # per unique catalog: extended axis + quantized request matrix
@@ -469,8 +695,11 @@ class TPUScheduler:
         # pass 1: pool choice per signature group (scheduler.go:256-283)
         infos: List[dict] = []
         for gi, group in enumerate(groups):
+            if not leftover[gi]:
+                continue  # fully placed on existing capacity
             info = self._choose_pool(
-                gi, group, pods, pools, encoded, sig_compats, allowed_per_pool, result
+                gi, group, pods, pools, encoded, sig_compats, allowed_per_pool,
+                result, leftover[gi],
             )
             if info is not None:
                 infos.append(info)
@@ -518,9 +747,12 @@ class TPUScheduler:
         sig_compats,
         allowed_per_pool,
         result: SolverResult,
+        indices: List[int],
     ) -> Optional[dict]:
         """First pool (weight order) whose template accepts the signature
-        and offers at least one viable type (scheduler.go:256-283)."""
+        and offers at least one viable type (scheduler.go:256-283).
+        ``indices`` is the group's still-unplaced subset (pods already on
+        existing nodes never consult nodepools)."""
         chosen = None
         for pi, pool in enumerate(pools):
             compat_row = allowed_per_pool[pi][0][gi]
@@ -532,7 +764,7 @@ class TPUScheduler:
                 f'incompatible with nodepool "{p.nodepool.name}", {sig_compats[pi][gi].error or "no viable instance type"}'
                 for pi, p in enumerate(pools)
             )
-            for i in group.pod_indices:
+            for i in indices:
                 result.pod_errors[pods[i].uid] = err
             return None
 
@@ -546,6 +778,7 @@ class TPUScheduler:
 
         return dict(
             group=group,
+            indices=indices,
             chosen=chosen,
             viable=allowed_per_pool[chosen][0][gi],  # (T,) bool
             zone_ok=allowed_per_pool[chosen][1][gi],  # (Z,)
@@ -608,7 +841,7 @@ class TPUScheduler:
                 return idx[order], reqs[order]
 
             if not spread:
-                idx, reqs = sorted_idx([i for m in members for i in m["group"].pod_indices])
+                idx, reqs = sorted_idx([i for m in members for i in m["indices"]])
                 self._prepare_job(
                     idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                     pool, pods, result, jobs, metas, merged=merged,
@@ -627,12 +860,12 @@ class TPUScheduler:
             zones = [z for z in zones if zone_types[z].any()]
             if not zones:
                 for m in spread:
-                    for i in m["group"].pod_indices:
+                    for i in m["indices"]:
                         result.pod_errors[pods[i].uid] = (
                             "no zone with viable offering for topology spread"
                         )
                 if plain:
-                    idx, reqs = sorted_idx([i for m in plain for i in m["group"].pod_indices])
+                    idx, reqs = sorted_idx([i for m in plain for i in m["indices"]])
                     self._prepare_job(
                         idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                         pool, pods, result, jobs, metas, merged=merged,
@@ -641,7 +874,7 @@ class TPUScheduler:
 
             buckets: Dict[str, List[int]] = {z: [] for z in zones}
             for m in spread:
-                g_idx, _ = sorted_idx(m["group"].pod_indices)
+                g_idx, _ = sorted_idx(m["indices"])
                 for j, i in enumerate(g_idx):
                     buckets[zones[j % len(zones)]].append(int(i))
             # plain pods ride along only when zone choice doesn't shrink
@@ -651,11 +884,11 @@ class TPUScheduler:
                 bool(np.array_equal(zone_types[z], viable)) for z in zones
             )
             if ride_along:
-                p_idx, _ = sorted_idx([i for m in plain for i in m["group"].pod_indices])
+                p_idx, _ = sorted_idx([i for m in plain for i in m["indices"]])
                 for j, i in enumerate(p_idx):
                     buckets[zones[j % len(zones)]].append(int(i))
             elif plain:
-                idx, reqs = sorted_idx([i for m in plain for i in m["group"].pod_indices])
+                idx, reqs = sorted_idx([i for m in plain for i in m["indices"]])
                 self._prepare_job(
                     idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                     pool, pods, result, jobs, metas, merged=merged,

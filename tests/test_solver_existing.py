@@ -1,0 +1,319 @@
+"""Tensor path over EXISTING capacity (scheduler.go:241-254,
+existingnode.go:64-120): the TPU solver packs signature groups onto
+in-flight/real nodes before opening new ones, instead of falling back to
+the oracle the moment any state node exists. Parity vs the greedy oracle
+on placements + node counts."""
+
+import numpy as np
+
+from helpers import make_node, make_nodepool, make_pod, spread
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+    new_instance_type,
+)
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import Taint, Toleration
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+from karpenter_core_tpu.solver import TPUScheduler
+from karpenter_core_tpu.state.statenode import StateNode
+
+
+def state_node(cpu="4", memory="16Gi", pods="100", labels=None, taints=None, name=None):
+    node = make_node(
+        name=name,
+        labels={
+            wk.NODEPOOL_LABEL_KEY: "default",
+            wk.NODE_REGISTERED_LABEL_KEY: "true",
+            wk.NODE_INITIALIZED_LABEL_KEY: "true",
+            **(labels or {}),
+        },
+        capacity={"cpu": cpu, "memory": memory, "pods": pods},
+        taints=taints,
+    )
+    return StateNode(node=node)
+
+
+def tpu_solve(pods, state_nodes, nodepools=None, provider=None):
+    provider = provider or _default_provider()
+    nodepools = nodepools or [make_nodepool()]
+    return TPUScheduler(nodepools, provider, kube_client=KubeClient()).solve(
+        pods, state_nodes=state_nodes
+    )
+
+
+def oracle_solve(pods, state_nodes, nodepools=None, provider=None):
+    provider = provider or _default_provider()
+    nodepools = nodepools or [make_nodepool()]
+    s = build_scheduler(
+        KubeClient(), None, nodepools, provider, pods, state_nodes=state_nodes
+    )
+    return s.solve(pods)
+
+
+def _default_provider():
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(10)
+    return provider
+
+
+class TestExistingPackTensorPath:
+    def test_fills_existing_before_opening_nodes(self):
+        sns = [state_node(cpu="4") for _ in range(2)]
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(8)]
+        res = tpu_solve(pods, sns)
+        # all 8 pods fit on the two 4-cpu nodes; tensor path, no oracle
+        assert res.oracle_results is None
+        assert not res.node_plans
+        assert sum(len(p.pod_indices) for p in res.existing_plans) == 8
+        assert res.pods_scheduled == 8
+        assert not res.pod_errors
+
+    def test_overflow_opens_new_nodes(self):
+        sns = [state_node(cpu="2")]
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(6)]
+        res = tpu_solve(pods, sns)
+        assert res.oracle_results is None
+        assert sum(len(p.pod_indices) for p in res.existing_plans) == 2
+        assert sum(len(p.pod_indices) for p in res.node_plans) == 4
+        assert res.pods_scheduled == 6
+
+    def test_tainted_node_needs_toleration(self):
+        sns = [state_node(taints=[Taint(key="team", value="a", effect="NoSchedule")])]
+        plain = [make_pod(requests={"cpu": "1"}) for _ in range(2)]
+        res = tpu_solve(plain, sns)
+        assert not res.existing_plans  # intolerant pods skip the node
+        assert sum(len(p.pod_indices) for p in res.node_plans) == 2
+
+        tolerant = [
+            make_pod(
+                requests={"cpu": "1"},
+                tolerations=[Toleration(key="team", operator="Equal", value="a")],
+            )
+            for _ in range(2)
+        ]
+        res2 = tpu_solve(tolerant, sns)
+        assert sum(len(p.pod_indices) for p in res2.existing_plans) == 2
+        assert not res2.node_plans
+
+    def test_node_selector_matches_node_labels(self):
+        sns = [
+            state_node(labels={"disk": "ssd"}, name="node-ssd"),
+            state_node(labels={"disk": "hdd"}, name="node-hdd"),
+        ]
+        pods = [make_pod(requests={"cpu": "1"}, node_selector={"disk": "ssd"}) for _ in range(3)]
+        res = tpu_solve(pods, sns)
+        assert len(res.existing_plans) == 1
+        assert res.existing_plans[0].state_node.name() == "node-ssd"
+        assert len(res.existing_plans[0].pod_indices) == 3
+
+    def test_hostname_selector_pins_to_one_node(self):
+        sns = [state_node(name=f"node-{i}") for i in range(3)]
+        target = sns[1].hostname()
+        pods = [
+            make_pod(requests={"cpu": "1"}, node_selector={wk.LABEL_HOSTNAME: target})
+            for _ in range(2)
+        ]
+        res = tpu_solve(pods, sns)
+        assert len(res.existing_plans) == 1
+        assert res.existing_plans[0].state_node.hostname() == target
+
+    def test_pods_resource_cap(self):
+        sns = [state_node(cpu="64", pods="3")]
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(5)]
+        res = tpu_solve(pods, sns)
+        assert sum(len(p.pod_indices) for p in res.existing_plans) == 3
+        assert sum(len(p.pod_indices) for p in res.node_plans) == 2
+
+    def test_initialized_nodes_preferred(self):
+        uninit = make_node(
+            name="a-uninit",
+            labels={wk.NODEPOOL_LABEL_KEY: "default", wk.NODE_REGISTERED_LABEL_KEY: "true"},
+            capacity={"cpu": "4", "memory": "16Gi", "pods": "100"},
+        )
+        sns = [StateNode(node=uninit), state_node(name="z-init")]
+        pods = [make_pod(requests={"cpu": "1"})]
+        res = tpu_solve(pods, sns)
+        # initialized-first order (scheduler.go:310-321) despite name sort
+        assert res.existing_plans[0].state_node.name() == "z-init"
+
+
+class TestConservativeExclusions:
+    def test_host_port_pods_skip_existing_pack(self):
+        sns = [state_node(cpu="8")]
+        pods = [make_pod(requests={"cpu": "1"}, host_ports=[8080]) for _ in range(2)]
+        res = tpu_solve(pods, sns)
+        # conservative: stateful per-node port checks aren't modeled, so
+        # port-bearing pods open new capacity instead of risking a bad
+        # nomination (both would conflict on one node anyway)
+        assert not res.existing_plans
+        assert res.pods_scheduled == 2
+
+    def test_overcommitted_node_rejected(self):
+        sn = state_node(cpu="2")
+        # overcommit: existing pod consumes more than allocatable memory
+        hog = make_pod(requests={"cpu": "1", "memory": "32Gi"}, node_name=sn.name())
+        sn.update_for_pod(hog)
+        pods = [make_pod(requests={"cpu": "1"})]
+        res = tpu_solve(pods, [sn])
+        assert not res.existing_plans  # negative-axis node rejects all pods
+        assert sum(len(p.pod_indices) for p in res.node_plans) == 1
+
+    def test_plain_group_matching_oracle_spread_selector_pulled(self):
+        sns = [state_node(cpu="8")]
+        spready = [
+            make_pod(
+                requests={"cpu": "1"},
+                labels={"app": "x"},
+                topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": "x"})],
+            )
+            for _ in range(2)
+        ]
+        # same labels, no constraints of its own — its placements count
+        # toward the spread selector, so it must schedule with the oracle
+        plain_matching = [make_pod(requests={"cpu": "1"}, labels={"app": "x"}) for _ in range(2)]
+        res = tpu_solve(spready + plain_matching, sns)
+        assert res.oracle_results is not None
+        oracle_placed = sum(len(e.pods) for e in res.oracle_results.existing_nodes) + sum(
+            len(c.pods) for c in res.oracle_results.new_node_claims
+        )
+        assert oracle_placed == 4  # all four in the oracle world
+        assert not res.existing_plans and not res.node_plans
+
+
+class TestExistingPackParity:
+    def _rng_pods(self, n, seed):
+        rng = np.random.RandomState(seed)
+        cpus = ["100m", "250m", "500m", "1", "2"]
+        mems = ["128Mi", "512Mi", "1Gi", "2Gi"]
+        return [
+            make_pod(
+                requests={
+                    "cpu": cpus[rng.randint(len(cpus))],
+                    "memory": mems[rng.randint(len(mems))],
+                }
+            )
+            for _ in range(n)
+        ]
+
+    def test_node_count_parity_with_existing_capacity(self):
+        for seed in (0, 1, 2):
+            pods = self._rng_pods(400, seed)
+            mk_sns = lambda: [state_node(cpu="8", memory="32Gi") for _ in range(10)]
+            provider = _default_provider()
+            nodepools = [make_nodepool()]
+            o = oracle_solve(pods, mk_sns(), nodepools, provider)
+            t = tpu_solve(pods, mk_sns(), nodepools, provider)
+            assert t.oracle_results is None  # tensor path actually ran
+            o_scheduled = sum(len(c.pods) for c in o.new_node_claims) + sum(
+                len(e.pods) for e in o.existing_nodes
+            )
+            assert t.pods_scheduled == o_scheduled == 400
+            o_nodes = len(o.new_node_claims)
+            assert abs(t.node_count - o_nodes) <= max(1, 0.01 * o_nodes), (
+                f"seed {seed}: tpu {t.node_count} vs oracle {o_nodes}"
+            )
+
+    def test_memory_primary_mix_parity(self):
+        rng = np.random.RandomState(7)
+        pods = [
+            make_pod(
+                requests={
+                    "cpu": "100m",
+                    "memory": ["2Gi", "4Gi", "8Gi"][rng.randint(3)],
+                }
+            )
+            for _ in range(200)
+        ]
+        mk_sns = lambda: [state_node(cpu="16", memory="32Gi") for _ in range(5)]
+        provider = _default_provider()
+        nodepools = [make_nodepool()]
+        o = oracle_solve(pods, mk_sns(), nodepools, provider)
+        t = tpu_solve(pods, mk_sns(), nodepools, provider)
+        o_nodes = len(o.new_node_claims)
+        assert t.pods_scheduled == 200
+        # memory-primary mixes stress the K-open eviction heuristic
+        # (primary-axis headroom only — see ffd_pack); bounded drift
+        assert abs(t.node_count - o_nodes) <= max(2, 0.02 * o_nodes)
+
+
+class TestMixedTensorOracleCapacity:
+    def test_no_capacity_double_use(self):
+        """Tensor-placed pods must shrink what the oracle sees: spread
+        pods (oracle) + plain pods (tensor) sharing one node can't
+        overcommit it."""
+        sns = [state_node(cpu="4", name="only-node")]
+        plain = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        spready = [
+            make_pod(
+                requests={"cpu": "1"},
+                labels={"app": "web"},
+                topology_spread=[spread(wk.LABEL_HOSTNAME, labels={"app": "web"})],
+            )
+            for _ in range(2)
+        ]
+        res = tpu_solve(plain + spready, sns)
+        # plain pods fill the node on the tensor path
+        assert sum(len(p.pod_indices) for p in res.existing_plans) == 4
+        # spread pods went to the oracle, which saw a FULL node
+        assert res.oracle_results is not None
+        oracle_on_node = sum(len(e.pods) for e in res.oracle_results.existing_nodes)
+        assert oracle_on_node == 0
+        assert res.pods_scheduled == 6
+
+
+class TestProvisionerIntegration:
+    def test_nominates_instead_of_creating(self):
+        from karpenter_core_tpu.provisioning.provisioner import Provisioner
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        kube = KubeClient()
+        provider = _default_provider()
+        nodepool = make_nodepool()
+        kube.create(nodepool)
+        node = make_node(
+            labels={
+                wk.NODEPOOL_LABEL_KEY: "default",
+                wk.NODE_REGISTERED_LABEL_KEY: "true",
+                wk.NODE_INITIALIZED_LABEL_KEY: "true",
+            },
+            capacity={"cpu": "8", "memory": "32Gi", "pods": "100"},
+        )
+        kube.create(node)
+        for _ in range(4):
+            kube.create(make_pod(requests={"cpu": "1"}))
+        cluster = Cluster(kube, provider)
+        Informers(kube, cluster).start()
+        prov = Provisioner(kube, provider, cluster, use_tpu_solver=True)
+        names, reason = prov.reconcile()
+        assert names == []  # capacity suffices: nominations, no claims
+        assert reason is None
+        assert kube.list("NodeClaim") == []
+
+    def test_overflow_creates_claims(self):
+        from karpenter_core_tpu.provisioning.provisioner import Provisioner
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        kube = KubeClient()
+        provider = _default_provider()
+        kube.create(make_nodepool())
+        node = make_node(
+            labels={
+                wk.NODEPOOL_LABEL_KEY: "default",
+                wk.NODE_REGISTERED_LABEL_KEY: "true",
+                wk.NODE_INITIALIZED_LABEL_KEY: "true",
+            },
+            capacity={"cpu": "2", "memory": "8Gi", "pods": "100"},
+        )
+        kube.create(node)
+        for _ in range(6):
+            kube.create(make_pod(requests={"cpu": "1"}))
+        cluster = Cluster(kube, provider)
+        Informers(kube, cluster).start()
+        prov = Provisioner(kube, provider, cluster, use_tpu_solver=True)
+        names, reason = prov.reconcile()
+        assert len(names) >= 1  # overflow launched new capacity
+        assert kube.list("NodeClaim") != []
