@@ -324,13 +324,34 @@ def pod_signature(pod: Pod, relevant_label_keys: Optional[Set[str]] = None) -> t
         labels_key = tuple(
             sorted((k, v) for k, v in pod.metadata.labels.items() if k in relevant_label_keys)
         )
-    # fast path: fully unconstrained pod (the common case at 50k scale)
+    # host ports and PVC-backed volumes are per-node stateful constraints
+    # (hostportusage.go:70-90, volumeusage.go:79-178) — they join the
+    # signature so port/volume-bearing pods never silently share a group
+    # with unconstrained ones
     spec = pod.spec
+    ports_key = tuple(
+        sorted(
+            (p.host_ip or "", p.host_port, p.protocol or "TCP")
+            for c in spec.containers + spec.init_containers
+            for p in c.ports
+            if p.host_port
+        )
+    )
+    volumes_key = tuple(
+        sorted(
+            (v.name, v.persistent_volume_claim or "", bool(v.ephemeral))
+            for v in spec.volumes
+            if v.persistent_volume_claim is not None or v.ephemeral
+        )
+    )
+    # fast path: fully unconstrained pod (the common case at 50k scale)
     if (
         spec.affinity is None
         and not spec.node_selector
         and not spec.tolerations
         and not spec.topology_spread_constraints
+        and not ports_key
+        and not volumes_key
     ):
         return (pod.namespace, labels_key, (), (), (), (), (), ())
     spreads = tuple(
@@ -382,6 +403,8 @@ def pod_signature(pod: Pod, relevant_label_keys: Optional[Set[str]] = None) -> t
         node_aff_key,
         pod_aff_key,
         anti_aff_key,
+        ports_key,
+        volumes_key,
     )
 
 
@@ -413,6 +436,21 @@ class SignatureGroup:
                 sel = term.label_selector
                 if sel is None or not sel.matches(self.exemplar.metadata.labels):
                     return True  # anti-affinity against other pods — relational
+        return False
+
+    @property
+    def has_stateful_node_constraints(self) -> bool:
+        """Host ports / PVC volumes need per-node conflict state the pack
+        matrix doesn't model (hostportusage.go:70, volumeusage.go:79) —
+        these groups route to the oracle."""
+        spec = self.exemplar.spec
+        for c in spec.containers + spec.init_containers:
+            for p in c.ports:
+                if p.host_port:
+                    return True
+        for v in spec.volumes:
+            if v.persistent_volume_claim is not None or v.ephemeral:
+                return True
         return False
 
     @property

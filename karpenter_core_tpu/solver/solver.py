@@ -275,13 +275,17 @@ class TPUScheduler:
         self._frontier_cache: Dict[tuple, np.ndarray] = {}
         self._alloc_full_cache: Dict[tuple, np.ndarray] = {}
         groups = group_pods(pods)
-        relational = [g for g in groups if g.has_relational]
-        tensor_groups = [g for g in groups if not g.has_relational]
+        relational = [
+            g for g in groups if g.has_relational or g.has_stateful_node_constraints
+        ]
+        tensor_groups = [g for g in groups if g not in relational]
         # pods *selected by* a relational pod's affinity terms must schedule
         # in the same (oracle) world, or affinity can't anchor to them
         selectors = []
         for g in relational:
             a = g.exemplar.spec.affinity
+            if a is None:  # stateful (port/volume) group, no affinity terms
+                continue
             for terms in (
                 (a.pod_affinity.required if a.pod_affinity else []),
                 ([w.pod_affinity_term for w in a.pod_affinity.preferred] if a.pod_affinity else []),
@@ -314,14 +318,18 @@ class TPUScheduler:
             oracle_groups = oracle_groups + spreadish
         # plain groups whose labels match an oracle-routed group's spread
         # selector must schedule in the same (oracle) world, or the
-        # topology skew counts would miss their placements
-        spread_sels = [
-            c.label_selector
-            for g in oracle_groups
-            for c in g.exemplar.spec.topology_spread_constraints
-            if c.label_selector is not None
-        ]
-        if spread_sels:
+        # topology skew counts would miss their placements. Fixpoint: a
+        # pulled group's own spread selectors can pull further groups.
+        frontier = list(oracle_groups)
+        while frontier and tensor_groups:
+            spread_sels = [
+                c.label_selector
+                for g in frontier
+                for c in g.exemplar.spec.topology_spread_constraints
+                if c.label_selector is not None
+            ]
+            if not spread_sels:
+                break
             pulled_spread = [
                 g
                 for g in tensor_groups
@@ -329,6 +337,7 @@ class TPUScheduler:
             ]
             tensor_groups = [g for g in tensor_groups if g not in pulled_spread]
             oracle_groups = oracle_groups + pulled_spread
+            frontier = pulled_spread
         oracle_pods: List[Pod] = [
             pods[i] for g in oracle_groups for i in g.pod_indices
         ]
@@ -384,8 +393,8 @@ class TPUScheduler:
         """Pack signature groups onto existing/in-flight capacity before
         opening any new node (scheduler.go:241-246; existingnode.go:64-120
         semantics: taints → node-label/requirement compat → resource fits;
-        host-port/volume bookkeeping is committed via update_for_pod when
-        the oracle runs after us).
+        host-port/volume-bearing groups never reach this path — they
+        route to the oracle at solve() group split).
 
         Encoding: nodes become an (M, R) free-capacity matrix (available
         minus remaining daemon overhead) in the oracle's try-order
@@ -395,7 +404,6 @@ class TPUScheduler:
         and the pack itself is the native/scan first-fit."""
         from ..kube.objects import OP_IN
         from ..scheduling import Requirement
-        from ..scheduling.hostports import get_host_ports
         from ..scheduling.requirements import label_requirements
         from ..scheduling.requirements import pod_requirements as _pod_reqs
 
@@ -403,19 +411,6 @@ class TPUScheduler:
         M = len(nodes)
         if M == 0 or not groups:
             return
-
-        def _needs_oracle_checks(pod: Pod) -> bool:
-            """Host-port conflicts and CSI volume limits are per-node
-            stateful checks (existingnode.go:64-82) the pack matrix
-            doesn't model yet — pods carrying either stay out of the
-            existing-node pack (conservative: they open new nodes rather
-            than risk an invalid nomination)."""
-            if get_host_ports(pod):
-                return True
-            for v in pod.spec.volumes:
-                if v.persistent_volume_claim is not None or v.ephemeral:
-                    return True
-            return False
         if self._all_requests is None:
             self._all_requests = [resources.requests_for_pods(p) for p in pods]
         all_requests = self._all_requests
@@ -425,18 +420,22 @@ class TPUScheduler:
             batch_requests,
         )
 
+        # one Taints/label-requirements view per node, shared by the
+        # daemon-overhead, class-column, and hostname passes below
+        node_taints = [Taints(n.taints()) for n in nodes]
+        node_labels = [n.labels() for n in nodes]
+        node_label_reqs = [label_requirements(lbls) for lbls in node_labels]
+
         # free capacity: available minus REMAINING daemon overhead
         # (expected daemons that fit the node, less those already present,
         # floored at zero — existingnode.go:43-52)
         free = np.zeros((M, axis.count), dtype=np.int32)
         for m, node in enumerate(nodes):
-            node_taints = Taints(node.taints())
-            node_label_reqs = label_requirements(node.labels())
             daemons = [
                 p
                 for p in daemonset_pods
-                if node_taints.tolerates(p) is None
-                and node_label_reqs.compatible(_pod_reqs(p)) is None
+                if node_taints[m].tolerates(p) is None
+                and node_label_reqs[m].compatible(_pod_reqs(p)) is None
             ]
             expected = resources.requests_for_pods(*daemons) if daemons else {}
             remaining_daemon = {
@@ -460,15 +459,14 @@ class TPUScheduler:
         compat = np.zeros((S, M), dtype=np.uint8)
         class_cols: Dict[tuple, np.ndarray] = {}
         for m, node in enumerate(nodes):
-            labels = node.labels()
+            labels = node_labels[m]
             ckey = (
                 tuple(sorted((k, v) for k, v in labels.items() if k != wk.LABEL_HOSTNAME)),
                 tuple(sorted((t.key, t.value, t.effect) for t in node.taints())),
             )
             col = class_cols.get(ckey)
             if col is None:
-                node_taints = Taints(node.taints())
-                node_reqs = label_requirements(
+                class_reqs = label_requirements(
                     {k: v for k, v in labels.items() if k != wk.LABEL_HOSTNAME}
                 )
                 col = np.zeros(S, dtype=np.uint8)
@@ -476,37 +474,30 @@ class TPUScheduler:
                     if s in hostname_sigs:
                         continue  # resolved per node below
                     col[s] = (
-                        node_taints.tolerates(g.exemplar) is None
-                        and node_reqs.compatible(sig_reqs[s]) is None
+                        node_taints[m].tolerates(g.exemplar) is None
+                        and class_reqs.compatible(sig_reqs[s]) is None
                     )
                 class_cols[ckey] = col
             compat[:, m] = col
         for s in hostname_sigs:
             g = groups[s]
             for m, node in enumerate(nodes):
-                node_reqs = label_requirements(node.labels())
+                node_reqs = Requirements(*node_label_reqs[m].values_list())
                 node_reqs.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [node.hostname()]))
                 compat[s, m] = (
-                    Taints(node.taints()).tolerates(g.exemplar) is None
+                    node_taints[m].tolerates(g.exemplar) is None
                     and node_reqs.compatible(sig_reqs[s]) is None
                 )
         if not compat.any():
             return
 
         # global pack in the oracle's pod order: all pods descending by
-        # (primary, memory) — queue.go:76; host-port/volume-bearing pods
-        # are held back for per-node stateful checks
-        pairs = [
-            (i, s)
-            for s, g in enumerate(groups)
-            for i in g.pod_indices
-            if not _needs_oracle_checks(pods[i])
-        ]
-        if not pairs:
-            return
-        pod_idx = np.array([i for i, _ in pairs], dtype=np.int64)
-        sig_ids = np.array([s for _, s in pairs], dtype=np.int32)
-        reqs = build_requests_matrix([all_requests[i] for i, _ in pairs], axis)
+        # (primary, memory) — queue.go:76
+        pod_idx = np.array([i for g in groups for i in g.pod_indices], dtype=np.int64)
+        sig_ids = np.array(
+            [s for s, g in enumerate(groups) for _ in g.pod_indices], dtype=np.int32
+        )
+        reqs = build_requests_matrix(batch_requests, axis)
         order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
         pod_idx, sig_ids, reqs = pod_idx[order], sig_ids[order], reqs[order]
         assign, _ = run_pack_existing(reqs, sig_ids, compat, free)
@@ -688,52 +679,163 @@ class TPUScheduler:
             (np.asarray(fut), zone_ok, ct_ok) for fut, zone_ok, ct_ok in pending
         ]
 
-        # --- pack: prepare every group/zone job, ONE batched device call,
-        # then finalize (single dispatch + single host sync per solve)
-        jobs: List[tuple] = []
-        metas: List[dict] = []
-        # pass 1: pool choice per signature group (scheduler.go:256-283)
-        infos: List[dict] = []
-        for gi, group in enumerate(groups):
-            if not leftover[gi]:
-                continue  # fully placed on existing capacity
-            info = self._choose_pool(
-                gi, group, pods, pools, encoded, sig_compats, allowed_per_pool,
-                result, leftover[gi],
-            )
-            if info is not None:
-                infos.append(info)
-        # pass 2: class-merged jobs — groups with identical pool/mask
-        # fingerprints pack TOGETHER, and unpinned pods ride along into
-        # zone-spread buckets (the oracle mixes compatible pods onto
-        # shared nodes; per-group packing alone makes strictly more
-        # nodes whenever a batch must fan out across zones anyway)
-        self._prepare_class_jobs(
-            infos,
-            pods,
-            matrices,
-            pool_entries,
-            pools,
-            encoded,
-            daemon_requests,
-            result,
-            jobs,
-            metas,
+        # --- pack rounds: prepare every group/zone job, ONE batched device
+        # call, finalize, then enforce NodePool limits with a running
+        # reduction over the emitted plans (scheduler.go:347-383). Plans
+        # that no longer fit a limited pool are stripped and their pods
+        # retried against the surviving pools/types next round; bounded
+        # rounds guarantee termination.
+        remaining = self._initial_remaining(pools, state_nodes or [])
+        # only _enforce_limits reads this; skip on the unlimited hot path
+        gi_of = (
+            {i: gi for gi, g in enumerate(groups) for i in g.pod_indices}
+            if remaining
+            else {}
         )
-        packed = batch_pack(jobs)
-        records: List[dict] = []
-        # small plans: every (uncapped) node joins the merge pass — the
-        # oracle also back-fills leftover space on full nodes. Large
-        # plans: only underfull tails (bounds the O(N·K·T) merge cost).
-        total_nodes = sum(int(c) for _, c in packed)
-        merge_all = total_nodes <= 256
-        for meta, (node_ids, node_count) in zip(metas, packed):
-            self._finalize_job(meta, node_ids, node_count, pods, result, records, merge_all)
-        # cross-group consolidation: merge underfull tail nodes whose
-        # requirement/offering intersections still admit a shared type
-        # (the oracle mixes compatible pods freely — scheduler.go:143-147's
-        # alternating-A,B canary; per-group packing alone can't)
-        self._merge_and_emit(records, pods, result)
+        last_chosen: Dict[int, str] = {}
+        pending_idx: Dict[int, List[int]] = {
+            gi: idx for gi, idx in leftover.items() if idx
+        }
+        max_rounds = max(len(pools) + 1, 4) if remaining else 1
+        for _round in range(max_rounds):
+            if not pending_idx:
+                break
+            limit_masks = self._limit_masks(pools, encoded, remaining)
+            jobs: List[tuple] = []
+            metas: List[dict] = []
+            # pass 1: pool choice per signature group (scheduler.go:256-283)
+            infos: List[dict] = []
+            for gi in sorted(pending_idx):
+                info = self._choose_pool(
+                    gi, groups[gi], pods, pools, encoded, sig_compats,
+                    allowed_per_pool, result, pending_idx[gi], limit_masks,
+                )
+                if info is not None:
+                    infos.append(info)
+            # pass 2: class-merged jobs — groups with identical pool/mask
+            # fingerprints pack TOGETHER, and unpinned pods ride along into
+            # zone-spread buckets (the oracle mixes compatible pods onto
+            # shared nodes; per-group packing alone makes strictly more
+            # nodes whenever a batch must fan out across zones anyway)
+            self._prepare_class_jobs(
+                infos,
+                pods,
+                matrices,
+                pool_entries,
+                pools,
+                encoded,
+                daemon_requests,
+                result,
+                jobs,
+                metas,
+            )
+            packed = batch_pack(jobs)
+            records: List[dict] = []
+            # small plans: every (uncapped) node joins the merge pass — the
+            # oracle also back-fills leftover space on full nodes. Large
+            # plans: only underfull tails (bounds the O(N·K·T) merge cost).
+            total_nodes = sum(int(c) for _, c in packed)
+            merge_all = total_nodes <= 256
+            plans_start = len(result.node_plans)
+            for meta, (node_ids, node_count) in zip(metas, packed):
+                self._finalize_job(meta, node_ids, node_count, pods, result, records, merge_all)
+            # cross-group consolidation: merge underfull tail nodes whose
+            # requirement/offering intersections still admit a shared type
+            # (the oracle mixes compatible pods freely — scheduler.go:143-147's
+            # alternating-A,B canary; per-group packing alone can't)
+            self._merge_and_emit(records, pods, result)
+            if not remaining:
+                pending_idx = {}
+                break
+            last_chosen.update(
+                {info["gi"]: pools[info["chosen"]].nodepool.name for info in infos}
+            )
+            pending_idx = self._enforce_limits(result, plans_start, remaining, gi_of)
+        # pods still pending after the bounded rounds: limits starved them
+        for gi, idx in pending_idx.items():
+            pool_name = last_chosen.get(gi, pools[0].nodepool.name if pools else "")
+            for i in idx:
+                result.pod_errors.setdefault(
+                    pods[i].uid,
+                    f'all available instance types exceed limits for nodepool: "{pool_name}"',
+                )
+
+    # ------------------------------------------------------------------
+    # NodePool limits (scheduler.go:76-80, 287-321, 347-383)
+
+    @staticmethod
+    def _initial_remaining(pools: List[PoolEncoding], state_nodes: list) -> Dict[str, dict]:
+        """Per limited pool: spec limits minus the capacity of its
+        existing nodes (scheduler.go:76-80 + :287-321)."""
+        remaining: Dict[str, dict] = {}
+        for pool in pools:
+            limits = pool.nodepool.spec.limits
+            if limits:
+                remaining[pool.nodepool.name] = dict(limits)
+        if remaining:
+            for n in state_nodes:
+                name = n.labels().get(wk.NODEPOOL_LABEL_KEY, "")
+                if name in remaining:
+                    remaining[name] = resources.subtract(remaining[name], n.capacity())
+        return remaining
+
+    def _limit_masks(
+        self,
+        pools: List[PoolEncoding],
+        encoded: List[EncodedInstanceTypes],
+        remaining: Dict[str, dict],
+    ) -> Optional[List[Optional[np.ndarray]]]:
+        """Per pool, the (T,) mask of instance types whose capacity still
+        fits under the pool's remaining limits (filterByRemainingResources,
+        scheduler.go:367-383); None for unlimited pools."""
+        if not remaining:
+            return None
+        masks: List[Optional[np.ndarray]] = []
+        for pool, enc in zip(pools, encoded):
+            rem = remaining.get(pool.nodepool.name)
+            if rem is None:
+                masks.append(None)
+                continue
+            mask = np.ones(len(enc.instance_types), dtype=bool)
+            for t, it in enumerate(enc.instance_types):
+                for name, r in rem.items():
+                    if it.capacity.get(name, 0) > r:
+                        mask[t] = False
+                        break
+            masks.append(mask)
+        return masks
+
+    def _enforce_limits(
+        self,
+        result: SolverResult,
+        plans_start: int,
+        remaining: Dict[str, dict],
+        gi_of: Dict[int, int],
+    ) -> Dict[int, List[int]]:
+        """Running reduction over this round's emitted plans in order:
+        subtract each plan's pinned instance-type capacity from its
+        pool's remaining limits; plans that no longer fit are stripped
+        and their pods returned for the next round (the reference's
+        subtractMax is pessimistic over ALL surviving type options
+        because its claims launch an unknown type — our plans pin the
+        type, so exact subtraction is faithful to what actually
+        launches)."""
+        kept: List[NodePlan] = []
+        spilled: Dict[int, List[int]] = {}
+        for plan in result.node_plans[plans_start:]:
+            rem = remaining.get(plan.nodepool_name)
+            if rem is None:
+                kept.append(plan)
+                continue
+            cap = plan.instance_type.capacity
+            if any(cap.get(name, 0) > r for name, r in rem.items()):
+                for i in plan.pod_indices:
+                    spilled.setdefault(gi_of[i], []).append(i)
+                continue
+            remaining[plan.nodepool_name] = resources.subtract(rem, cap)
+            kept.append(plan)
+        result.node_plans[plans_start:] = kept
+        return spilled
 
     # ------------------------------------------------------------------
 
@@ -748,22 +850,43 @@ class TPUScheduler:
         allowed_per_pool,
         result: SolverResult,
         indices: List[int],
+        limit_masks: Optional[List[Optional[np.ndarray]]] = None,
     ) -> Optional[dict]:
         """First pool (weight order) whose template accepts the signature
-        and offers at least one viable type (scheduler.go:256-283).
+        and offers at least one viable type within its remaining limits
+        (scheduler.go:256-283 + filterByRemainingResources :367).
         ``indices`` is the group's still-unplaced subset (pods already on
         existing nodes never consult nodepools)."""
         chosen = None
+        chosen_viable = None
+        limit_starved: List[str] = []
         for pi, pool in enumerate(pools):
+            if not sig_compats[pi][gi].compatible:
+                continue
             compat_row = allowed_per_pool[pi][0][gi]
-            if sig_compats[pi][gi].compatible and compat_row.any():
+            if limit_masks is not None and limit_masks[pi] is not None:
+                viable_row = compat_row & limit_masks[pi]
+                if compat_row.any() and not viable_row.any():
+                    limit_starved.append(pool.nodepool.name)
+                    continue
+            else:
+                viable_row = compat_row
+            if viable_row.any():
                 chosen = pi
+                chosen_viable = viable_row
                 break
         if chosen is None:
-            err = "; ".join(
-                f'incompatible with nodepool "{p.nodepool.name}", {sig_compats[pi][gi].error or "no viable instance type"}'
-                for pi, p in enumerate(pools)
-            )
+            parts = []
+            for pi, p in enumerate(pools):
+                if p.nodepool.name in limit_starved:
+                    parts.append(
+                        f'all available instance types exceed limits for nodepool: "{p.nodepool.name}"'
+                    )
+                else:
+                    parts.append(
+                        f'incompatible with nodepool "{p.nodepool.name}", {sig_compats[pi][gi].error or "no viable instance type"}'
+                    )
+            err = "; ".join(parts)
             for i in indices:
                 result.pod_errors[pods[i].uid] = err
             return None
@@ -778,9 +901,10 @@ class TPUScheduler:
 
         return dict(
             group=group,
+            gi=gi,
             indices=indices,
             chosen=chosen,
-            viable=allowed_per_pool[chosen][0][gi],  # (T,) bool
+            viable=chosen_viable,  # (T,) bool, limit-filtered
             zone_ok=allowed_per_pool[chosen][1][gi],  # (Z,)
             ct_ok=allowed_per_pool[chosen][2][gi],  # (C,)
             max_per_node=max_per_node,

@@ -140,15 +140,25 @@ class TestExistingPackTensorPath:
 
 
 class TestConservativeExclusions:
-    def test_host_port_pods_skip_existing_pack(self):
+    def test_host_port_pods_route_to_oracle(self):
         sns = [state_node(cpu="8")]
         pods = [make_pod(requests={"cpu": "1"}, host_ports=[8080]) for _ in range(2)]
         res = tpu_solve(pods, sns)
-        # conservative: stateful per-node port checks aren't modeled, so
-        # port-bearing pods open new capacity instead of risking a bad
-        # nomination (both would conflict on one node anyway)
+        # port-bearing groups go to the oracle, which models per-node
+        # port state: the two conflicting pods land on DIFFERENT nodes
+        assert res.oracle_results is not None
         assert not res.existing_plans
         assert res.pods_scheduled == 2
+        on_existing = sum(len(e.pods) for e in res.oracle_results.existing_nodes)
+        new_claims = res.oracle_results.new_node_claims
+        assert on_existing == 1 and len(new_claims) == 1
+
+    def test_host_port_pods_never_copacked_on_new_node(self):
+        # no existing capacity: conflicting-port pods must still split
+        pods = [make_pod(requests={"cpu": "1"}, host_ports=[8080]) for _ in range(2)]
+        res = tpu_solve(pods, [])
+        assert res.pods_scheduled == 2
+        assert res.node_count == 2
 
     def test_overcommitted_node_rejected(self):
         sn = state_node(cpu="2")
@@ -159,6 +169,42 @@ class TestConservativeExclusions:
         res = tpu_solve(pods, [sn])
         assert not res.existing_plans  # negative-axis node rejects all pods
         assert sum(len(p.pod_indices) for p in res.node_plans) == 1
+
+    def test_pvc_zone_pin_honored_via_tpu_entrypoint(self):
+        """A pod whose bound PV pins a zone must land in that zone when
+        scheduled through the TPU entry point (volumetopology.go:42-79;
+        PVC-bearing groups route to the oracle, which injects the pin)."""
+        from karpenter_core_tpu.kube.objects import (
+            PersistentVolume,
+            PersistentVolumeClaim,
+            StorageClass,
+            Volume,
+        )
+
+        kube = KubeClient()
+        sc = StorageClass()
+        sc.metadata.name = "standard"
+        sc.provisioner = "ebs.csi.aws.com"
+        kube.create(sc)
+        pv = PersistentVolume()
+        pv.metadata.name = "pv-1"
+        pv.zones = ["test-zone-2"]
+        pv.driver = "ebs.csi.aws.com"
+        kube.create(pv)
+        pvc = PersistentVolumeClaim()
+        pvc.metadata.name = "data"
+        pvc.storage_class_name = "standard"
+        pvc.volume_name = "pv-1"
+        kube.create(pvc)
+
+        pod = make_pod(requests={"cpu": "100m"})
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="data")]
+        provider = _default_provider()
+        res = TPUScheduler([make_nodepool()], provider, kube_client=kube).solve([pod])
+        assert not res.pod_errors
+        assert res.oracle_results is not None  # PVC group routed to oracle
+        nc = res.oracle_results.new_node_claims[0]
+        assert nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values == {"test-zone-2"}
 
     def test_plain_group_matching_oracle_spread_selector_pulled(self):
         sns = [state_node(cpu="8")]
