@@ -209,6 +209,11 @@ class Metrics:
         self.solver_parity = r.gauge(
             f"{ns}_tpu_solver_packing_parity", "TPU/oracle packing parity ratio"
         )
+        self.solver_phase_duration = r.histogram(
+            f"{ns}_tpu_solver_phase_duration_seconds",
+            "TPU solve phase wall time (existing_pack/encode/pack)",
+            labels=["phase"],
+        )
         # node/nodepool/pod scrapers (metrics/{node,nodepool,pod})
         self.node_allocatable = r.gauge(f"{ns}_nodes_allocatable", "Node allocatable", ["node", "resource"])
         self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])

@@ -108,6 +108,8 @@ class Provisioner:
     # -- schedule (provisioner.go:298) -------------------------------------
 
     def schedule(self) -> Optional[Results]:
+        # scheduling_duration is observed by the operator's reconcile
+        # wrapper (operator.py) — observing here too would double-count
         # snapshot nodes BEFORE listing pods to avoid over-provisioning
         # (provisioner.go:301-312)
         nodes = self.cluster.deep_copy_nodes()
@@ -171,6 +173,7 @@ class Provisioner:
             kube_client=self.kube_client,
             cluster=self.cluster,
             recorder=self.recorder,
+            metrics=self.metrics,
         )
         sr = solver.solve(
             pods,

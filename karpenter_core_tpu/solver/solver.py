@@ -254,6 +254,7 @@ class TPUScheduler:
         kube_client=None,
         cluster=None,
         recorder=None,
+        metrics=None,
     ):
         self.nodepools = order_by_weight(
             [np_ for np_ in nodepools if np_.metadata.deletion_timestamp is None]
@@ -262,10 +263,45 @@ class TPUScheduler:
         self.kube_client = kube_client
         self.cluster = cluster
         self.recorder = recorder
+        self.metrics = metrics
+
+    def _phase(self, name: str):
+        """Timer context for one solve phase → histogram metric (the
+        pprof/trace analogue of operator.go:144-160; SURVEY §5 tracing)."""
+        import contextlib
+
+        if self.metrics is None:
+            return contextlib.nullcontext()
+        return self.metrics.solver_phase_duration.time(phase=name)
 
     # ------------------------------------------------------------------
 
     def solve(
+        self,
+        pods: List[Pod],
+        state_nodes=None,
+        daemonset_pods: Optional[List[Pod]] = None,
+    ) -> SolverResult:
+        """One batched solve. With KARPENTER_TPU_PROFILE_DIR set, the
+        whole solve runs under jax.profiler.trace so device dispatches
+        land in an xprof-readable trace (SURVEY §5's tracing obligation;
+        the reference's --enable-profiling pprof, operator.go:144-160)."""
+        import time as _time
+
+        profile_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
+        t0 = _time.perf_counter()
+        try:
+            if profile_dir:
+                import jax
+
+                with jax.profiler.trace(profile_dir):
+                    return self._solve(pods, state_nodes, daemonset_pods)
+            return self._solve(pods, state_nodes, daemonset_pods)
+        finally:
+            if self.metrics is not None:
+                self.metrics.solver_duration.observe(_time.perf_counter() - t0)
+
+    def _solve(
         self,
         pods: List[Pod],
         state_nodes=None,
@@ -342,22 +378,88 @@ class TPUScheduler:
             pods[i] for g in oracle_groups for i in g.pod_indices
         ]
 
+        self._committed_plans: set = set()
         if tensor_groups:
-            self._solve_tensor(
-                pods,
-                tensor_groups,
-                daemonset_pods or [],
-                result,
-                state_nodes=list(state_nodes or ()),
-            )
+            sns = list(state_nodes or ())
+            self._solve_tensor(pods, tensor_groups, daemonset_pods or [], result, state_nodes=sns)
+            self._relax_and_retry(pods, tensor_groups, daemonset_pods or [], result, sns)
         if oracle_pods:
             # the oracle must see capacity net of tensor-path placements:
             # commit them onto the (already deep-copied) state nodes
-            for plan in result.existing_plans:
-                for i in plan.pod_indices:
-                    plan.state_node.update_for_pod(pods[i])
+            self._commit_existing_plans(pods, result)
             self._solve_oracle(oracle_pods, state_nodes, daemonset_pods, result)
         return result
+
+    def _commit_existing_plans(self, pods: List[Pod], result: SolverResult) -> None:
+        """Reflect tensor placements in the state-node copies (once per
+        plan) so later passes — relaxation retries, the oracle — see
+        capacity net of what's already promised."""
+        for plan in result.existing_plans:
+            if id(plan) in self._committed_plans:
+                continue
+            self._committed_plans.add(id(plan))
+            for i in plan.pod_indices:
+                plan.state_node.update_for_pod(pods[i])
+
+    def _relax_and_retry(
+        self,
+        pods: List[Pod],
+        groups: List[SignatureGroup],
+        daemonset_pods: List[Pod],
+        result: SolverResult,
+        state_nodes: list,
+    ) -> None:
+        """Preference relaxation fixpoint for the tensor path
+        (preferences.go:38-60 ladder, scheduler.go:163-169 re-queue):
+        each round strips ONE soft constraint from every failed group's
+        exemplar (the whole group shares the signature) and re-enters the
+        pipeline with just the failed pods; stops when nothing relaxes.
+
+        Known divergence from the oracle's requeue: retried pods see
+        existing state nodes (net of committed placements) but not this
+        solve's earlier NEW-node plans, so a relaxed group can open a
+        node where the oracle would back-fill an in-flight claim —
+        bounded to relaxed groups, which are rare in large batches."""
+        from ..kube.objects import EFFECT_PREFER_NO_SCHEDULE
+        from ..scheduler.preferences import Preferences
+
+        prefs = Preferences(
+            any(
+                t.effect == EFFECT_PREFER_NO_SCHEDULE
+                for np_ in self.nodepools
+                for t in np_.spec.template.taints
+            )
+        )
+        import copy as _copy
+
+        for _ in range(10):  # ladder depth bound (terms strip one per round)
+            retry: List[SignatureGroup] = []
+            for g in groups:
+                failed = [i for i in g.pod_indices if pods[i].uid in result.pod_errors]
+                if not failed:
+                    continue
+                # relax a COPY: the exemplar is the live stored Pod (the
+                # kube client returns its objects), and a persisted
+                # relaxation would survive into future reconciles — the
+                # reference resets by re-listing fresh pods each loop
+                exemplar = _copy.deepcopy(g.exemplar)
+                if not prefs.relax(exemplar):
+                    continue
+                retry.append(
+                    SignatureGroup(
+                        signature=g.signature, exemplar=exemplar, pod_indices=failed
+                    )
+                )
+            if not retry:
+                return
+            for g in retry:
+                for i in g.pod_indices:
+                    result.pod_errors.pop(pods[i].uid, None)
+            # capacity promised to earlier placements must be visible
+            # before the retry packs onto existing nodes again
+            self._commit_existing_plans(pods, result)
+            self._solve_tensor(pods, retry, daemonset_pods, result, state_nodes=state_nodes)
+            groups = retry
 
     # ------------------------------------------------------------------
 
@@ -533,7 +635,10 @@ class TPUScheduler:
             gi: list(g.pod_indices) for gi, g in enumerate(groups)
         }
         if state_nodes:
-            self._pack_existing(pods, groups, daemonset_pods, state_nodes, leftover, result)
+            with self._phase("existing_pack"):
+                self._pack_existing(
+                    pods, groups, daemonset_pods, state_nodes, leftover, result
+                )
             if not any(leftover.values()):
                 return
 
@@ -565,6 +670,9 @@ class TPUScheduler:
                     result.pod_errors[pods[i].uid] = "no nodepool found"
             return
 
+        import time as _time
+
+        _encode_t0 = _time.perf_counter()
         # --- per-pool encoding + compat kernels -------------------------
         # backend resolution can block on a subprocess probe (broken TPU
         # plugin) — resolve it before taking the catalog lock so a slow
@@ -679,6 +787,11 @@ class TPUScheduler:
             (np.asarray(fut), zone_ok, ct_ok) for fut, zone_ok, ct_ok in pending
         ]
 
+        if self.metrics is not None:
+            self.metrics.solver_phase_duration.observe(
+                _time.perf_counter() - _encode_t0, phase="encode"
+            )
+        _pack_t0 = _time.perf_counter()
         # --- pack rounds: prepare every group/zone job, ONE batched device
         # call, finalize, then enforce NodePool limits with a running
         # reduction over the emitted plans (scheduler.go:347-383). Plans
@@ -759,6 +872,10 @@ class TPUScheduler:
                     pods[i].uid,
                     f'all available instance types exceed limits for nodepool: "{pool_name}"',
                 )
+        if self.metrics is not None:
+            self.metrics.solver_phase_duration.observe(
+                _time.perf_counter() - _pack_t0, phase="pack"
+            )
 
     # ------------------------------------------------------------------
     # NodePool limits (scheduler.go:76-80, 287-321, 347-383)

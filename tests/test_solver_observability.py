@@ -1,0 +1,54 @@
+"""Solve-phase observability (SURVEY §5 tracing): duration + per-phase
+histograms observed on every solve; profiler trace capture behind
+KARPENTER_TPU_PROFILE_DIR."""
+
+import os
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.metrics.registry import Metrics
+from karpenter_core_tpu.solver import TPUScheduler
+from karpenter_core_tpu.state.statenode import StateNode
+
+
+def test_phase_histograms_observed():
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(5)
+    metrics = Metrics()
+    node = make_node(
+        labels={
+            wk.NODEPOOL_LABEL_KEY: "default",
+            wk.NODE_REGISTERED_LABEL_KEY: "true",
+            wk.NODE_INITIALIZED_LABEL_KEY: "true",
+        },
+        capacity={"cpu": "2", "memory": "8Gi", "pods": "10"},
+    )
+    solver = TPUScheduler(
+        [make_nodepool()], provider, kube_client=KubeClient(), metrics=metrics
+    )
+    res = solver.solve(
+        [make_pod(requests={"cpu": "1"}) for _ in range(6)],
+        state_nodes=[StateNode(node=node)],
+    )
+    assert res.pods_scheduled == 6
+    assert sum(metrics.solver_duration.totals.values()) == 1
+    text = "\n".join(metrics.solver_phase_duration.collect())
+    for phase in ("existing_pack", "encode", "pack"):
+        assert f'phase="{phase}"' in text, text
+
+
+def test_profile_dir_produces_trace(tmp_path):
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(3)
+    os.environ["KARPENTER_TPU_PROFILE_DIR"] = str(tmp_path)
+    try:
+        solver = TPUScheduler([make_nodepool()], provider, kube_client=KubeClient())
+        res = solver.solve([make_pod(requests={"cpu": "1"})])
+        assert res.pods_scheduled == 1
+    finally:
+        del os.environ["KARPENTER_TPU_PROFILE_DIR"]
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the trace dir
+    produced = [p for p in tmp_path.rglob("*") if p.is_file()]
+    assert produced, "profiler trace directory is empty"

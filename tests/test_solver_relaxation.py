@@ -1,0 +1,133 @@
+"""Preference relaxation on the TENSOR path (preferences.go:38-60,
+scheduler.go:163-169): soft constraints peel off one per round and the
+failed pods re-enter the tensor pipeline — previously they hard-failed
+with pod errors."""
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import (
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+)
+from karpenter_core_tpu.solver import TPUScheduler
+from karpenter_core_tpu.state.statenode import StateNode
+
+
+def _provider():
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(10)
+    return provider
+
+
+def tpu_solve(pods, state_nodes=None, provider=None):
+    return TPUScheduler([make_nodepool()], provider or _provider(), kube_client=KubeClient()).solve(
+        pods, state_nodes=state_nodes
+    )
+
+
+def preferred_zone(zone):
+    return PreferredSchedulingTerm(
+        weight=10,
+        preference=NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", [zone])]
+        ),
+    )
+
+
+class TestTensorRelaxation:
+    def test_impossible_preferred_zone_relaxes(self):
+        pods = [
+            make_pod(requests={"cpu": "1"}, preferred_node_affinity=[preferred_zone("no-such-zone")])
+            for _ in range(3)
+        ]
+        res = tpu_solve(pods)
+        # previously: hard pod_errors; now the preference strips and the
+        # pods schedule via the tensor path
+        assert res.oracle_results is None
+        assert not res.pod_errors
+        assert res.pods_scheduled == 3
+
+    def test_satisfiable_preferred_zone_honored(self):
+        pods = [
+            make_pod(requests={"cpu": "1"}, preferred_node_affinity=[preferred_zone("test-zone-2")])
+            for _ in range(3)
+        ]
+        res = tpu_solve(pods)
+        assert not res.pod_errors
+        assert all(p.zone == "test-zone-2" for p in res.node_plans)
+
+    def test_required_or_terms_drop_first_impossible(self):
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(2)]
+        for p in pods:
+            from karpenter_core_tpu.kube.objects import Affinity, NodeAffinity
+
+            p.spec.affinity = Affinity(
+                node_affinity=NodeAffinity(
+                    required=NodeSelector(
+                        node_selector_terms=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", ["nowhere"])
+                                ]
+                            ),
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"])
+                                ]
+                            ),
+                        ]
+                    )
+                )
+            )
+        res = tpu_solve(pods)
+        # OR semantics: first term impossible → dropped, second satisfiable
+        assert not res.pod_errors
+        assert res.pods_scheduled == 2
+        assert all(p.zone == "test-zone-1" for p in res.node_plans)
+
+    def test_relaxed_pod_lands_on_existing_node(self):
+        """After relaxation the pod must retry EXISTING capacity first,
+        not jump straight to a new node (scheduler.go:241-246 order
+        holds across relaxation rounds)."""
+        node = make_node(
+            labels={
+                wk.NODEPOOL_LABEL_KEY: "default",
+                wk.NODE_REGISTERED_LABEL_KEY: "true",
+                wk.NODE_INITIALIZED_LABEL_KEY: "true",
+                wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            capacity={"cpu": "8", "memory": "32Gi", "pods": "100"},
+        )
+        sn = StateNode(node=node)
+        pods = [
+            make_pod(requests={"cpu": "1"}, preferred_node_affinity=[preferred_zone("no-such-zone")])
+            for _ in range(2)
+        ]
+        res = tpu_solve(pods, state_nodes=[sn])
+        assert not res.pod_errors
+        assert sum(len(p.pod_indices) for p in res.existing_plans) == 2
+        assert not res.node_plans
+
+    def test_relaxation_does_not_mutate_stored_pod(self):
+        """relax() must act on a copy: the exemplar is the live stored
+        Pod, and a persisted relaxation would survive into the next
+        reconcile (the reference re-lists fresh pods each loop)."""
+        pod = make_pod(
+            requests={"cpu": "1"}, preferred_node_affinity=[preferred_zone("no-such-zone")]
+        )
+        res = tpu_solve([pod])
+        assert not res.pod_errors
+        # the stored pod still carries its preference
+        assert pod.spec.affinity.node_affinity.preferred, (
+            "relaxation leaked into the stored pod spec"
+        )
+
+    def test_truly_unschedulable_still_errors(self):
+        pods = [make_pod(requests={"cpu": "10000"})]  # larger than any type
+        res = tpu_solve(pods)
+        assert len(res.pod_errors) == 1
+        assert res.pods_scheduled == 0
