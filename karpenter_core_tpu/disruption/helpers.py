@@ -106,7 +106,13 @@ def simulate_scheduling(
 ) -> Results:
     """helpers.go:73 simulateScheduling: run the scheduler in simulation
     mode over pending + candidate + deleting-node pods minus the candidate
-    nodes, rejecting placements on uninitialized nodes."""
+    nodes, rejecting placements on uninitialized nodes.
+
+    When the provisioner runs the TPU backend, the simulation does too:
+    the displaced pods pack onto the surviving fleet via the tensor
+    existing-capacity path (native/device first-fit) instead of the
+    greedy O(P·M) per-pod loop — the same engine the provisioning path
+    uses, so decisions agree by construction."""
     candidate_names = {c.name() for c in candidates}
     nodes = cluster.deep_copy_nodes()
     deleting = [n for n in nodes if n.marked_for_deletion]
@@ -130,6 +136,10 @@ def simulate_scheduling(
     ]
     if not nodepools:
         raise NodePoolsNotFoundError("no nodepools found")
+    if getattr(provisioner, "use_tpu_solver", False):
+        return _simulate_tpu(
+            kube_client, cluster, provisioner, pods, state_nodes, nodepools
+        )
     scheduler = build_scheduler(
         kube_client,
         cluster,
@@ -144,6 +154,73 @@ def simulate_scheduling(
     results = scheduler.solve(pods)
     # placements that depend on uninitialized nodes don't count
     # (helpers.go:108-115)
+    for existing in results.existing_nodes:
+        if not existing.initialized():
+            for p in existing.pods:
+                results.pod_errors[p.uid] = (
+                    f"would schedule against a non-initialized node {existing.name()}"
+                )
+                results._pods_by_uid[p.uid] = p
+    return results
+
+
+class PlanReplacementClaim:
+    """Adapts a TPU NodePlan to the SchedulingNodeClaim surface the
+    disruption decision core and provisioner.create consume: the plan
+    pins one instance type (what would actually launch), so price
+    filtering and the spot/OD guards operate on that type."""
+
+    def __init__(self, plan, nodepool, pods: List[Pod]):
+        from ..scheduler.nodeclaim import NodeClaimTemplate
+        from ..scheduling import Requirements
+
+        self.template = NodeClaimTemplate(nodepool)
+        self.nodepool_name = plan.nodepool_name
+        self.pods = pods
+        self.requirements = Requirements(
+            *(plan.requirements.values_list() if plan.requirements else ())
+        )
+        self.instance_type_options = [plan.instance_type]
+        self.requests = dict(plan.requests or {})
+
+    def to_node_claim(self, nodepool):
+        return self.template.to_node_claim(
+            nodepool, self.requirements, self.instance_type_options, self.requests
+        )
+
+
+def _simulate_tpu(
+    kube_client, cluster, provisioner, pods: List[Pod], state_nodes, nodepools
+) -> Results:
+    """TPU-backed simulation: one tensor solve over displaced pods +
+    surviving fleet; NodePlans adapt to replacement claims."""
+    from ..solver import TPUScheduler
+
+    solver = TPUScheduler(
+        nodepools, provisioner.cloud_provider, kube_client=kube_client, cluster=cluster
+    )
+    sr = solver.solve(
+        pods, state_nodes=state_nodes, daemonset_pods=cluster.get_daemonset_pods()
+    )
+    results = sr.oracle_results or Results()
+    results.pod_errors.update(sr.pod_errors)
+    results._pods_by_uid.update({p.uid: p for p in pods})
+    nodepool_by_name = {np_.name: np_ for np_ in nodepools}
+    for plan in sr.node_plans:
+        plan_pods = [pods[i] for i in plan.pod_indices]
+        results.new_node_claims.append(
+            PlanReplacementClaim(plan, nodepool_by_name[plan.nodepool_name], plan_pods)
+        )
+    # placements that depend on uninitialized nodes don't count
+    # (helpers.go:108-115) — tensor placements and oracle ones alike
+    for plan in sr.existing_plans:
+        if not plan.state_node.initialized():
+            for i in plan.pod_indices:
+                p = pods[i]
+                results.pod_errors[p.uid] = (
+                    f"would schedule against a non-initialized node {plan.state_node.name()}"
+                )
+                results._pods_by_uid[p.uid] = p
     for existing in results.existing_nodes:
         if not existing.initialized():
             for p in existing.pods:

@@ -242,12 +242,20 @@ class MultiNodeConsolidation(ConsolidationBase):
 
         order = None
         if self.use_tpu_screen:
-            from .tpu_repack import screen_prefixes
+            from .tpu_repack import repack_prefixes, screen_prefixes
 
-            k = screen_prefixes(self.ctx, candidates[:max_n])
-            if k >= 2:
-                # try the screened k first, then fall down
-                order = list(range(k, 1, -1))
+            # two one-dispatch bounds bracket the answer: the capacity
+            # screen is optimistic (upper), the true batched repack is
+            # conservative (lower) — together they replace the
+            # reference's O(log N) simulation probes with usually ≤3
+            # verification solves
+            k_hi = screen_prefixes(self.ctx, candidates[:max_n])
+            k_lo = repack_prefixes(self.ctx, candidates[:max_n])
+            tries = [
+                k for k in dict.fromkeys((k_hi, k_hi - 1, k_hi - 2, k_lo)) if k >= 2
+            ]
+            if tries:
+                order = tries
         if order is None:
             # no usable screen result: the raised TPU cap would make each
             # binary-search probe a near-1000-candidate simulation — fall
@@ -261,10 +269,10 @@ class MultiNodeConsolidation(ConsolidationBase):
             cmd = self._attempt(candidates[:k])
             if cmd is not None:
                 return cmd
-            attempted_min = k
-        # screen over-estimated; binary search the untried sizes below the
-        # smallest prefix we actually attempted, capped so each probe's
-        # simulation stays reference-sized
+            attempted_min = min(attempted_min, k)
+        # both bounds over-estimated; binary search the untried sizes
+        # below the smallest prefix we actually attempted, capped so each
+        # probe's simulation stays reference-sized
         return self._binary_search(
             candidates, min(max_n, attempted_min - 1, MAX_PARALLEL), deadline
         )

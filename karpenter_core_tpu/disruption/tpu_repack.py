@@ -152,6 +152,96 @@ def _largest_launchable(ctx, axis) -> np.ndarray:
     return new_node_cap
 
 
+def repack_prefixes(ctx, candidates: List[Candidate]) -> int:
+    """Largest prefix whose displaced pods actually PACK — a true
+    first-fit against per-node free capacity and label/taint
+    admissibility, not a capacity sum — onto the non-candidate fleet
+    plus one replacement node (SURVEY §7.7's "evaluate candidate
+    prefixes in one batched solve").
+
+    One native/device pack prices every prefix at once: pods are packed
+    in candidate order, bins only ever fill, so prefix k's pack state is
+    a prefix of the single pack sequence. Surviving candidates' free
+    space is deliberately excluded (a placement there would be invalid
+    for any larger prefix that removes the host), which makes the result
+    a LOWER bound on the consolidatable prefix — the optimistic capacity
+    screen (screen_prefixes) is the upper bound, and the oracle verifies
+    whichever prefix is attempted."""
+    from ..solver.encode import extend_axis, group_pods
+    from ..solver.pack import run_pack_existing
+    from ..solver.solver import existing_node_compat
+    from ..utils import pod as podutils
+
+    if len(candidates) < 2:
+        return 0
+    candidate_names = {c.name() for c in candidates}
+    pods_per_candidate = [
+        [p for p in (c.pods or []) if podutils.is_reschedulable(p)] for c in candidates
+    ]
+    flat_pods = [p for ps in pods_per_candidate for p in ps]
+    owner = np.array(
+        [ci for ci, ps in enumerate(pods_per_candidate) for _ in ps], dtype=np.int64
+    )
+
+    fleet_nodes = [
+        n
+        for n in ctx.cluster.deep_copy_nodes()
+        if not n.marked_for_deletion
+        and n.name() not in candidate_names
+        and n.initialized()
+    ]
+    all_requests = [resources.requests_for_pods(p) for p in flat_pods]
+    axis = extend_axis(
+        build_resource_axis([], [c.instance_type for c in candidates]), all_requests
+    )
+    new_node_cap = _largest_launchable(ctx, axis)
+
+    N = len(candidates)
+    if flat_pods:
+        reqs = np.stack([quantize_requests(r, axis) for r in all_requests])
+        # candidate-major order (prefix monotonicity), descending within
+        # each candidate (queue.go:76 ordering inside the unit)
+        order = np.lexsort((-reqs[:, 1], -reqs[:, 0], owner))
+        reqs, owner = reqs[order], owner[order]
+        flat_sorted = [flat_pods[i] for i in order]
+
+        assign = np.full(len(flat_sorted), -1, dtype=np.int32)
+        if fleet_nodes:
+            groups = group_pods(flat_sorted)
+            sig_of = np.zeros(len(flat_sorted), dtype=np.int32)
+            for s, g in enumerate(groups):
+                sig_of[np.asarray(g.pod_indices, dtype=np.int64)] = s
+            compat = existing_node_compat(groups, fleet_nodes)
+            free = np.zeros((len(fleet_nodes), axis.count), dtype=np.int32)
+            for m, node in enumerate(fleet_nodes):
+                avail = node.available()
+                if not any(v < 0 for v in avail.values()):
+                    free[m] = quantize_capacity(avail, axis)
+            if compat.any():
+                assign, _ = run_pack_existing(reqs, sig_of, compat, free)
+
+        # leftovers must fit ONE replacement node: cumulative load per
+        # prefix ≤ the largest launchable allocatable, and every leftover
+        # pod must individually fit it
+        left = assign < 0
+        leftover_load = np.zeros((N, axis.count), dtype=np.int64)
+        pod_fits_new = np.ones(N, dtype=bool)
+        for j in np.flatnonzero(left):
+            ci = owner[j]
+            leftover_load[ci] += reqs[j].astype(np.int64)
+            if np.any(reqs[j] > new_node_cap):
+                pod_fits_new[ci] = False
+        cum = np.cumsum(leftover_load, axis=0)
+        feasible = np.all(cum <= new_node_cap.astype(np.int64)[None, :], axis=1)
+        feasible &= np.cumprod(pod_fits_new)[: N].astype(bool)
+    else:
+        feasible = np.ones(N, dtype=bool)  # nothing displaced: all delete
+
+    if not feasible.any():
+        return 0
+    return int(np.max(np.flatnonzero(feasible))) + 1
+
+
 def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
     """Largest prefix size (≥0) that passes the capacity screen."""
     if len(candidates) < 2:

@@ -180,6 +180,57 @@ def _entry_device_packed(entry: _CatalogEntry):
     return data
 
 
+def existing_node_compat(groups: List["SignatureGroup"], nodes: list) -> np.ndarray:
+    """(S, M) uint8 admissibility of each signature group on each
+    existing node: taints tolerated + node labels satisfy the group's
+    requirements (existingnode.go:64-82). Computed once per node CLASS
+    (labels minus hostname + taints) — fleets have few classes, so the
+    host set algebra is O(S·classes); hostname-pinned signatures resolve
+    per node."""
+    from ..kube.objects import OP_IN
+    from ..scheduling import Requirement
+    from ..scheduling.requirements import label_requirements
+    from ..scheduling.requirements import pod_requirements as _pod_reqs
+
+    S, M = len(groups), len(nodes)
+    sig_reqs = [_pod_reqs(g.exemplar) for g in groups]
+    hostname_sigs = {s for s, r in enumerate(sig_reqs) if wk.LABEL_HOSTNAME in r}
+    compat = np.zeros((S, M), dtype=np.uint8)
+    node_taints = [Taints(n.taints()) for n in nodes]
+    class_cols: Dict[tuple, np.ndarray] = {}
+    for m, node in enumerate(nodes):
+        labels = node.labels()
+        ckey = (
+            tuple(sorted((k, v) for k, v in labels.items() if k != wk.LABEL_HOSTNAME)),
+            tuple(sorted((t.key, t.value, t.effect) for t in node.taints())),
+        )
+        col = class_cols.get(ckey)
+        if col is None:
+            class_reqs = label_requirements(
+                {k: v for k, v in labels.items() if k != wk.LABEL_HOSTNAME}
+            )
+            col = np.zeros(S, dtype=np.uint8)
+            for s, g in enumerate(groups):
+                if s in hostname_sigs:
+                    continue  # resolved per node below
+                col[s] = (
+                    node_taints[m].tolerates(g.exemplar) is None
+                    and class_reqs.compatible(sig_reqs[s]) is None
+                )
+            class_cols[ckey] = col
+        compat[:, m] = col
+    for s in hostname_sigs:
+        g = groups[s]
+        for m, node in enumerate(nodes):
+            node_reqs = label_requirements(node.labels())
+            node_reqs.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [node.hostname()]))
+            compat[s, m] = (
+                node_taints[m].tolerates(g.exemplar) is None
+                and node_reqs.compatible(sig_reqs[s]) is None
+            )
+    return compat
+
+
 @dataclass
 class NodePlan:
     """One node the solver decided to create."""
@@ -500,12 +551,9 @@ class TPUScheduler:
 
         Encoding: nodes become an (M, R) free-capacity matrix (available
         minus remaining daemon overhead) in the oracle's try-order
-        (initialized first, then name); signature × node admissibility is
-        computed once per node CLASS (labels minus hostname + taints) —
-        fleets have few classes, so the host set algebra is O(S·classes),
-        and the pack itself is the native/scan first-fit."""
-        from ..kube.objects import OP_IN
-        from ..scheduling import Requirement
+        (initialized first, then name); admissibility comes from
+        existing_node_compat; the pack itself is the native/scan
+        first-fit."""
         from ..scheduling.requirements import label_requirements
         from ..scheduling.requirements import pod_requirements as _pod_reqs
 
@@ -554,42 +602,9 @@ class TPUScheduler:
             if not any(v < 0 for v in avail.values()):
                 free[m] = quantize_capacity(avail, axis)
 
-        # signature × node admissibility, cached per node class
-        S = len(groups)
-        sig_reqs = [_pod_reqs(g.exemplar) for g in groups]
-        hostname_sigs = {s for s, r in enumerate(sig_reqs) if wk.LABEL_HOSTNAME in r}
-        compat = np.zeros((S, M), dtype=np.uint8)
-        class_cols: Dict[tuple, np.ndarray] = {}
-        for m, node in enumerate(nodes):
-            labels = node_labels[m]
-            ckey = (
-                tuple(sorted((k, v) for k, v in labels.items() if k != wk.LABEL_HOSTNAME)),
-                tuple(sorted((t.key, t.value, t.effect) for t in node.taints())),
-            )
-            col = class_cols.get(ckey)
-            if col is None:
-                class_reqs = label_requirements(
-                    {k: v for k, v in labels.items() if k != wk.LABEL_HOSTNAME}
-                )
-                col = np.zeros(S, dtype=np.uint8)
-                for s, g in enumerate(groups):
-                    if s in hostname_sigs:
-                        continue  # resolved per node below
-                    col[s] = (
-                        node_taints[m].tolerates(g.exemplar) is None
-                        and class_reqs.compatible(sig_reqs[s]) is None
-                    )
-                class_cols[ckey] = col
-            compat[:, m] = col
-        for s in hostname_sigs:
-            g = groups[s]
-            for m, node in enumerate(nodes):
-                node_reqs = Requirements(*node_label_reqs[m].values_list())
-                node_reqs.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [node.hostname()]))
-                compat[s, m] = (
-                    node_taints[m].tolerates(g.exemplar) is None
-                    and node_reqs.compatible(sig_reqs[s]) is None
-                )
+        # signature × node admissibility (shared with the consolidation
+        # repack — disruption/tpu_repack.py)
+        compat = existing_node_compat(groups, nodes)
         if not compat.any():
             return
 
