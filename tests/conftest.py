@@ -19,3 +19,16 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+import pytest
+
+
+@pytest.fixture
+def env():
+    """Shared disruption-test environment (helpers.Env); fixtures only
+    resolve from conftest, so the fixture lives here (ADVICE r2)."""
+    from helpers import Env
+
+    e = Env()
+    yield e
+    e.stop()

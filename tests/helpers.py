@@ -156,3 +156,100 @@ def spread(topology_key: str, max_skew: int = 1, labels: Optional[Dict[str, str]
         label_selector=LabelSelector(match_labels=dict(labels or {})),
         min_domains=min_domains,
     )
+
+
+class Env:
+    """Disruption-test environment: in-memory apiserver + fake provider +
+    cluster state + provisioner + disruption controller (modeled on
+    pkg/test/environment.go's envtest Environment)."""
+
+    def __init__(self, policy=None, consolidate_after=0.0):
+        from karpenter_core_tpu.apis.nodeclaim import (
+            COND_INITIALIZED,
+            COND_LAUNCHED,
+            COND_REGISTERED,
+            NodeClaim,
+        )
+        from karpenter_core_tpu.apis.nodepool import CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_core_tpu.disruption import DisruptionController
+        from karpenter_core_tpu.events import Recorder
+        from karpenter_core_tpu.kube.client import KubeClient
+        from karpenter_core_tpu.provisioning import Provisioner
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        self._NodeClaim = NodeClaim
+        self._lifecycle_conds = (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED)
+        self.now = 10_000.0
+        self.kube = KubeClient()
+        self.provider = FakeCloudProvider()
+        self.provider.instance_types = instance_types(10)
+        self.cluster = Cluster(self.kube, self.provider, clock=self.clock)
+        self.informers = Informers(self.kube, self.cluster)
+        self.informers.start()
+        self.recorder = Recorder()
+        self.provisioner = Provisioner(self.kube, self.provider, self.cluster, recorder=self.recorder)
+        self.nodepool = make_nodepool()
+        self.nodepool.spec.disruption.consolidation_policy = (
+            policy if policy is not None else CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+        )
+        self.nodepool.spec.disruption.consolidate_after = consolidate_after
+        self.kube.create(self.nodepool)
+        self.controller = DisruptionController(
+            self.kube,
+            self.cluster,
+            self.provisioner,
+            self.provider,
+            recorder=self.recorder,
+            clock=self.clock,
+            validation_sleep=lambda t: None,
+        )
+
+    def clock(self):
+        return self.now
+
+    def make_initialized_node(self, instance_type_name="fake-it-4", zone="test-zone-1",
+                              capacity_type="on-demand", pods=()):
+        """An initialized node+claim pair owned by the nodepool."""
+        it = next(i for i in self.provider.get_instance_types(self.nodepool) if i.name == instance_type_name)
+        provider_id = f"fake:///node-{len(self.kube.list('Node'))}"
+        nc = self._NodeClaim()
+        nc.metadata.name = f"claim-{len(self.kube.list('NodeClaim'))}"
+        nc.metadata.labels = {
+            wk.NODEPOOL_LABEL_KEY: self.nodepool.name,
+            wk.LABEL_INSTANCE_TYPE: instance_type_name,
+            wk.LABEL_TOPOLOGY_ZONE: zone,
+            wk.CAPACITY_TYPE_LABEL_KEY: capacity_type,
+        }
+        nc.metadata.annotations = {wk.NODEPOOL_HASH_ANNOTATION_KEY: self.nodepool.static_hash()}
+        nc.status.provider_id = provider_id
+        nc.status.capacity = dict(it.capacity)
+        nc.status.allocatable = it.allocatable()
+        for cond in self._lifecycle_conds:
+            nc.set_condition(cond, "True")
+        self.kube.create(nc)
+        self.provider.created_node_claims[provider_id] = nc
+
+        node = make_node(
+            labels={**nc.metadata.labels,
+                    wk.NODE_REGISTERED_LABEL_KEY: "true", wk.NODE_INITIALIZED_LABEL_KEY: "true"},
+            capacity={k: v for k, v in it.capacity.items()},
+            provider_id=provider_id,
+        )
+        node.status.allocatable = it.allocatable()
+        node.metadata.creation_timestamp = self.now - 100
+        self.kube.create(node)
+        for pod in pods:
+            pod.spec.node_name = node.name
+            pod.status.phase = "Running"
+            pod.status.conditions = []
+            self.kube.create(pod)
+        return node, nc
+
+    def stop(self):
+        self.informers.stop()
+
+
+def running_pod(cpu="100m", labels=None):
+    return make_pod(requests={"cpu": cpu}, labels=labels, pending_unschedulable=False)
