@@ -1,15 +1,26 @@
 """Benchmark: batched TPU scheduling throughput vs the reference's
-enforced floor.
+enforced floor, across the five BASELINE.json evaluation configs.
 
-Config mirrors the reference's profiling grid (BASELINE.md: 400 instance
-types, scheduling_benchmark_test.go:57-77) at 10k pods with the same
-5/7 generic + 2/7 topology-constrained pod mix, solved by the TPU path
-(constraint kernels + FFD scan). Baseline = the reference's test-enforced
-100 pods/sec floor (scheduling_benchmark_test.go:51,177-181).
+Headline mirrors the north star (50k pods x 2k instance types,
+BASELINE.md) with the reference's 5/7 generic + 2/7 topology pod mix;
+baseline = the reference's test-enforced 100 pods/sec floor
+(scheduling_benchmark_test.go:51,177-181). Per-config packing stats
+mirror what the reference benchmark reports per run: nodes created and
+pods-per-node min/max/mean/stddev (scheduling_benchmark_test.go:144-172).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"backend"} — backend records the platform the solve actually ran on so a
-CPU fallback is never mistaken for a TPU number.
+Prints ONE JSON line. Keys:
+  metric/value/unit/vs_baseline  — headline warm-solve throughput
+  backend                        — platform the solve actually ran on
+  probe_error / probe_attempts   — why TPU init failed, when it did
+  cold_ms / warm_ms              — first solve (encode+compile) vs steady state
+  configs                        — the five BASELINE.json configs
+  engines                        — native-C++ vs device pack, XLA vs pallas compat
+
+Backend resolution is deliberately tenacious: the bench window is the
+only environment with chip access, so before falling back to CPU we
+probe the image default and then force-try each known TPU platform with
+a generous offline budget, capturing every attempt's stderr tail so the
+artifact records raise-vs-hang instead of a silent fallback.
 """
 
 from __future__ import annotations
@@ -18,105 +29,566 @@ import json
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
+BASELINE_PODS_PER_SEC = 100.0  # scheduling_benchmark_test.go:51,177-181
 
-def main() -> None:
-    # import inside main so the JSON line is the only stdout on success
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    # resolve the JAX backend up front via the solver's hardened policy
-    # (out-of-process probe with timeout + CPU fallback, one home in
-    # solver.backend); BENCH_* env vars map onto the KARPENTER_TPU_* ones
+def resolve_backend(out: dict) -> str:
+    """Pick the JAX platform for this process, trying hard for the chip.
+
+    Order: BENCH_BACKEND override; image default (the axon pin); then
+    explicit 'axon' and 'tpu'. Fast raises get one retry (transient
+    tunnel flake); hangs are not retried (they cost the full timeout).
+    Every attempt's outcome lands in out["probe_attempts"].
+    """
     from karpenter_core_tpu.solver import backend as backend_mod
 
-    if os.environ.get("BENCH_BACKEND"):
-        os.environ["KARPENTER_TPU_BACKEND"] = os.environ["BENCH_BACKEND"]
-    if os.environ.get("BENCH_PROBE_TIMEOUT"):
-        os.environ["KARPENTER_TPU_PROBE_TIMEOUT"] = os.environ["BENCH_PROBE_TIMEOUT"]
-    backend = backend_mod.default_backend()
+    forced = os.environ.get("BENCH_BACKEND")
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
 
-    from karpenter_core_tpu.apis import labels as wk
-    from karpenter_core_tpu.apis.nodepool import NodePool
-    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    def adopt(platform, name):
+        # pin this process to the probed-good platform and tell the
+        # solver's resolver so it never re-probes
+        if platform:
+            os.environ["JAX_PLATFORMS"] = platform
+            os.environ["KARPENTER_TPU_BACKEND"] = platform
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        return name or jax.default_backend()
+
+    if forced:
+        if forced == "cpu":
+            backend_mod.pin_cpu()
+            return "cpu"
+        return adopt(forced, None)
+
+    attempts = []
+    seen_hang = False
+    default_platform = os.environ.get("JAX_PLATFORMS") or None
+    for platform in (None, "axon", "tpu"):
+        if platform is not None and platform == default_platform:
+            continue  # identical to the default attempt
+        budget = timeout if not seen_hang else min(timeout, 120.0)
+        for retry in range(2):
+            probe = backend_mod.probe_backend(budget, platform=platform)
+            attempts.append(
+                {
+                    "platform": platform or "default",
+                    "backend": probe.backend,
+                    "rc": probe.rc,
+                    "timed_out": probe.timed_out,
+                    "stderr_tail": probe.stderr_tail[-400:],
+                }
+            )
+            if probe.ok and probe.backend != "cpu":
+                out["probe_attempts"] = attempts
+                return adopt(platform, probe.backend)
+            if probe.ok:  # resolved but to CPU — forcing won't change it
+                break
+            if probe.timed_out:
+                seen_hang = True
+                break  # a hang won't heal on immediate retry
+            # fast raise: one cheap retry
+            budget = min(budget, 120.0)
+
+    out["probe_attempts"] = attempts
+    out["probe_error"] = "; ".join(
+        "{}: {}".format(
+            a["platform"],
+            "timeout" if a["timed_out"] else (a["stderr_tail"].strip().splitlines() or ["rc=%s" % a["rc"]])[-1],
+        )
+        for a in attempts
+    )[-2000:]
+    backend_mod.pin_cpu()
+    return "cpu"
+
+
+# ---------------------------------------------------------------------------
+# workload builders (shared by headline + configs)
+# ---------------------------------------------------------------------------
+
+
+def _mk_pod(i, cpu, mem, gpu=None, selector=None, tolerations=None, spread=None, labels=None):
     from karpenter_core_tpu.kube.objects import (
         Container,
-        LabelSelector,
         Pod,
         PodCondition,
         PodSpec,
         ResourceRequirements,
-        TopologySpreadConstraint,
     )
     from karpenter_core_tpu.kube.quantity import parse_quantity
+
+    pod = Pod()
+    pod.metadata.name = f"bench-{i}"
+    pod.metadata.labels = dict(labels or {})
+    requests = {"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+    if gpu:
+        requests["nvidia.com/gpu"] = parse_quantity(gpu)
+    pod.spec = PodSpec(
+        containers=[Container(name="main", resources=ResourceRequirements(requests=requests))]
+    )
+    if selector:
+        pod.spec.node_selector = selector
+    if tolerations:
+        pod.spec.tolerations = tolerations
+    if spread:
+        pod.spec.topology_spread_constraints = spread
+    pod.status.conditions = [
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    ]
+    return pod
+
+
+def packing_stats(result) -> dict:
+    """Reference-parity packing efficiency: nodes created + pods-per-node
+    min/max/mean/stddev (scheduling_benchmark_test.go:144-172)."""
+    per_node = [len(p.pod_indices) for p in result.node_plans]
+    if result.oracle_results is not None:
+        per_node += [len(c.pods) for c in result.oracle_results.new_node_claims]
+    if not per_node:
+        return {"nodes": 0}
+    a = np.asarray(per_node, dtype=np.float64)
+    return {
+        "nodes": int(a.size),
+        "pods_per_node_min": int(a.min()),
+        "pods_per_node_max": int(a.max()),
+        "pods_per_node_mean": round(float(a.mean()), 2),
+        "pods_per_node_stddev": round(float(a.std()), 2),
+    }
+
+
+def _scale(n: int) -> int:
+    """BENCH_SCALE in (0,1] shrinks every pod/node count for smoke runs."""
+    return max(1, int(n * float(os.environ.get("BENCH_SCALE", "1"))))
+
+
+def headline(out: dict) -> None:
+    """North star: 50k pods x 2k types, reference pod mix; cold + warm."""
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
     from karpenter_core_tpu.solver import TPUScheduler
 
-    # default grid = the BASELINE.json north-star config (50k × 2k)
-    N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
-    N_TYPES = int(os.environ.get("BENCH_TYPES", "2000"))
+    n_pods = _scale(int(os.environ.get("BENCH_PODS", "50000")))
+    n_types = _scale(int(os.environ.get("BENCH_TYPES", "2000")))
     rng = np.random.RandomState(42)
 
-    def make_pod(i: int, topo: bool) -> Pod:
-        pod = Pod()
-        pod.metadata.name = f"bench-{i}"
-        pod.metadata.labels = {"app": f"bench-{i % 7}"}
+    pods = []
+    for i in range(n_pods):
         cpu = ["100m", "250m", "500m", "1", "1500m", "2"][rng.randint(6)]
         mem = ["128Mi", "256Mi", "512Mi", "1Gi", "2Gi"][rng.randint(5)]
-        pod.spec = PodSpec(
-            containers=[
-                Container(
-                    name="main",
-                    resources=ResourceRequirements(
-                        requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
-                    ),
-                )
-            ]
-        )
-        if topo:
-            # 2/7 of pods carry zone+hostname spreads like the reference mix
-            pod.spec.topology_spread_constraints = [
+        spread = None
+        labels = {"app": f"bench-{i % 7}"}
+        if (i % 7) >= 5:  # 2/7 topology-spread, like the reference mix
+            spread = [
                 TopologySpreadConstraint(
                     max_skew=1,
                     topology_key=wk.LABEL_TOPOLOGY_ZONE,
                     when_unsatisfiable="DoNotSchedule",
-                    label_selector=LabelSelector(match_labels={"app": pod.metadata.labels["app"]}),
-                ),
+                    label_selector=LabelSelector(match_labels={"app": labels["app"]}),
+                )
             ]
-        pod.status.conditions = [
-            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
-        ]
-        return pod
+        pods.append(_mk_pod(i, cpu, mem, spread=spread, labels=labels))
 
-    pods = [make_pod(i, topo=(i % 7) >= 5) for i in range(N_PODS)]
     provider = FakeCloudProvider()
-    provider.instance_types = instance_types(N_TYPES)
+    provider.instance_types = instance_types(n_types)
     nodepool = NodePool()
     nodepool.metadata.name = "default"
 
+    # cold: what a provisioner restart pays — catalog encode + jit compile
     solver = TPUScheduler([nodepool], provider)
-
-    # warm-up on the full batch so every pad bucket's ffd_pack shape is
-    # compiled before the timed run (jit caches per padded shape)
+    t0 = time.perf_counter()
     solver.solve(pods)
+    cold = time.perf_counter() - t0
 
-    start = time.perf_counter()
+    t0 = time.perf_counter()
     result = solver.solve(pods)
-    elapsed = time.perf_counter() - start
+    warm = time.perf_counter() - t0
 
-    scheduled = result.pods_scheduled
-    pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": f"pods/sec scheduled ({N_PODS} pods x {N_TYPES} instance types, TPU solver)",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/sec",
-                "vs_baseline": round(pods_per_sec / 100.0, 2),
-                "backend": backend,
-            }
-        )
+    pods_per_sec = result.pods_scheduled / warm if warm > 0 else 0.0
+    out.update(
+        {
+            "metric": f"pods/sec scheduled ({n_pods} pods x {n_types} instance types, TPU solver)",
+            "value": round(pods_per_sec, 1),
+            "unit": "pods/sec",
+            "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+            "cold_ms": round(cold * 1000.0, 1),
+            "warm_ms": round(warm * 1000.0, 1),
+            "pods_scheduled": result.pods_scheduled,
+            **{f"packing_{k}": v for k, v in packing_stats(result).items()},
+        }
     )
+
+
+# ---------------------------------------------------------------------------
+# the five BASELINE.json evaluation configs
+# ---------------------------------------------------------------------------
+
+
+def config1() -> dict:
+    """1k uniform CPU-only pods, 10 types, single NodePool — CPU ref path."""
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.scheduler.builder import build_scheduler
+
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(10)
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+    pods = [_mk_pod(i, "500m", "512Mi") for i in range(_scale(1000))]
+
+    sched = build_scheduler(None, None, [nodepool], provider, pods)
+    sched.solve(pods)  # warm (caches pod requirement extraction paths)
+    sched = build_scheduler(None, None, [nodepool], provider, pods)
+    t0 = time.perf_counter()
+    res = sched.solve(pods)
+    dt = time.perf_counter() - t0
+    per_node = [len(c.pods) for c in res.new_node_claims]
+    n = sum(per_node)
+    a = np.asarray(per_node or [0], dtype=np.float64)
+    return {
+        "config": "1: 1k uniform pods x 10 types (CPU oracle path)",
+        "pods_per_sec": round(n / dt, 1) if dt > 0 else 0.0,
+        "nodes": len(res.new_node_claims),
+        "pods_per_node_min": int(a.min()),
+        "pods_per_node_max": int(a.max()),
+        "pods_per_node_mean": round(float(a.mean()), 2),
+        "pods_per_node_stddev": round(float(a.std()), 2),
+    }
+
+
+def config2() -> dict:
+    """10k mixed cpu/mem/gpu pods, 500 types, resource-fit only."""
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import (
+        FakeCloudProvider,
+        instance_types,
+        new_instance_type,
+    )
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    rng = np.random.RandomState(7)
+    provider = FakeCloudProvider()
+    cat = instance_types(480)
+    for g in range(20):  # gpu-bearing types
+        cat.append(
+            new_instance_type(
+                f"fake-gpu-{g}",
+                {"cpu": str(8 * (g + 1)), "memory": f"{16 * (g + 1)}Gi",
+                 "pods": "110", "nvidia.com/gpu": str(min(8, g + 1))},
+            )
+        )
+    provider.instance_types = cat
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+
+    pods = []
+    for i in range(_scale(10_000)):
+        cpu = ["100m", "250m", "500m", "1", "2", "4"][rng.randint(6)]
+        mem = ["128Mi", "512Mi", "1Gi", "2Gi", "4Gi"][rng.randint(5)]
+        gpu = "1" if rng.rand() < 0.1 else None
+        pods.append(_mk_pod(i, cpu, mem, gpu=gpu))
+
+    solver = TPUScheduler([nodepool], provider)
+    solver.solve(pods)
+    t0 = time.perf_counter()
+    res = solver.solve(pods)
+    dt = time.perf_counter() - t0
+    return {
+        "config": "2: 10k mixed cpu/mem/gpu pods x 500 types (TPU)",
+        "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
+        **packing_stats(res),
+    }
+
+
+def config3() -> dict:
+    """50k constrained pods (nodeSelector + tolerations + spread) + parity."""
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        Toleration,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.scheduler.builder import build_scheduler
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    rng = np.random.RandomState(11)
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(_scale(2000))
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+
+    def constrained(i):
+        sel = tol = spread = None
+        labels = {"app": f"svc-{i % 9}"}
+        r = i % 9
+        if r < 3:
+            sel = {wk.CAPACITY_TYPE_LABEL_KEY: ["spot", "on-demand"][i % 2]}
+        elif r < 5:
+            tol = [Toleration(key="dedicated", operator="Exists")]
+        elif r < 7:
+            spread = [TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": labels["app"]}))]
+        cpu = ["100m", "250m", "500m", "1", "1500m", "2"][rng.randint(6)]
+        mem = ["128Mi", "256Mi", "512Mi", "1Gi", "2Gi"][rng.randint(5)]
+        return _mk_pod(i, cpu, mem, selector=sel, tolerations=tol, spread=spread, labels=labels)
+
+    pods = [constrained(i) for i in range(_scale(50_000))]
+    solver = TPUScheduler([nodepool], provider)
+    solver.solve(pods)
+    t0 = time.perf_counter()
+    res = solver.solve(pods)
+    dt = time.perf_counter() - t0
+
+    # packing parity vs the oracle on a subsample (oracle is O(P·N))
+    sub = pods[: _scale(5000)]
+    oracle = build_scheduler(None, None, [nodepool], provider, sub).solve(sub)
+    tpu_sub = TPUScheduler([nodepool], provider).solve(sub)
+    o_nodes = len(oracle.new_node_claims)
+    parity = 1.0 - abs(tpu_sub.node_count - o_nodes) / max(o_nodes, 1)
+    return {
+        "config": "3: 50k constrained pods x 2k types (TPU)",
+        "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
+        "packing_parity_vs_oracle": round(parity, 4),
+        "oracle_nodes_on_subsample": o_nodes,
+        "tpu_nodes_on_subsample": tpu_sub.node_count,
+        **packing_stats(res),
+    }
+
+
+def config4() -> dict:
+    """Multi-node consolidation over 5k underutilized nodes.
+
+    The reference caps candidates at 100 and binary-searches prefixes
+    with a full simulation per probe (multinodeconsolidation.go:34,
+    58-59, 1 min budget); the TPU screen evaluates every prefix of all
+    candidates in one dispatch, then oracle simulations verify the
+    chosen prefix."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from helpers import Env
+
+    from karpenter_core_tpu.disruption.helpers import get_candidates
+    from karpenter_core_tpu.disruption.methods import MultiNodeConsolidation
+
+    env = Env()
+    try:
+        for i in range(_scale(5000)):
+            env.make_initialized_node(
+                instance_type_name="fake-it-4",
+                pods=[_running_pod(f"r-{i}")],
+            )
+        env.now += 3600.0
+        assert env.cluster.synced()
+        method = MultiNodeConsolidation(env.controller.ctx)
+        t0 = time.perf_counter()
+        candidates = get_candidates(
+            env.cluster,
+            env.kube,
+            env.recorder,
+            env.clock,
+            env.provider,
+            method.should_disrupt,
+        )
+        cmd = method.compute_command(candidates)
+        dt = time.perf_counter() - t0
+        return {
+            "config": "4: multi-node consolidation screen, 5k underutilized nodes",
+            "candidates_per_sec": round(len(candidates) / dt, 1) if dt > 0 else 0.0,
+            "candidates": len(candidates),
+            "disrupted": len(cmd.candidates) if cmd else 0,
+            "elapsed_sec": round(dt, 3),
+        }
+    finally:
+        env.stop()
+
+
+def _running_pod(name):
+    from karpenter_core_tpu.kube.objects import (
+        Container,
+        Pod,
+        PodSpec,
+        ResourceRequirements,
+    )
+    from karpenter_core_tpu.kube.quantity import parse_quantity
+
+    pod = Pod()
+    pod.metadata.name = name
+    pod.spec = PodSpec(containers=[Container(
+        name="c", resources=ResourceRequirements(
+            requests={"cpu": parse_quantity("100m"),
+                      "memory": parse_quantity("128Mi")}))])
+    return pod
+
+
+def config5() -> dict:
+    """Spot-price-weighted packing: 2k types x 6 zones, cost objective."""
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import (
+        FakeCloudProvider,
+        new_instance_type,
+        price_from_resources,
+    )
+    from karpenter_core_tpu.cloudprovider.types import Offering
+    from karpenter_core_tpu.kube.quantity import parse_quantity
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    rng = np.random.RandomState(3)
+    zones = [f"test-zone-{z}" for z in range(1, 7)]
+    cat = []
+    for i in range(_scale(2000)):
+        cpu, mem = (i % 64) + 1, 2 * ((i % 64) + 1)
+        res = {"cpu": str(cpu), "memory": f"{mem}Gi", "pods": str(max(110, cpu * 8))}
+        base = price_from_resources({k: parse_quantity(v) for k, v in res.items()})
+        offerings = []
+        for z in zones:
+            od = base * (1.0 + 0.05 * rng.rand())
+            spot = od * (0.25 + 0.5 * rng.rand())  # spot discount varies by zone
+            offerings.append(Offering(wk.CAPACITY_TYPE_ON_DEMAND, z, od))
+            offerings.append(Offering(wk.CAPACITY_TYPE_SPOT, z, spot))
+        cat.append(new_instance_type(f"fake-it-{i}", res, offerings=offerings))
+    provider = FakeCloudProvider()
+    provider.instance_types = cat
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+
+    pods = []
+    for i in range(_scale(10_000)):
+        cpu = ["250m", "500m", "1", "2"][rng.randint(4)]
+        mem = ["512Mi", "1Gi", "2Gi"][rng.randint(3)]
+        pods.append(_mk_pod(i, cpu, mem))
+
+    solver = TPUScheduler([nodepool], provider)
+    solver.solve(pods)
+    t0 = time.perf_counter()
+    res = solver.solve(pods)
+    dt = time.perf_counter() - t0
+    spot_nodes = sum(1 for p in res.node_plans if p.capacity_type == wk.CAPACITY_TYPE_SPOT)
+    return {
+        "config": "5: spot-weighted packing, 2k types x 6 zones (TPU)",
+        "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
+        "total_price_per_hr": round(res.total_price, 2),
+        "spot_node_fraction": round(spot_nodes / max(res.node_count, 1), 3),
+        **packing_stats(res),
+    }
+
+
+# ---------------------------------------------------------------------------
+# engine shootout: device vs native pack, pallas vs XLA compat
+# ---------------------------------------------------------------------------
+
+
+def engine_shootout(backend: str) -> dict:
+    """Time the two pack engines and the two compat kernels at bench
+    scale, so the auto-engine policy and _PALLAS_MIN_S are set from data
+    (VERDICT r2 weak #5)."""
+    import jax
+
+    from karpenter_core_tpu import native
+    from karpenter_core_tpu.solver.kernels import compat_kernel
+    from karpenter_core_tpu.solver.pack import batch_pack
+    from karpenter_core_tpu.solver.pallas_kernels import compat_via_pallas
+
+    rng = np.random.RandomState(5)
+    out: dict = {"backend": backend, "native_available": bool(native.available())}
+
+    # pack: 64 signature groups x 512 pods x 4 resources, 32-row frontier
+    jobs = []
+    for _ in range(64):
+        reqs = rng.randint(1, 200, size=(512, 4)).astype(np.int32)
+        frontier = np.sort(rng.randint(500, 4000, size=(32, 4)).astype(np.int32), axis=0)[::-1].copy()
+        jobs.append((reqs, frontier, 110))
+
+    def timeit(fn, reps=3):
+        fn()  # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps * 1000.0
+
+    if native.available():
+        out["pack_native_ms"] = round(timeit(lambda: batch_pack(jobs, engine="native")), 2)
+    out["pack_device_ms"] = round(timeit(lambda: batch_pack(jobs, engine="device")), 2)
+
+    # compat: S=512 signatures x T=2048 types, two keys (vocab 64 + 8)
+    S, T = 512, 2048
+    keys = ("zone", "arch")
+    sig_arrays = {"valid": np.ones(S, dtype=bool)}
+    type_masks, type_has, type_neg = {}, {}, {}
+    for key, vk in (("zone", 64), ("arch", 8)):
+        sig_arrays[f"mask:{key}"] = rng.rand(S, vk) < 0.3
+        sig_arrays[f"has:{key}"] = rng.rand(S) < 0.8
+        sig_arrays[f"neg:{key}"] = np.zeros(S, dtype=bool)
+        type_masks[key] = rng.rand(T, vk) < 0.3
+        type_has[key] = np.ones(T, dtype=bool)
+        type_neg[key] = np.zeros(T, dtype=bool)
+
+    jt = {k: jax.numpy.asarray(v) for k, v in type_masks.items()}
+    jh = {k: jax.numpy.asarray(v) for k, v in type_has.items()}
+    jn = {k: jax.numpy.asarray(v) for k, v in type_neg.items()}
+    js = {k: jax.numpy.asarray(v) for k, v in sig_arrays.items()}
+
+    out["compat_xla_ms"] = round(
+        timeit(lambda: compat_kernel(js, jt, jh, jn, keys).block_until_ready()), 2
+    )
+    try:
+        interpret = backend == "cpu"  # pallas TPU lowering needs a real chip
+        out["compat_pallas_ms"] = round(
+            timeit(
+                lambda: compat_via_pallas(
+                    sig_arrays, type_masks, type_has, type_neg, keys, interpret=interpret
+                ).block_until_ready()
+            ),
+            2,
+        )
+        out["compat_pallas_interpret"] = interpret
+    except Exception as e:  # pallas lowering may be unsupported on this backend
+        out["compat_pallas_error"] = str(e)[-300:]
+    return out
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    out: dict = {}
+    backend = resolve_backend(out)
+    out["backend"] = backend
+    from karpenter_core_tpu.solver import backend as backend_mod
+
+    if backend != "cpu":
+        out.pop("probe_error", None)  # chip found: attempts are informational
+    elif backend_mod.LAST_PROBE_ERROR and "probe_error" not in out:
+        out["probe_error"] = backend_mod.LAST_PROBE_ERROR
+
+    try:
+        headline(out)
+    except Exception:
+        out["error"] = traceback.format_exc()[-1500:]
+
+    configs = []
+    if os.environ.get("BENCH_CONFIGS", "1") != "0":
+        for fn in (config1, config2, config3, config4, config5):
+            try:
+                configs.append(fn())
+            except Exception:
+                configs.append({"config": fn.__name__, "error": traceback.format_exc()[-800:]})
+        out["configs"] = configs
+
+    try:
+        out["engines"] = engine_shootout(backend)
+    except Exception:
+        out["engines"] = {"error": traceback.format_exc()[-800:]}
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -17,12 +17,16 @@ timeout), and ``default_backend`` (cached resolution with fallback).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import subprocess
 import sys
 from typing import Optional
 
 _BACKEND: Optional[str] = None
+
+#: last probe failure diagnostics, for surfacing in bench artifacts
+LAST_PROBE_ERROR: Optional[str] = None
 
 
 def pin_cpu() -> None:
@@ -33,25 +37,86 @@ def pin_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def probe_backend(timeout: float = 120.0) -> Optional[str]:
-    """Which backend does a fresh interpreter get? None on failure/hang.
+@dataclasses.dataclass
+class ProbeResult:
+    """Outcome of one out-of-process backend probe."""
 
-    Runs ``jax.default_backend()`` in a subprocess so a hanging PJRT init
-    (dead TPU tunnel) costs a bounded timeout instead of blocking the
-    caller forever.
+    platform: Optional[str]  # platform forced for the probe (None = image default)
+    backend: Optional[str]  # reported jax.default_backend(), None on failure
+    rc: Optional[int]  # subprocess return code, None on timeout
+    timed_out: bool
+    stderr_tail: str  # last ~800 chars of the probe's stderr
+
+    @property
+    def ok(self) -> bool:
+        return self.backend is not None
+
+    def describe(self) -> str:
+        if self.ok:
+            return f"platform={self.platform or 'default'} -> {self.backend}"
+        mode = "timeout" if self.timed_out else f"rc={self.rc}"
+        return (
+            f"platform={self.platform or 'default'} {mode}: "
+            f"{self.stderr_tail[-400:] or '<no stderr>'}"
+        )
+
+
+# The probe runs a real device matmul, not just backend init: a tunnel
+# that initializes but cannot compile/execute (round-1 failure mode:
+# "TPU backend setup/compile error" raised from inside the solve) must
+# count as a failed probe, not crash the solve mid-run.
+_PROBE_SCRIPT = """
+import os, sys
+plat = sys.argv[1]
+if plat:
+    os.environ["JAX_PLATFORMS"] = plat
+import jax
+if plat:
+    jax.config.update("jax_platforms", plat)
+import jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+(x @ x).block_until_ready()
+print("BACKEND=" + jax.default_backend())
+"""
+
+
+def probe_backend(timeout: float = 120.0, platform: Optional[str] = None) -> ProbeResult:
+    """Probe which backend a fresh interpreter gets — with diagnostics.
+
+    Runs init **plus a device matmul** in a subprocess so a hanging PJRT
+    init (dead TPU tunnel) costs a bounded timeout instead of blocking
+    the caller forever, and captures the stderr tail so artifacts can
+    record *why* init failed (raise vs hang) instead of a bare fallback.
     """
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            [sys.executable, "-c", _PROBE_SCRIPT, platform or ""],
             capture_output=True,
             text=True,
             timeout=timeout,
         )
-        if probe.returncode == 0 and probe.stdout.strip():
-            return probe.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
-    return None
+        backend = None
+        for line in probe.stdout.strip().splitlines():
+            if line.startswith("BACKEND="):
+                backend = line[len("BACKEND=") :]
+        return ProbeResult(
+            platform=platform,
+            backend=backend if probe.returncode == 0 else None,
+            rc=probe.returncode,
+            timed_out=False,
+            stderr_tail=(probe.stderr or "")[-800:],
+        )
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        return ProbeResult(
+            platform=platform,
+            backend=None,
+            rc=None,
+            timed_out=True,
+            stderr_tail=(stderr or "")[-800:],
+        )
 
 
 def default_backend() -> str:
@@ -79,15 +144,19 @@ def default_backend() -> str:
     # an unpinned process may get a broken TPU plugin whose init hangs;
     # probe out-of-process first so the hang mode costs a timeout, not
     # a stuck provisioning loop
+    global LAST_PROBE_ERROR
     timeout = float(os.environ.get("KARPENTER_TPU_PROBE_TIMEOUT", "60"))
-    if probe_backend(timeout) is None:
-        _log_fallback("probe failed or timed out")
+    probe = probe_backend(timeout)
+    if not probe.ok:
+        LAST_PROBE_ERROR = probe.describe()
+        _log_fallback(LAST_PROBE_ERROR)
         pin_cpu()
         _BACKEND = jax.default_backend()
         return _BACKEND
     try:
         _BACKEND = jax.default_backend()
     except RuntimeError as e:  # plugin raced from probe-ok to unreachable
+        LAST_PROBE_ERROR = str(e)
         _log_fallback(str(e))
         pin_cpu()
         _BACKEND = jax.default_backend()
@@ -103,5 +172,6 @@ def _log_fallback(reason: str) -> None:
 
 
 def reset_for_tests() -> None:
-    global _BACKEND
+    global _BACKEND, LAST_PROBE_ERROR
     _BACKEND = None
+    LAST_PROBE_ERROR = None
