@@ -36,6 +36,10 @@ class Preferences:
             relaxations.append(self._tolerate_prefer_no_schedule_taints)
         for fn in relaxations:
             if fn(pod) is not None:
+                # in-place spec mutation without a resource_version bump:
+                # drop the pod's scheduling memo (solver.podcache) so the
+                # next solve re-derives its signature from the relaxed spec
+                pod.__dict__.pop("_karp_memo", None)
                 return True
         return False
 

@@ -43,6 +43,10 @@ class VolumeTopology:
         # zone requirements apply to every OR'd term (volumetopology.go:66-76)
         for term in na.required.node_selector_terms:
             term.match_expressions = term.match_expressions + requirements
+        # in-place spec mutation without a resource_version bump: drop the
+        # pod's scheduling memo (solver.podcache invariant) so signature
+        # grouping sees the injected zone affinity
+        pod.__dict__.pop("_karp_memo", None)
 
     def _requirements_for_volume(self, pod: Pod, volume) -> List[NodeSelectorRequirement]:
         if volume.persistent_volume_claim:

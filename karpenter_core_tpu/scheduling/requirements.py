@@ -22,7 +22,42 @@ class Requirements(Dict[str, Requirement]):
 
     def __init__(self, *requirements: Requirement):
         super().__init__()
+        self._fp = None
         self.add(*requirements)
+
+    def __setitem__(self, key: str, value: Requirement) -> None:
+        self._fp = None  # any write invalidates the cached fingerprint
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key: str) -> None:
+        self._fp = None
+        super().__delitem__(key)
+
+    def pop(self, key: str, *args):
+        self._fp = None
+        return super().pop(key, *args)
+
+    # dict's C implementations of these bypass __setitem__ on subclasses —
+    # override them all so no mutation path can serve a stale fingerprint
+    def update(self, *args, **kwargs):
+        self._fp = None
+        super().update(*args, **kwargs)
+
+    def setdefault(self, key: str, default=None):
+        self._fp = None
+        return super().setdefault(key, default)
+
+    def clear(self) -> None:
+        self._fp = None
+        super().clear()
+
+    def popitem(self):
+        self._fp = None
+        return super().popitem()
+
+    def __ior__(self, other):
+        self._fp = None
+        return super().__ior__(other)
 
     def add(self, *requirements: Requirement) -> None:
         for req in requirements:
@@ -30,6 +65,28 @@ class Requirements(Dict[str, Requirement]):
             if existing is not None:
                 req = req.intersection(existing)
             self[req.key] = req
+
+    def fingerprint(self) -> tuple:
+        """Canonical, hashable identity of the full requirement set
+        (operator polarity, values, Gt/Lt bounds). Cached until the next
+        write. Requirement objects are mostly immutable (intersection/
+        copy return new instances) but ``Requirement.insert`` mutates
+        ``values`` in place — the cheap (key count, value count) guard
+        recomputes when one fires after caching. A same-count value
+        *replacement* would evade the guard; nothing in the codebase
+        does that."""
+        guard = (len(self), sum(len(r.values) for r in self.values()))
+        cached = self._fp
+        if cached is not None and cached[0] == guard:
+            return cached[1]
+        fp = tuple(
+            sorted(
+                (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+                for r in self.values()
+            )
+        )
+        self._fp = (guard, fp)
+        return fp
 
     def keys_set(self) -> frozenset:
         return frozenset(self.keys())

@@ -145,6 +145,28 @@ def build_requests_matrix(all_requests: Sequence[Dict[str, int]], axis: Resource
     return np.minimum(np.ceil(milli / div[None, :]), 2.0**30).astype(np.int32)
 
 
+def build_requests_matrix_ids(
+    req_ids: np.ndarray, axis: ResourceAxis, id_to_req: Dict[int, Dict[str, int]]
+) -> np.ndarray:
+    """(P, R) int32 request matrix from interned request ids (podcache):
+    quantize each *unique* request shape once, then gather — the 50k-pod
+    batch usually has a few dozen distinct request rows. ``id_to_req``
+    is the batch's own id→dict view (from its memos), so a concurrent
+    intern-table reset cannot orphan this batch's ids."""
+    if req_ids.size == 0:
+        return np.zeros((0, axis.count), dtype=np.int32)
+    uniq, inv = np.unique(req_ids, return_inverse=True)
+    rows = build_requests_matrix([id_to_req[int(u)] for u in uniq], axis)
+    return rows[inv]
+
+
+def unique_requests(
+    req_ids: np.ndarray, id_to_req: Dict[int, Dict[str, int]]
+) -> List[Dict[str, int]]:
+    """The distinct request dicts behind a batch's interned ids."""
+    return [id_to_req[int(u)] for u in np.unique(req_ids)]
+
+
 def quantize_requests(requests: Dict[str, int], axis: ResourceAxis) -> np.ndarray:
     """ceil-quantize a request ResourceList → int32 vector (conservative:
     never lets a pod look smaller)."""
@@ -194,6 +216,10 @@ class EncodedInstanceTypes:
     # so cached masks can be re-extended when the vocab grows (see
     # extend_encoded_masks)
     key_reqs: Dict[str, list] = field(default_factory=dict)
+    # cross-solve derived-tensor caches (pareto frontiers, daemon-adjusted
+    # allocatable) — they live and die with the encoding, so cached
+    # catalog entries keep them warm across solves
+    runtime_caches: Dict[tuple, np.ndarray] = field(default_factory=dict)
 
 
 def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis, vocab: Vocab) -> EncodedInstanceTypes:
@@ -478,15 +504,37 @@ class SignatureGroup:
         return None
 
 
-def group_pods(pods: List[Pod]) -> List[SignatureGroup]:
-    relevant = selector_label_keys(pods)
-    groups: Dict[tuple, SignatureGroup] = {}
-    for i, pod in enumerate(pods):
-        sig = pod_signature(pod, relevant)
-        g = groups.get(sig)
+def group_pods(pods: List[Pod], memos=None) -> List[SignatureGroup]:
+    """Signature-group the batch. Signatures are memoized per pod
+    (podcache), revalidated against the batch's relevant-label-key set:
+    two batches with different selector populations filter different
+    label subsets into the signature, so the memo carries the
+    fingerprint it was computed under."""
+    from . import podcache
+
+    if memos is None:
+        memos = podcache.get_memos(pods)
+    relevant: Set[str] = set()
+    for m in memos:
+        if m.selector_keys:
+            relevant.update(m.selector_keys)
+    fp = hash(tuple(sorted(relevant)))
+    groups: Dict[int, SignatureGroup] = {}
+    get = groups.get
+    for i, (pod, m) in enumerate(zip(pods, memos)):
+        # read/write sig_state as one atomic reference; use LOCALS for
+        # grouping so a concurrent group_pods (different fingerprint, e.g.
+        # a disruption simulation) can overwrite the memo without this
+        # batch mixing the two fingerprints' signatures
+        state = m.sig_state
+        if state is None or state[0] != fp:
+            sig = pod_signature(pod, relevant)
+            state = (fp, sig, podcache.intern_sig(sig))
+            m.sig_state = state
+        g = get(state[2])
         if g is None:
-            g = SignatureGroup(signature=sig, exemplar=pod)
-            groups[sig] = g
+            g = SignatureGroup(signature=state[1], exemplar=pod)
+            groups[state[2]] = g
         g.pod_indices.append(i)
     return list(groups.values())
 

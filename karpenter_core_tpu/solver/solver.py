@@ -38,7 +38,7 @@ from .encode import (
     SignatureGroup,
     build_axis_from_capacities,
     build_catalog_axis,
-    build_requests_matrix,
+    build_requests_matrix_ids,
     encode_instance_types,
     encode_signature_for_pool,
     extend_axis,
@@ -47,6 +47,7 @@ from .encode import (
     group_pods,
     quantize_capacity,
     quantize_requests,
+    unique_requests,
 )
 from .kernels import allowed_kernel, build_compat_inputs, zone_ct_masks
 from .pack import (
@@ -92,15 +93,12 @@ _CATALOG_LOCK = threading.RLock()
 
 def _requirements_fingerprint(reqs) -> tuple:
     """Canonical identity of a merged Requirements set (full algebra:
-    operator polarity, values, Gt/Lt bounds) for class-merge equality."""
+    operator polarity, values, Gt/Lt bounds) for class-merge equality.
+    Cached on the Requirements object (invalidated by its mutators) —
+    the catalog fingerprint recomputes this per type per solve."""
     if reqs is None:
         return ()
-    return tuple(
-        sorted(
-            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
-            for r in reqs.values()
-        )
-    )
+    return reqs.fingerprint()
 
 
 def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
@@ -359,13 +357,25 @@ class TPUScheduler:
         daemonset_pods: Optional[List[Pod]] = None,
     ) -> SolverResult:
         result = SolverResult()
-        self._frontier_cache: Dict[tuple, np.ndarray] = {}
-        self._alloc_full_cache: Dict[tuple, np.ndarray] = {}
-        groups = group_pods(pods)
+        from . import podcache
+
+        memos = podcache.get_memos(pods)
+        self._all_requests = [m.requests for m in memos]
+        self._req_ids = np.fromiter(
+            (m.req_id for m in memos), dtype=np.int64, count=len(memos)
+        )
+        # this batch's own id→request view: immune to intern-table resets
+        self._req_map = {m.req_id: m.requests for m in memos}
+        groups = group_pods(pods, memos=memos)
+        def exclude(pool: List[SignatureGroup], subset: List[SignatureGroup]):
+            """pool minus subset, by identity (dataclass __eq__ is deep)."""
+            ids = {id(g) for g in subset}
+            return [g for g in pool if id(g) not in ids]
+
         relational = [
             g for g in groups if g.has_relational or g.has_stateful_node_constraints
         ]
-        tensor_groups = [g for g in groups if g not in relational]
+        tensor_groups = exclude(groups, relational)
         # pods *selected by* a relational pod's affinity terms must schedule
         # in the same (oracle) world, or affinity can't anchor to them
         selectors = []
@@ -387,7 +397,7 @@ class TPUScheduler:
             for g in tensor_groups
             if any(sel.matches(g.exemplar.metadata.labels) for sel in selectors)
         ]
-        tensor_groups = [g for g in tensor_groups if g not in pulled]
+        tensor_groups = exclude(tensor_groups, pulled)
         oracle_groups = relational + pulled
         if state_nodes:
             # topology-bearing groups need existing per-domain counts to
@@ -401,7 +411,7 @@ class TPUScheduler:
                 or g.hostname_spread() is not None
                 or g.hostname_isolated
             ]
-            tensor_groups = [g for g in tensor_groups if g not in spreadish]
+            tensor_groups = exclude(tensor_groups, spreadish)
             oracle_groups = oracle_groups + spreadish
         # plain groups whose labels match an oracle-routed group's spread
         # selector must schedule in the same (oracle) world, or the
@@ -422,7 +432,7 @@ class TPUScheduler:
                 for g in tensor_groups
                 if any(s.matches(g.exemplar.metadata.labels) for s in spread_sels)
             ]
-            tensor_groups = [g for g in tensor_groups if g not in pulled_spread]
+            tensor_groups = exclude(tensor_groups, pulled_spread)
             oracle_groups = oracle_groups + pulled_spread
             frontier = pulled_spread
         oracle_pods: List[Pod] = [
@@ -471,6 +481,8 @@ class TPUScheduler:
         solve's earlier NEW-node plans, so a relaxed group can open a
         node where the oracle would back-fill an in-flight claim —
         bounded to relaxed groups, which are rare in large batches."""
+        if not result.pod_errors:
+            return  # nothing failed — no group can need relaxation
         from ..kube.objects import EFFECT_PREFER_NO_SCHEDULE
         from ..scheduler.preferences import Preferences
 
@@ -561,13 +573,13 @@ class TPUScheduler:
         M = len(nodes)
         if M == 0 or not groups:
             return
-        if self._all_requests is None:
-            self._all_requests = [resources.requests_for_pods(p) for p in pods]
-        all_requests = self._all_requests
-        batch_requests = [all_requests[i] for g in groups for i in g.pod_indices]
+        batch_idx = np.array(
+            [i for g in groups for i in g.pod_indices], dtype=np.int64
+        )
+        batch_ids = self._req_ids[batch_idx]
         axis = extend_axis(
             build_axis_from_capacities([n.allocatable() for n in nodes]),
-            batch_requests,
+            unique_requests(batch_ids, self._req_map),
         )
 
         # one Taints/label-requirements view per node, shared by the
@@ -610,11 +622,11 @@ class TPUScheduler:
 
         # global pack in the oracle's pod order: all pods descending by
         # (primary, memory) — queue.go:76
-        pod_idx = np.array([i for g in groups for i in g.pod_indices], dtype=np.int64)
+        pod_idx = batch_idx
         sig_ids = np.array(
             [s for s, g in enumerate(groups) for _ in g.pod_indices], dtype=np.int32
         )
-        reqs = build_requests_matrix(batch_requests, axis)
+        reqs = build_requests_matrix_ids(batch_ids, axis, self._req_map)
         order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
         pod_idx, sig_ids, reqs = pod_idx[order], sig_ids[order], reqs[order]
         assign, _ = run_pack_existing(reqs, sig_ids, compat, free)
@@ -645,7 +657,6 @@ class TPUScheduler:
         # --- existing capacity first (scheduler.go:241-246) -------------
         # per-group indices still needing placement after the existing-
         # node pack; starts as every pod in the group
-        self._all_requests = None
         leftover: Dict[int, List[int]] = {
             gi: list(g.pod_indices) for gi, g in enumerate(groups)
         }
@@ -770,16 +781,18 @@ class TPUScheduler:
                 pending.append((fut, zone_ok, ct_ok))
 
         # --- per-pod encoding (overlapped with the device dispatch) -----
-        if self._all_requests is None:
-            self._all_requests = [resources.requests_for_pods(p) for p in pods]
-        all_requests = self._all_requests  # reused for lazy NodePlan.requests
         from ..scheduling.requirements import pod_requirements as _pod_reqs
 
         # per unique catalog: extended axis + quantized request matrix
+        # (quantized once per unique request shape, gathered per pod)
+        uniq_reqs = unique_requests(self._req_ids, self._req_map)
         matrices: Dict[int, tuple] = {}
         for e in {id(e): e for e in pool_entries}.values():
-            axis_ext = extend_axis(e.axis, all_requests)
-            matrices[id(e)] = (axis_ext, build_requests_matrix(all_requests, axis_ext))
+            axis_ext = extend_axis(e.axis, uniq_reqs)
+            matrices[id(e)] = (
+                axis_ext,
+                build_requests_matrix_ids(self._req_ids, axis_ext, self._req_map),
+            )
 
         # daemonset overhead per pool, added to every planned node's load
         daemon_requests = {}
@@ -1128,11 +1141,17 @@ class TPUScheduler:
                     )
                 continue
 
-            buckets: Dict[str, List[int]] = {z: [] for z in zones}
+            # per-zone strided slices replace the per-pod append loop:
+            # pod j of a group's descending order lands in zone j % Z,
+            # identical round-robin, vectorized
+            buckets: Dict[str, list] = {z: [] for z in zones}
+            Z = len(zones)
             for m in spread:
                 g_idx, _ = sorted_idx(m["indices"])
-                for j, i in enumerate(g_idx):
-                    buckets[zones[j % len(zones)]].append(int(i))
+                for zi, z in enumerate(zones):
+                    part = g_idx[zi::Z]
+                    if part.size:
+                        buckets[z].append(part)
             # plain pods ride along only when zone choice doesn't shrink
             # the viable set — otherwise a pod needing a type offered in
             # one zone could be round-robined into a bucket without it
@@ -1141,8 +1160,10 @@ class TPUScheduler:
             )
             if ride_along:
                 p_idx, _ = sorted_idx([i for m in plain for i in m["indices"]])
-                for j, i in enumerate(p_idx):
-                    buckets[zones[j % len(zones)]].append(int(i))
+                for zi, z in enumerate(zones):
+                    part = p_idx[zi::Z]
+                    if part.size:
+                        buckets[z].append(part)
             elif plain:
                 idx, reqs = sorted_idx([i for m in plain for i in m["indices"]])
                 self._prepare_job(
@@ -1151,7 +1172,7 @@ class TPUScheduler:
                 )
             for z in zones:
                 if buckets[z]:
-                    idx, reqs = sorted_idx(buckets[z])
+                    idx, reqs = sorted_idx(np.concatenate(buckets[z]))
                     self._prepare_job(
                         idx, reqs, enc, zone_types[z], zone_ok, ct_ok, daemon,
                         max_per_node, pool, pods, result, jobs, metas, zone=z,
@@ -1187,11 +1208,17 @@ class TPUScheduler:
         # pack-time and merge-time capacity views can't diverge)
         alloc = self._alloc_full(enc, daemon)[viable_idx].astype(np.int32)
         # zone buckets of one group share viable sets — cache the frontier
-        cache_key = (id(enc), viable_idx.tobytes(), daemon.tobytes())
-        frontier = self._frontier_cache.get(cache_key)
+        # on the encoding (warm across solves for cached catalogs)
+        cache_key = ("frontier", viable_idx.tobytes(), daemon.tobytes())
+        frontier = enc.runtime_caches.get(cache_key)
         if frontier is None:
             frontier = pareto_frontier(alloc)
-            self._frontier_cache[cache_key] = frontier
+            # _CATALOG_LOCK's contract covers in-place mutation of shared
+            # cached entries (concurrent disruption simulations)
+            with _CATALOG_LOCK:
+                if len(enc.runtime_caches) > 256:
+                    enc.runtime_caches.clear()
+                enc.runtime_caches[cache_key] = frontier
         jobs.append((reqs, frontier, np.int32(max_per_node)))
         metas.append(
             dict(
@@ -1261,7 +1288,7 @@ class TPUScheduler:
         bounds = np.searchsorted(sorted_ids, np.arange(node_count + 1))
         for n in range(node_count):
             ti = chosen_types[n]
-            members = [int(i) for i in sorted_idx[bounds[n] : bounds[n + 1]]]
+            members = sorted_idx[bounds[n] : bounds[n + 1]].tolist()
             if ti < 0:
                 for i in members:
                     result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
@@ -1312,9 +1339,10 @@ class TPUScheduler:
     _MERGE_SCAN_CAP = 64  # K-open bound on the first-fit merge scan
 
     def _alloc_full(self, enc: EncodedInstanceTypes, daemon: np.ndarray) -> np.ndarray:
-        """(T, R_ext) daemon-adjusted allocatable over the whole catalog."""
-        key = (id(enc), daemon.tobytes())
-        cached = self._alloc_full_cache.get(key)
+        """(T, R_ext) daemon-adjusted allocatable over the whole catalog
+        (cached on the encoding, warm across solves)."""
+        key = ("alloc", daemon.tobytes())
+        cached = enc.runtime_caches.get(key)
         if cached is not None:
             return cached
         alloc = enc.allocatable.astype(np.int64)
@@ -1324,7 +1352,10 @@ class TPUScheduler:
                 axis=1,
             )
         alloc = np.maximum(alloc - daemon[None, :].astype(np.int64), 0)
-        self._alloc_full_cache[key] = alloc
+        with _CATALOG_LOCK:
+            if len(enc.runtime_caches) > 256:
+                enc.runtime_caches.clear()
+            enc.runtime_caches[key] = alloc
         return alloc
 
     def _merge_and_emit(self, records: List[dict], pods: List[Pod], result: SolverResult) -> None:

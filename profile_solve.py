@@ -1,0 +1,84 @@
+"""Dev-only: profile the config-3 warm solve (cProfile + phase timers).
+
+Usage: python profile_solve.py [pods] [types]
+Env: BENCH_BACKEND=cpu to force the CPU fallback for comparison.
+"""
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import bench
+
+
+def main():
+    out = {}
+    backend = bench.resolve_backend(out)
+    print("backend:", backend, file=sys.stderr)
+
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        Toleration,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    n_types = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
+    rng = np.random.RandomState(11)
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(n_types)
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+
+    def constrained(i):
+        sel = tol = spread = None
+        labels = {"app": f"svc-{i % 9}"}
+        r = i % 9
+        if r < 3:
+            sel = {wk.CAPACITY_TYPE_LABEL_KEY: ["spot", "on-demand"][i % 2]}
+        elif r < 5:
+            tol = [Toleration(key="dedicated", operator="Exists")]
+        elif r < 7:
+            spread = [TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": labels["app"]}))]
+        cpu = ["100m", "250m", "500m", "1", "1500m", "2"][rng.randint(6)]
+        mem = ["128Mi", "256Mi", "512Mi", "1Gi", "2Gi"][rng.randint(5)]
+        return bench._mk_pod(i, cpu, mem, selector=sel, tolerations=tol,
+                             spread=spread, labels=labels)
+
+    pods = [constrained(i) for i in range(n_pods)]
+    solver = TPUScheduler([nodepool], provider)
+    t0 = time.perf_counter()
+    solver.solve(pods)
+    print(f"cold: {(time.perf_counter()-t0)*1000:.1f} ms", file=sys.stderr)
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res = solver.solve(pods)
+        print(f"warm: {(time.perf_counter()-t0)*1000:.1f} ms "
+              f"({res.pods_scheduled} pods, {res.node_count} nodes)", file=sys.stderr)
+
+    pr = cProfile.Profile()
+    pr.enable()
+    solver.solve(pods)
+    pr.disable()
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Per-pod memoization (solver/podcache.py): cache keying, invalidation
+on resource_version bumps, in-place relaxation dropping the memo, and
+the cached Requirements fingerprint invalidating on every mutator."""
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.apis.nodepool import NodePool
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.objects import (
+    OP_IN,
+    Container,
+    Pod,
+    PodCondition,
+    PodSpec,
+    ResourceRequirements,
+)
+from karpenter_core_tpu.kube.quantity import parse_quantity
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+from karpenter_core_tpu.solver import TPUScheduler, podcache
+
+
+def _pod(name, cpu="500m", mem="512Mi"):
+    p = Pod()
+    p.metadata.name = name
+    p.spec = PodSpec(
+        containers=[
+            Container(
+                name="c",
+                resources=ResourceRequirements(
+                    requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+                ),
+            )
+        ]
+    )
+    p.status.conditions = [
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    ]
+    return p
+
+
+@pytest.fixture
+def solver():
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(20)
+    np_ = NodePool()
+    np_.metadata.name = "default"
+    return TPUScheduler([np_], provider)
+
+
+def test_memo_hit_is_stable(solver):
+    pods = [_pod(f"p-{i}") for i in range(50)]
+    r1 = solver.solve(pods)
+    memos = [p.__dict__["_karp_memo"][1] for p in pods]
+    r2 = solver.solve(pods)
+    assert [p.__dict__["_karp_memo"][1] for p in pods] == memos  # same objects
+    assert r1.node_count == r2.node_count
+    assert r2.pods_scheduled == 50
+
+
+def test_request_interning_dedups(solver):
+    pods = [_pod(f"p-{i}") for i in range(50)]
+    solver.solve(pods)
+    memos = podcache.get_memos(pods)
+    # identical request shapes share one id and one dict object
+    assert len({m.req_id for m in memos}) == 1
+    assert len({id(m.requests) for m in memos}) == 1
+
+
+def test_rv_bump_invalidates(solver):
+    pods = [_pod(f"p-{i}") for i in range(10)]
+    assert solver.solve(pods).pods_scheduled == 10
+    # grow pod 0 beyond every catalog type; without the rv bump the stale
+    # memo would still schedule it
+    pods[0].spec.containers[0].resources.requests["cpu"] = parse_quantity("4000")
+    assert solver.solve(pods).pods_scheduled == 10  # stale by design
+    pods[0].metadata.resource_version += 1
+    res = solver.solve(pods)
+    assert res.pods_scheduled == 9
+    assert pods[0].uid in res.pod_errors
+
+
+def test_relax_drops_memo():
+    """Preferences.relax mutates the live pod in place with no rv bump —
+    it must drop the stashed memo so the next solve re-derives the
+    signature (scheduler.py relaxes stored pods directly)."""
+    from karpenter_core_tpu.kube.objects import (
+        Affinity,
+        NodeAffinity,
+        NodeSelector,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+    )
+    from karpenter_core_tpu.scheduler.preferences import Preferences
+
+    pod = _pod("r-0")
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinity(
+            required=NodeSelector(
+                node_selector_terms=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key="kubernetes.io/arch", operator=OP_IN, values=["nope"]
+                            )
+                        ]
+                    ),
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key="kubernetes.io/arch", operator=OP_IN, values=["amd64"]
+                            )
+                        ]
+                    ),
+                ]
+            )
+        )
+    )
+    memo = podcache.get_memos([pod])[0]
+    assert pod.__dict__["_karp_memo"][1] is memo
+    assert Preferences().relax(pod)
+    assert "_karp_memo" not in pod.__dict__
+
+
+def test_sig_interning_groups_by_int(solver):
+    a = [_pod(f"a-{i}") for i in range(5)]
+    b = _pod("b-0")
+    b.spec.node_selector = {"karpenter.sh/capacity-type": "spot"}
+    memos = podcache.get_memos(a + [b])
+    from karpenter_core_tpu.solver.encode import group_pods
+
+    groups = group_pods(a + [b], memos=memos)
+    assert len(groups) == 2
+    sig_ids = {m.sig_state[2] for m in memos}
+    assert len(sig_ids) == 2
+
+
+def test_requirements_fingerprint_invalidation():
+    r = Requirements(Requirement("a", OP_IN, ["1"]))
+    fp1 = r.fingerprint()
+    assert r.fingerprint() is fp1  # cached
+    r.add(Requirement("b", OP_IN, ["2"]))
+    fp2 = r.fingerprint()
+    assert fp2 != fp1
+    r.pop("b")
+    assert r.fingerprint() == fp1
+    # dict mutators that bypass __setitem__ in CPython must also invalidate
+    r.update({"c": Requirement("c", OP_IN, ["3"])})
+    assert r.fingerprint() != fp1
+    del r["c"]
+    assert r.fingerprint() == fp1
+    r.setdefault("d", Requirement("d", OP_IN, ["4"]))
+    assert r.fingerprint() != fp1
+    r.clear()
+    assert r.fingerprint() == ()
+
+
+def test_intern_reset_never_aliases():
+    """Clearing the dedup maps must never hand an existing id to new
+    content (monotonic ids)."""
+    r1 = {"cpu": 1}
+    _, id1 = podcache._intern_requests(r1)
+    podcache.reset()
+    _, id2 = podcache._intern_requests({"cpu": 2})
+    assert id2 != id1
+    s1 = podcache.intern_sig(("x",))
+    podcache.reset()
+    assert podcache.intern_sig(("y",)) != s1
