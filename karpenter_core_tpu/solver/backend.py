@@ -29,6 +29,43 @@ _BACKEND: Optional[str] = None
 LAST_PROBE_ERROR: Optional[str] = None
 
 
+_CACHE_ENABLED = False
+
+
+def enable_compilation_cache() -> None:
+    """Point JAX's persistent compilation cache at a repo-local dir so a
+    provisioner restart replays cached XLA binaries instead of paying
+    cold compiles (~7 s on the tunneled TPU in BENCH_r03). TPU-only: on
+    CPU the cache re-loads AOT results compiled for slightly different
+    host-feature sets (XLA warns of SIGILL risk) and measurably slows
+    the solve, while CPU compiles are cheap anyway. Idempotent; opt-out
+    with KARPENTER_TPU_COMPILE_CACHE=off."""
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    path = os.environ.get("KARPENTER_TPU_COMPILE_CACHE")
+    if path == "off":
+        _CACHE_ENABLED = True
+        return
+    if not path:
+        # XDG cache location: valid for both pip-installed deployments and
+        # dev checkouts (a package-relative default would land the cache
+        # beside site-packages)
+        xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache"
+        )
+        path = os.path.join(xdg, "karpenter-tpu", "jax-cache")
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # older jax without these knobs — cache is best-effort
+        pass
+    _CACHE_ENABLED = True
+
+
 def pin_cpu() -> None:
     """Pin this process's JAX platform to CPU, overriding any plugin pin."""
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -133,6 +170,8 @@ def default_backend() -> str:
     import jax
 
     if forced:
+        if forced != "cpu":
+            enable_compilation_cache()
         jax.config.update("jax_platforms", forced)
         _BACKEND = jax.default_backend()
         return _BACKEND
@@ -155,6 +194,8 @@ def default_backend() -> str:
         return _BACKEND
     try:
         _BACKEND = jax.default_backend()
+        if _BACKEND != "cpu":
+            enable_compilation_cache()
     except RuntimeError as e:  # plugin raced from probe-ok to unreachable
         LAST_PROBE_ERROR = str(e)
         _log_fallback(str(e))

@@ -315,27 +315,13 @@ def _selector_key(sel) -> tuple:
 
 def selector_label_keys(pods: Sequence[Pod]) -> Set[str]:
     """Label keys referenced by any topology-spread / affinity selector in
-    the batch — the only labels that affect scheduling identity."""
+    the batch — the only labels that affect scheduling identity. One
+    implementation: podcache's per-pod walk (memoized there)."""
+    from .podcache import _selector_keys
+
     keys: Set[str] = set()
-
-    def collect(sel) -> None:
-        if sel is None:
-            return
-        keys.update(sel.match_labels.keys())
-        keys.update(e.key for e in sel.match_expressions)
-
     for pod in pods:
-        for c in pod.spec.topology_spread_constraints:
-            collect(c.label_selector)
-        a = pod.spec.affinity
-        if a is not None:
-            for pa in (a.pod_affinity, a.pod_anti_affinity):
-                if pa is None:
-                    continue
-                for t in pa.required:
-                    collect(t.label_selector)
-                for w in pa.preferred:
-                    collect(w.pod_affinity_term.label_selector)
+        keys.update(_selector_keys(pod))
     return keys
 
 

@@ -104,6 +104,43 @@ def allowed_kernel(
     return compat & offering_kernel(zone_ok, ct_ok, avail)
 
 
+def allowed_host(
+    sig_arrays: Dict[str, np.ndarray],
+    type_masks: Dict[str, np.ndarray],
+    type_has: Dict[str, np.ndarray],
+    type_neg: Dict[str, np.ndarray],
+    zone_ok: np.ndarray,
+    ct_ok: np.ndarray,
+    avail: np.ndarray,
+    keys: Tuple[str, ...],
+) -> np.ndarray:
+    """Numpy twin of ``allowed_kernel`` for the small-S regime.
+
+    On the tunneled TPU a device dispatch costs ~65 ms at bench widths
+    (BENCH_r03 engines: compat_xla_ms 65.2 on-chip vs 2.6 on CPU —
+    transfer/dispatch dominated), while this host loop finishes in
+    single-digit ms up to S ~ 2k. The solver routes compat here when
+    S·T is below ``COMPAT_MIN_DEVICE_WORK`` so the chip only sees
+    dispatches big enough to earn their round trip."""
+    S = sig_arrays["valid"].shape[0]
+    T = avail.shape[0]
+    ok = np.repeat(sig_arrays["valid"][:, None], T, axis=1)
+    for key in keys:
+        overlap = (
+            sig_arrays[f"mask:{key}"].astype(np.float32)
+            @ type_masks[key].astype(np.float32).T
+        ) > 0
+        both_has = sig_arrays[f"has:{key}"][:, None] & type_has[key][None, :]
+        both_neg = sig_arrays[f"neg:{key}"][:, None] & type_neg[key][None, :]
+        ok &= (~both_has) | overlap | both_neg
+    # offering: some available (zone, ct) pair allowed by the signature
+    pair_ok = (zone_ok[:, :, None] & ct_ok[:, None, :]).reshape(S, -1)
+    off = (
+        pair_ok.astype(np.float32) @ avail.reshape(T, -1).astype(np.float32).T
+    ) > 0
+    return ok & off
+
+
 def zone_ct_masks(compats, enc: EncodedInstanceTypes) -> Tuple[np.ndarray, np.ndarray]:
     """Signature-level zone / capacity-type admissibility from merged
     requirements (missing key ⇒ all allowed)."""

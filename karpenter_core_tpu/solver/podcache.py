@@ -70,6 +70,12 @@ _INTERN_MAX = 100_000
 
 
 def _selector_keys(pod) -> tuple:
+    spec = pod.spec
+    tsc = spec.topology_spread_constraints
+    a = spec.affinity
+    # fast path: no selectors anywhere (the common pod at 50k scale)
+    if not tsc and (a is None or (a.pod_affinity is None and a.pod_anti_affinity is None)):
+        return ()
     keys = set()
 
     def collect(sel) -> None:
@@ -78,9 +84,8 @@ def _selector_keys(pod) -> tuple:
         keys.update(sel.match_labels.keys())
         keys.update(e.key for e in sel.match_expressions)
 
-    for c in pod.spec.topology_spread_constraints:
+    for c in tsc:
         collect(c.label_selector)
-    a = pod.spec.affinity
     if a is not None:
         for pa in (a.pod_affinity, a.pod_anti_affinity):
             if pa is None:

@@ -49,7 +49,7 @@ from .encode import (
     quantize_requests,
     unique_requests,
 )
-from .kernels import allowed_kernel, build_compat_inputs, zone_ct_masks
+from .kernels import allowed_host, allowed_kernel, build_compat_inputs, zone_ct_masks
 from .pack import (
     assign_cheapest_types,
     batch_pack,
@@ -89,6 +89,16 @@ _CATALOG_CACHE_MAX = 8
 # called only by the provisioner singleton, but concurrent reconcilers
 # (disruption simulations) may share catalog entries
 _CATALOG_LOCK = threading.RLock()
+
+
+def _cache_put(enc: "EncodedInstanceTypes", key: tuple, value: np.ndarray) -> None:
+    """Bounded insert into an encoding's cross-solve cache under
+    _CATALOG_LOCK (its contract covers in-place mutation of shared
+    cached entries — concurrent disruption simulations)."""
+    with _CATALOG_LOCK:
+        if len(enc.runtime_caches) > 256:
+            enc.runtime_caches.clear()
+        enc.runtime_caches[key] = value
 
 
 def _requirements_fingerprint(reqs) -> tuple:
@@ -139,13 +149,22 @@ def _catalog_entry(catalog: List[InstanceType]) -> _CatalogEntry:
         return entry
 
 
-# signature count at which the fused pallas compat path pays for itself
-# (below it, dispatch latency dominates and the XLA path's smaller
-# transfers win; above it, the one-HBM-write fused kernel is ~2x the
-# XLA path device-side — see tests/test_pallas_compat.py). TPU-only:
-# other backends take the XLA path unless tests force interpret mode.
-_PALLAS_MIN_S = int(os.environ.get("KARPENTER_TPU_PALLAS_MIN_S", "256"))
+# Engine policy, set from measured shootout data (BENCH_r03 engines):
+#
+# - pallas compat lost to plain XLA on the real chip (81.2 ms vs 65.2 ms
+#   at S=512, interpret=false), so the fused pallas path is OPT-IN now:
+#   lower KARPENTER_TPU_PALLAS_MIN_S to re-enable it.
+# - on the tunneled TPU a compat dispatch has a ~65 ms floor
+#   (transfer/dispatch dominated: same kernel is 2.6 ms on CPU/XLA),
+#   while the numpy twin runs in single-digit ms at small S — so compat
+#   only goes to the device when S·T ≥ COMPAT_MIN_DEVICE_WORK
+#   (default 2^24 ≈ S=8192 × T=2048, where host numpy crosses ~200 ms
+#   and the chip's fixed dispatch cost is finally amortized).
+_PALLAS_MIN_S = int(os.environ.get("KARPENTER_TPU_PALLAS_MIN_S", str(1 << 30)))
 _PALLAS_INTERPRET_OK = os.environ.get("KARPENTER_TPU_PALLAS_INTERPRET", "0") == "1"
+COMPAT_MIN_DEVICE_WORK = int(
+    os.environ.get("KARPENTER_TPU_COMPAT_MIN_WORK", str(1 << 24))
+)
 
 
 def _entry_device_packed(entry: _CatalogEntry):
@@ -731,7 +750,26 @@ class TPUScheduler:
                 sig_arrays = build_compat_inputs(compats, enc, e.vocab)
                 keys = tuple(sorted(enc.key_masks.keys()))
                 zone_ok, ct_ok = zone_ct_masks(compats, enc)
+                S_, T_ = len(compats), len(enc.instance_types)
                 if (
+                    backend == "tpu"
+                    and S_ * T_ < COMPAT_MIN_DEVICE_WORK
+                    and S_ < _PALLAS_MIN_S
+                ):
+                    # small-S regime: the tunneled chip's dispatch floor
+                    # (~65 ms, BENCH_r03) dwarfs this host matmul — keep
+                    # the round trip for workloads that earn it
+                    fut = allowed_host(
+                        sig_arrays,
+                        enc.key_masks,
+                        enc.key_has,
+                        enc.key_neg,
+                        zone_ok,
+                        ct_ok,
+                        enc.offering_avail,
+                        keys,
+                    )
+                elif (
                     len(compats) >= _PALLAS_MIN_S
                     and keys
                     and (backend == "tpu" or _PALLAS_INTERPRET_OK)
@@ -1213,12 +1251,7 @@ class TPUScheduler:
         frontier = enc.runtime_caches.get(cache_key)
         if frontier is None:
             frontier = pareto_frontier(alloc)
-            # _CATALOG_LOCK's contract covers in-place mutation of shared
-            # cached entries (concurrent disruption simulations)
-            with _CATALOG_LOCK:
-                if len(enc.runtime_caches) > 256:
-                    enc.runtime_caches.clear()
-                enc.runtime_caches[cache_key] = frontier
+            _cache_put(enc, cache_key, frontier)
         jobs.append((reqs, frontier, np.int32(max_per_node)))
         metas.append(
             dict(
@@ -1352,10 +1385,7 @@ class TPUScheduler:
                 axis=1,
             )
         alloc = np.maximum(alloc - daemon[None, :].astype(np.int64), 0)
-        with _CATALOG_LOCK:
-            if len(enc.runtime_caches) > 256:
-                enc.runtime_caches.clear()
-            enc.runtime_caches[key] = alloc
+        _cache_put(enc, key, alloc)
         return alloc
 
     def _merge_and_emit(self, records: List[dict], pods: List[Pod], result: SolverResult) -> None:

@@ -1,0 +1,110 @@
+"""Engine policy (VERDICT r3 task 1): on the TPU backend, compat work
+below COMPAT_MIN_DEVICE_WORK routes to the numpy twin (the tunneled
+chip's dispatch floor dwarfs small matmuls — BENCH_r03 engines data);
+results must be identical to the device path."""
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.apis.nodepool import NodePool
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.objects import (
+    Container,
+    Pod,
+    PodCondition,
+    PodSpec,
+    ResourceRequirements,
+)
+from karpenter_core_tpu.kube.quantity import parse_quantity
+from karpenter_core_tpu.solver import TPUScheduler
+from karpenter_core_tpu.solver import backend as backend_mod
+
+
+def _pod(name, cpu="500m", mem="512Mi", sel=None):
+    p = Pod()
+    p.metadata.name = name
+    p.spec = PodSpec(
+        containers=[
+            Container(
+                name="c",
+                resources=ResourceRequirements(
+                    requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+                ),
+            )
+        ]
+    )
+    if sel:
+        p.spec.node_selector = sel
+    p.status.conditions = [
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    ]
+    return p
+
+
+@pytest.fixture
+def env():
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(30)
+    np_ = NodePool()
+    np_.metadata.name = "default"
+    return np_, provider
+
+
+def _batch():
+    pods = [_pod(f"p-{i}") for i in range(40)]
+    pods += [
+        _pod(f"s-{i}", sel={"karpenter.sh/capacity-type": "spot"}) for i in range(10)
+    ]
+    return pods
+
+
+def test_host_compat_matches_device_path(env, monkeypatch):
+    np_, provider = env
+    ref = TPUScheduler([np_], provider).solve(_batch())  # cpu backend: XLA path
+
+    # pin the resolved backend to "tpu": small-S compat now takes the
+    # numpy twin (allowed_host) — no device needed, results identical
+    monkeypatch.setattr(backend_mod, "_BACKEND", "tpu")
+    host = TPUScheduler([np_], provider).solve(_batch())
+    assert host.node_count == ref.node_count
+    assert host.pods_scheduled == ref.pods_scheduled == 50
+    assert sorted(len(p.pod_indices) for p in host.node_plans) == sorted(
+        len(p.pod_indices) for p in ref.node_plans
+    )
+    assert host.total_price == pytest.approx(ref.total_price)
+
+
+def test_host_compat_threshold_routes_large_to_device(env, monkeypatch):
+    """Above the work threshold the fused device kernel is dispatched
+    (on this box that is XLA-CPU; on chip it is the same call)."""
+    np_, provider = env
+    monkeypatch.setattr(backend_mod, "_BACKEND", "tpu")
+    import karpenter_core_tpu.solver.solver as solver_mod
+
+    monkeypatch.setattr(solver_mod, "COMPAT_MIN_DEVICE_WORK", 1)  # force device
+    res = TPUScheduler([np_], provider).solve(_batch())
+    assert res.pods_scheduled == 50
+
+
+def test_allowed_host_equals_allowed_kernel():
+    from karpenter_core_tpu.solver.kernels import allowed_host, allowed_kernel
+
+    rng = np.random.RandomState(3)
+    S, T, Z, C = 17, 40, 4, 2
+    keys = ("a", "b")
+    sig, tm, th, tn = {"valid": rng.rand(S) < 0.9}, {}, {}, {}
+    for k, v in (("a", 9), ("b", 5)):
+        sig[f"mask:{k}"] = rng.rand(S, v) < 0.4
+        sig[f"has:{k}"] = rng.rand(S) < 0.7
+        sig[f"neg:{k}"] = rng.rand(S) < 0.2
+        tm[k] = rng.rand(T, v) < 0.4
+        th[k] = rng.rand(T) < 0.7
+        tn[k] = rng.rand(T) < 0.2
+    zone_ok = rng.rand(S, Z) < 0.6
+    ct_ok = rng.rand(S, C) < 0.8
+    avail = rng.rand(T, Z, C) < 0.5
+    got = allowed_host(sig, tm, th, tn, zone_ok, ct_ok, avail, keys)
+    want = np.asarray(
+        allowed_kernel(sig, tm, th, tn, zone_ok, ct_ok, avail, keys)
+    )
+    np.testing.assert_array_equal(got, want)
