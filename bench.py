@@ -524,7 +524,6 @@ def engine_shootout(backend: str) -> dict:
     import jax
 
     from karpenter_core_tpu import native
-    from karpenter_core_tpu.solver.kernels import compat_kernel
     from karpenter_core_tpu.solver.pack import batch_pack
     from karpenter_core_tpu.solver.pallas_kernels import compat_via_pallas
 
@@ -567,18 +566,22 @@ def engine_shootout(backend: str) -> dict:
     jn = {k: jax.numpy.asarray(v) for k, v in type_neg.items()}
     js = {k: jax.numpy.asarray(v) for k, v in sig_arrays.items()}
 
-    out["compat_xla_ms"] = round(
-        timeit(lambda: compat_kernel(js, jt, jh, jn, keys).block_until_ready()), 2
-    )
-
-    # host-numpy compat twin (the small-S engine the solver now prefers on
-    # TPU below COMPAT_MIN_DEVICE_WORK — policy set from this data)
-    from karpenter_core_tpu.solver.kernels import allowed_host
+    # both engines time the SAME fused compat ∧ offering computation
+    # (allowed_kernel vs its numpy twin) so the crossover threshold
+    # COMPAT_MIN_DEVICE_WORK is calibrated on matched work
+    from karpenter_core_tpu.solver.kernels import allowed_host, allowed_kernel
 
     Z, C = 6, 2
     zone_ok = np.ones((S, Z), dtype=bool)
     ct_ok = np.ones((S, C), dtype=bool)
     avail = np.ones((T, Z, C), dtype=bool)
+    jz, jc, ja = map(jax.numpy.asarray, (zone_ok, ct_ok, avail))
+    out["compat_xla_ms"] = round(
+        timeit(
+            lambda: allowed_kernel(js, jt, jh, jn, jz, jc, ja, keys).block_until_ready()
+        ),
+        2,
+    )
     out["compat_host_ms"] = round(
         timeit(
             lambda: allowed_host(

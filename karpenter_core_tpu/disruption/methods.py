@@ -251,9 +251,13 @@ class MultiNodeConsolidation(ConsolidationBase):
             # verification solves
             k_hi = screen_prefixes(self.ctx, candidates[:max_n])
             k_lo = repack_prefixes(self.ctx, candidates[:max_n])
-            tries = [
-                k for k in dict.fromkeys((k_hi, k_hi - 1, k_hi - 2, k_lo)) if k >= 2
-            ]
+            # descending: the two bounds use different capacity sets, so
+            # k_lo can exceed the screen's k_hi — unsorted tries would
+            # attempt (and return) a smaller prefix before the largest
+            # feasible one
+            tries = sorted(
+                {k for k in (k_hi, k_hi - 1, k_hi - 2, k_lo) if k >= 2}, reverse=True
+            )
             if tries:
                 order = tries
         if order is None:

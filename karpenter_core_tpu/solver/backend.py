@@ -33,7 +33,7 @@ _CACHE_ENABLED = False
 
 
 def enable_compilation_cache() -> None:
-    """Point JAX's persistent compilation cache at a repo-local dir so a
+    """Point JAX's persistent compilation cache at an XDG cache dir so a
     provisioner restart replays cached XLA binaries instead of paying
     cold compiles (~7 s on the tunneled TPU in BENCH_r03). TPU-only: on
     CPU the cache re-loads AOT results compiled for slightly different

@@ -182,12 +182,17 @@ def quantize_requests(requests: Dict[str, int], axis: ResourceAxis) -> np.ndarra
 
 def quantize_capacity(capacity: Dict[str, int], axis: ResourceAxis) -> np.ndarray:
     """floor-quantize an allocatable ResourceList (conservative: never lets
-    a node look bigger)."""
+    a node look bigger). Saturates at 2^30 - 1: an axis built from a
+    smaller capacity population (e.g. the consolidation repack's
+    candidate-only axis) can meet a larger fleet node, and a bare int32
+    cast would wrap its capacity negative — silently zeroing it. One
+    below the request-side clamp so a saturated REQUEST (2^30, 'beyond
+    every capacity') still never fits a saturated capacity."""
     out = np.zeros(axis.count, dtype=np.int64)
     for k, v in capacity.items():
         i = axis.index(k)
         if i is not None:
-            out[i] = max(int(v), 0) // int(axis.divisors[i])
+            out[i] = min(max(int(v), 0) // int(axis.divisors[i]), 2**30 - 1)
     return out.astype(np.int32)
 
 

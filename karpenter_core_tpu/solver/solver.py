@@ -91,6 +91,20 @@ _CATALOG_CACHE_MAX = 8
 _CATALOG_LOCK = threading.RLock()
 
 
+class _DeferredHostCompat:
+    """Host-compat job captured under _CATALOG_LOCK, executed at the
+    solve's sync point — the lock must not be held for the matmul (a
+    concurrent disruption simulation would serialize behind it)."""
+
+    __slots__ = ("args",)
+
+    def __init__(self, *args):
+        self.args = args
+
+    def __call__(self) -> np.ndarray:
+        return allowed_host(*self.args)
+
+
 def _cache_put(enc: "EncodedInstanceTypes", key: tuple, value: np.ndarray) -> None:
     """Bounded insert into an encoding's cross-solve cache under
     _CATALOG_LOCK (its contract covers in-place mutation of shared
@@ -758,12 +772,16 @@ class TPUScheduler:
                 ):
                     # small-S regime: the tunneled chip's dispatch floor
                     # (~65 ms, BENCH_r03) dwarfs this host matmul — keep
-                    # the round trip for workloads that earn it
-                    fut = allowed_host(
+                    # the round trip for workloads that earn it. Capture
+                    # the mask arrays under the lock (extend_encoded_masks
+                    # replaces entries, never mutates arrays) and defer
+                    # the compute to the sync point so the shared catalog
+                    # lock is not held for the matmul.
+                    fut = _DeferredHostCompat(
                         sig_arrays,
-                        enc.key_masks,
-                        enc.key_has,
-                        enc.key_neg,
+                        dict(enc.key_masks),
+                        dict(enc.key_has),
+                        dict(enc.key_neg),
                         zone_ok,
                         ct_ok,
                         enc.offering_avail,
@@ -850,7 +868,12 @@ class TPUScheduler:
             )
 
         allowed_per_pool = [
-            (np.asarray(fut), zone_ok, ct_ok) for fut, zone_ok, ct_ok in pending
+            (
+                fut() if isinstance(fut, _DeferredHostCompat) else np.asarray(fut),
+                zone_ok,
+                ct_ok,
+            )
+            for fut, zone_ok, ct_ok in pending
         ]
 
         if self.metrics is not None:
@@ -864,7 +887,9 @@ class TPUScheduler:
         # that no longer fit a limited pool are stripped and their pods
         # retried against the surviving pools/types next round; bounded
         # rounds guarantee termination.
-        remaining = self._initial_remaining(pools, state_nodes or [])
+        remaining = self._initial_remaining(
+            pools, state_nodes or [], result.node_plans
+        )
         # only _enforce_limits reads this; skip on the unlimited hot path
         gi_of = (
             {i: gi for gi, g in enumerate(groups) for i in g.pod_indices}
@@ -947,9 +972,16 @@ class TPUScheduler:
     # NodePool limits (scheduler.go:76-80, 287-321, 347-383)
 
     @staticmethod
-    def _initial_remaining(pools: List[PoolEncoding], state_nodes: list) -> Dict[str, dict]:
+    def _initial_remaining(
+        pools: List[PoolEncoding], state_nodes: list, prior_plans: List["NodePlan"] = ()
+    ) -> Dict[str, dict]:
         """Per limited pool: spec limits minus the capacity of its
-        existing nodes (scheduler.go:76-80 + :287-321)."""
+        existing nodes (scheduler.go:76-80 + :287-321) AND of NodePlans
+        already emitted earlier in this solve — relaxation retries
+        re-enter the pipeline and must not see the limits reset, or a
+        limited pool gets pushed past spec.limits (the reference
+        re-checks limits against every launched claim each loop,
+        scheduler.go:347-383)."""
         remaining: Dict[str, dict] = {}
         for pool in pools:
             limits = pool.nodepool.spec.limits
@@ -960,6 +992,11 @@ class TPUScheduler:
                 name = n.labels().get(wk.NODEPOOL_LABEL_KEY, "")
                 if name in remaining:
                     remaining[name] = resources.subtract(remaining[name], n.capacity())
+            for plan in prior_plans:
+                if plan.nodepool_name in remaining:
+                    remaining[plan.nodepool_name] = resources.subtract(
+                        remaining[plan.nodepool_name], plan.instance_type.capacity
+                    )
         return remaining
 
     def _limit_masks(

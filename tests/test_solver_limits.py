@@ -115,3 +115,45 @@ class TestTensorLimits:
         res = tpu_solve(pods, [nodepool], provider)
         assert res.pods_scheduled == 5
         assert res.node_count == 5
+
+
+class TestLimitsSurviveRelaxationRetry:
+    def test_relaxed_retry_cannot_breach_limits(self):
+        """_relax_and_retry re-enters _solve_tensor; the re-derived
+        remaining-limits must subtract NodePlans already emitted this
+        solve, or the relaxed pod opens a node past spec.limits
+        (VERDICT r3 weak #4; ref scheduler.go:347-383)."""
+        from karpenter_core_tpu.kube.objects import (
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+
+        provider = single_type_provider(cpu="4")
+        nodepool = make_nodepool(limits={"cpu": "4"})  # exactly one node
+        filler = [make_pod(requests={"cpu": "3"})]
+        # preferred affinity to a zone no offering has: fails pass 1,
+        # relaxation strips the preference, retry would open a 2nd node
+        relaxable = make_pod(
+            requests={"cpu": "3"},
+            preferred_node_affinity=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=wk.LABEL_TOPOLOGY_ZONE,
+                                operator="In",
+                                values=["no-such-zone"],
+                            )
+                        ]
+                    ),
+                )
+            ],
+        )
+        res = tpu_solve(filler + [relaxable], [nodepool], provider)
+        assert res.oracle_results is None  # tensor path ran
+        assert res.node_count == 1  # the limit holds across the retry
+        assert res.pods_scheduled == 1
+        assert relaxable.uid in res.pod_errors
+        assert "exceed limits" in res.pod_errors[relaxable.uid]

@@ -3,6 +3,7 @@ true batched prefix repack (repack_prefixes) and the TPU-backed
 simulation path (simulate_scheduling with a use_tpu_solver provisioner)
 must agree with the oracle's consolidation decisions."""
 
+import numpy as np
 from helpers import Env, running_pod
 
 from karpenter_core_tpu.disruption.helpers import get_candidates, simulate_scheduling
@@ -104,3 +105,68 @@ class TestTPUSimulationParity:
             assert claim.nodepool_name == "default"
             nc = claim.to_node_claim(env.nodepool)
             assert nc.spec.requirements
+
+
+class TestPrefixTryOrdering:
+    def test_tries_descend_even_when_repack_bound_exceeds_screen(self, env, monkeypatch):
+        """The capacity screen (k_hi) and the repack lower bound (k_lo)
+        use different capacity sets; when k_lo > k_hi the largest
+        feasible prefix must still be attempted FIRST, or a smaller
+        consolidation gets returned (VERDICT r3 weak #6)."""
+        import karpenter_core_tpu.disruption.methods as methods_mod
+        import karpenter_core_tpu.disruption.tpu_repack as repack_mod
+
+        for i in range(8):
+            env.make_initialized_node("fake-it-4", pods=[running_pod()])
+        env.now += 3600.0
+        assert env.cluster.synced()
+        method = MultiNodeConsolidation(env.controller.ctx)
+        cands = _candidates(env)
+        assert len(cands) >= 6
+
+        monkeypatch.setattr(repack_mod, "screen_prefixes", lambda ctx, c: 4)
+        monkeypatch.setattr(repack_mod, "repack_prefixes", lambda ctx, c: 6)
+        attempted = []
+
+        def record(prefix):
+            attempted.append(len(prefix))
+            return None  # force it to walk the whole try list
+
+        monkeypatch.setattr(method, "_attempt", record)
+        monkeypatch.setattr(
+            method, "_binary_search", lambda *a, **k: methods_mod.Command()
+        )
+        method.first_n_consolidation(cands, max_n=len(cands))
+        assert attempted == sorted(attempted, reverse=True)
+        assert attempted[0] == 6  # the larger (repack) bound goes first
+
+
+class TestQuantizeCapacitySaturation:
+    def test_oversized_fleet_node_saturates_instead_of_wrapping(self):
+        """A fleet node quantized against a candidate-only axis (smaller
+        divisors) must saturate at 2^30, not wrap int32-negative and
+        silently zero its capacity (VERDICT r3 weak #5)."""
+        from karpenter_core_tpu.kube.quantity import parse_quantity
+        from karpenter_core_tpu.solver.encode import (
+            build_axis_from_capacities,
+            quantize_capacity,
+        )
+
+        # axis built from small candidates only -> divisor stays 10^6
+        axis = build_axis_from_capacities(
+            [{"cpu": parse_quantity("4"), "memory": parse_quantity("8Gi")}]
+        )
+        huge = {
+            "cpu": parse_quantity("4000000"),  # 4e15 nanos / 1e6 = 4e9 > 2^31
+            "memory": parse_quantity("30000Ti"),
+        }
+        q = quantize_capacity(huge, axis)
+        assert q.dtype == np.int32
+        assert (q >= 0).all()
+        # one below the request clamp: a saturated (2^30) request must
+        # still not fit even a saturated capacity
+        assert q[axis.index("cpu")] == 2**30 - 1
+        assert q[axis.index("memory")] == 2**30 - 1
+        # and a normal node is untouched
+        q2 = quantize_capacity({"cpu": parse_quantity("4")}, axis)
+        assert q2[axis.index("cpu")] == 4000
