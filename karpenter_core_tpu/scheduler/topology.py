@@ -226,6 +226,42 @@ def _ignored_for_topology(p: Pod) -> bool:
     return not podutils.is_scheduled(p) or podutils.is_terminal(p) or podutils.is_terminating(p)
 
 
+def count_matching_pods_by_domain(
+    kube_client, tg: TopologyGroup, excluded_uids
+) -> Dict[str, int]:
+    """Per-domain count of existing pods matching a topology group's
+    selector/namespaces/node filter (topology.go:238 countDomains).
+    Shared by the oracle's seeding and the tensor path's
+    (solver/topology_tensor.py) so the two can't drift."""
+    counts: Dict[str, int] = {}
+    if kube_client is None:
+        return counts
+    pods: List[Pod] = []
+    for ns in tg.namespaces:
+        pods.extend(
+            kube_client.list(
+                "Pod", namespace=ns, label_selector=tg.selector or LabelSelector()
+            )
+        )
+    for p in pods:
+        if _ignored_for_topology(p) or p.uid in excluded_uids:
+            continue
+        node = kube_client.get("Node", p.spec.node_name)
+        if node is None:
+            continue
+        domain = node.metadata.labels.get(tg.key)
+        if domain is None and tg.key == wk.LABEL_HOSTNAME:
+            # node may not be labeled yet; fall back to node name
+            # (topology.go:272-279)
+            domain = node.name
+        if domain is None:
+            continue
+        if not tg.node_filter.matches_labels(node.metadata.labels):
+            continue
+        counts[domain] = counts.get(domain, 0) + 1
+    return counts
+
+
 class Topology:
     """All topology groups for one scheduling batch (topology.go:42)."""
 
@@ -362,31 +398,10 @@ class Topology:
 
     def _count_domains(self, tg: TopologyGroup) -> None:
         """Count existing matching pods into the group (topology.go:238)."""
-        if self.kube_client is None:
-            return
-        pods: List[Pod] = []
-        for ns in tg.namespaces:
-            pods.extend(
-                self.kube_client.list(
-                    "Pod", namespace=ns, label_selector=tg.selector or LabelSelector()
-                )
-            )
-        for p in pods:
-            if _ignored_for_topology(p) or p.uid in self.excluded_pods:
-                continue
-            node = self.kube_client.get("Node", p.spec.node_name)
-            if node is None:
-                continue
-            domain = node.metadata.labels.get(tg.key)
-            if domain is None and tg.key == wk.LABEL_HOSTNAME:
-                # node may not be labeled yet; fall back to node name
-                # (topology.go:272-279)
-                domain = node.name
-            if domain is None:
-                continue
-            if not tg.node_filter.matches_labels(node.metadata.labels):
-                continue
-            tg.record(domain)
+        for domain, n in count_matching_pods_by_domain(
+            self.kube_client, tg, self.excluded_pods
+        ).items():
+            tg.domains[domain] = tg.domains.get(domain, 0) + n
 
     def _new_for_topologies(self, p: Pod) -> List[TopologyGroup]:
         groups = []

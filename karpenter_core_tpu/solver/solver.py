@@ -399,6 +399,10 @@ class TPUScheduler:
         )
         # this batch's own id→request view: immune to intern-table resets
         self._req_map = {m.req_id: m.requests for m in memos}
+        # spread-count seeding excludes the batch being scheduled
+        # (topology.go:71-75) and is cached per constraint per solve
+        self._batch_uids = {p.uid for p in pods}
+        self._seed_cache: Dict[tuple, Dict[str, int]] = {}
         groups = group_pods(pods, memos=memos)
         def exclude(pool: List[SignatureGroup], subset: List[SignatureGroup]):
             """pool minus subset, by identity (dataclass __eq__ is deep)."""
@@ -432,17 +436,34 @@ class TPUScheduler:
         ]
         tensor_groups = exclude(tensor_groups, pulled)
         oracle_groups = relational + pulled
+        # zone-spread groups stay on the tensor path (seeded per-domain
+        # counters + closed-form min-skew, topology_tensor.py) — EXCEPT
+        # when their selector matches pods outside the group, where
+        # counting needs the oracle's global view. Hostname topologies
+        # with existing capacity also go oracle: their per-node counts
+        # interleave with the existing-node pack in a way the batched
+        # pack doesn't model.
+        cross = []
+        for g in tensor_groups:
+            sels = [
+                c.label_selector
+                for c in g.exemplar.spec.topology_spread_constraints
+                if c.label_selector is not None
+            ]
+            if sels and any(
+                sel.matches(h.exemplar.metadata.labels)
+                for h in groups
+                if h is not g
+                for sel in sels
+            ):
+                cross.append(g)
+        tensor_groups = exclude(tensor_groups, cross)
+        oracle_groups = oracle_groups + cross
         if state_nodes:
-            # topology-bearing groups need existing per-domain counts to
-            # seed skew balancing — those route to the oracle when the
-            # cluster has capacity; plain groups pack onto existing nodes
-            # on the tensor path (scheduler.go:241-246 order)
             spreadish = [
                 g
                 for g in tensor_groups
-                if g.zone_spread() is not None
-                or g.hostname_spread() is not None
-                or g.hostname_isolated
+                if g.hostname_spread() is not None or g.hostname_isolated
             ]
             tensor_groups = exclude(tensor_groups, spreadish)
             oracle_groups = oracle_groups + spreadish
@@ -594,6 +615,11 @@ class TPUScheduler:
         host-port/volume-bearing groups never reach this path — they
         route to the oracle at solve() group split).
 
+        Zone-spread groups are NOT packed here: their pods get zones
+        first (seeded min-skew quotas in _prepare_class_jobs) and then
+        try existing nodes zone-pinned (_pack_spread_existing) against
+        the free-capacity state stashed in ``self._existing_ctx``.
+
         Encoding: nodes become an (M, R) free-capacity matrix (available
         minus remaining daemon overhead) in the oracle's try-order
         (initialized first, then name); admissibility comes from
@@ -606,13 +632,11 @@ class TPUScheduler:
         M = len(nodes)
         if M == 0 or not groups:
             return
-        batch_idx = np.array(
-            [i for g in groups for i in g.pod_indices], dtype=np.int64
-        )
-        batch_ids = self._req_ids[batch_idx]
+        # axis spans ALL batch requests (spread pods quantize against the
+        # same axis later, zone-pinned)
         axis = extend_axis(
             build_axis_from_capacities([n.allocatable() for n in nodes]),
-            unique_requests(batch_ids, self._req_map),
+            unique_requests(self._req_ids, self._req_map),
         )
 
         # one Taints/label-requirements view per node, shared by the
@@ -647,22 +671,45 @@ class TPUScheduler:
             if not any(v < 0 for v in avail.values()):
                 free[m] = quantize_capacity(avail, axis)
 
+        # stash the shared free-capacity state for the zone-pinned spread
+        # pack that runs later (quotas need pool/zone eligibility first)
+        free = np.ascontiguousarray(free, dtype=np.int32)
+        self._existing_ctx = dict(
+            nodes=nodes,
+            free=free,
+            axis=axis,
+            node_zones=np.array(
+                [lbls.get(wk.LABEL_TOPOLOGY_ZONE, "") for lbls in node_labels]
+            ),
+            compat_rows={},
+        )
+
+        # zone-spread groups are zone-assigned before touching existing
+        # capacity — exclude them from this selector-blind pack
+        pack = [(gi, g) for gi, g in enumerate(groups) if g.zone_spread() is None]
+        if not pack:
+            return
+        sub_groups = [g for _, g in pack]
         # signature × node admissibility (shared with the consolidation
         # repack — disruption/tpu_repack.py)
-        compat = existing_node_compat(groups, nodes)
+        compat = existing_node_compat(sub_groups, nodes)
         if not compat.any():
             return
 
         # global pack in the oracle's pod order: all pods descending by
         # (primary, memory) — queue.go:76
-        pod_idx = batch_idx
-        sig_ids = np.array(
-            [s for s, g in enumerate(groups) for _ in g.pod_indices], dtype=np.int32
+        pod_idx = np.array(
+            [i for g in sub_groups for i in g.pod_indices], dtype=np.int64
         )
-        reqs = build_requests_matrix_ids(batch_ids, axis, self._req_map)
+        sig_ids = np.array(
+            [s for s, g in enumerate(sub_groups) for _ in g.pod_indices],
+            dtype=np.int32,
+        )
+        reqs = build_requests_matrix_ids(self._req_ids[pod_idx], axis, self._req_map)
         order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
         pod_idx, sig_ids, reqs = pod_idx[order], sig_ids[order], reqs[order]
-        assign, _ = run_pack_existing(reqs, sig_ids, compat, free)
+        assign, free_out = run_pack_existing(reqs, sig_ids, compat, free)
+        self._existing_ctx["free"] = np.ascontiguousarray(free_out, dtype=np.int32)
 
         by_node: Dict[int, List[int]] = {}
         for j in np.flatnonzero(assign >= 0):
@@ -670,7 +717,7 @@ class TPUScheduler:
         if not by_node:
             return
         assigned = {i for members in by_node.values() for i in members}
-        for gi, g in enumerate(groups):
+        for gi, g in pack:
             leftover[gi] = [i for i in g.pod_indices if i not in assigned]
         for m in sorted(by_node):
             result.existing_plans.append(
@@ -690,6 +737,7 @@ class TPUScheduler:
         # --- existing capacity first (scheduler.go:241-246) -------------
         # per-group indices still needing placement after the existing-
         # node pack; starts as every pod in the group
+        self._existing_ctx: Optional[dict] = None
         leftover: Dict[int, List[int]] = {
             gi: list(g.pod_indices) for gi, g in enumerate(groups)
         }
@@ -1216,17 +1264,18 @@ class TPUScheduler:
                     )
                 continue
 
-            # per-zone strided slices replace the per-pod append loop:
-            # pod j of a group's descending order lands in zone j % Z,
-            # identical round-robin, vectorized
+            # per-group min-skew zone assignment from seeded domain
+            # counters (topology.go:125-148 Record + topologygroup.go:
+            # 93-104 min-skew selection, in closed form —
+            # topology_tensor.py); zone-assigned pods then try existing
+            # nodes in their zone before opening new ones
             buckets: Dict[str, list] = {z: [] for z in zones}
             Z = len(zones)
             for m in spread:
                 g_idx, _ = sorted_idx(m["indices"])
-                for zi, z in enumerate(zones):
-                    part = g_idx[zi::Z]
-                    if part.size:
-                        buckets[z].append(part)
+                self._spread_assign(
+                    m, g_idx, zones, enc, pods, result, buckets
+                )
             # plain pods ride along only when zone choice doesn't shrink
             # the viable set — otherwise a pod needing a type offered in
             # one zone could be round-robined into a bucket without it
@@ -1253,6 +1302,195 @@ class TPUScheduler:
                         max_per_node, pool, pods, result, jobs, metas, zone=z,
                         merged=merged,
                     )
+
+    # ------------------------------------------------------------------
+    # tensor-path topology spread (topology_tensor.py; VERDICT r3 #2/#5)
+
+    def _spread_seeds(self, group: SignatureGroup, constraint) -> Dict[str, int]:
+        """Existing matching-pod counts per zone for one constraint,
+        cached per solve (the oracle seeds identically via
+        Topology._count_domains; batch pods are excluded)."""
+        from ..scheduler.topology import TopologyNodeFilter
+        from .encode import _selector_key
+        from .topology_tensor import seed_counts_for_constraint
+
+        key = (
+            constraint.topology_key,
+            _selector_key(constraint.label_selector),
+            group.exemplar.namespace,
+            # counting drops pods on nodes failing the exemplar's node
+            # filter — groups with different nodeSelector/affinity must
+            # not share counts
+            TopologyNodeFilter.for_pod(group.exemplar).key(),
+        )
+        seeds = self._seed_cache.get(key)
+        if seeds is None:
+            seeds = seed_counts_for_constraint(
+                self.kube_client, group.exemplar, constraint, self._batch_uids
+            )
+            self._seed_cache[key] = seeds
+        return seeds
+
+    @staticmethod
+    def _existing_compat_row(group: SignatureGroup, ctx: dict) -> np.ndarray:
+        row = ctx["compat_rows"].get(id(group))
+        if row is None:
+            row = existing_node_compat([group], ctx["nodes"])[0]
+            ctx["compat_rows"][id(group)] = row
+        return row
+
+    def _spread_assign(
+        self,
+        m: dict,
+        g_idx: np.ndarray,
+        zones: List[str],
+        enc: EncodedInstanceTypes,
+        pods: List[Pod],
+        result: SolverResult,
+        buckets: Dict[str, list],
+    ) -> None:
+        """Assign one spread group's pods to zones by seeded min-skew
+        quotas, route each zone's pods through existing capacity first,
+        and append the remainder to the new-node buckets."""
+        from ..kube.objects import SCHEDULE_ANYWAY
+        from .topology_tensor import interleave_by_quota, spread_quotas
+
+        group: SignatureGroup = m["group"]
+        c = group.zone_spread()
+        P = len(g_idx)
+        if P == 0:
+            return
+        seeds = self._spread_seeds(group, c)
+        # later passes (limit-spill rounds, relaxation retries) must see
+        # this solve's own committed placements in the counts — the
+        # oracle records every landing immediately (topology.go:125);
+        # free when no plans exist yet (the common single-pass solve)
+        if result.node_plans or result.existing_plans:
+            seeds = dict(seeds)
+            sel = c.label_selector
+            ns = group.exemplar.namespace
+
+            def _matches(i: int) -> bool:
+                p = pods[i]
+                return p.namespace == ns and (
+                    sel is None or sel.matches(p.metadata.labels)
+                )
+
+            for plan in result.node_plans:
+                n = sum(1 for i in plan.pod_indices if _matches(i))
+                if n:
+                    seeds[plan.zone] = seeds.get(plan.zone, 0) + n
+            for eplan in result.existing_plans:
+                z = eplan.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE)
+                if z:
+                    n = sum(1 for i in eplan.pod_indices if _matches(i))
+                    if n:
+                        seeds[z] = seeds.get(z, 0) + n
+        ctx = self._existing_ctx
+        merged = m["merged"]
+        zone_req = (
+            merged.get_req(wk.LABEL_TOPOLOGY_ZONE) if merged is not None else None
+        )
+
+        def allowed(z: str) -> bool:
+            return zone_req is None or zone_req.has(z)
+
+        # placement domains A: new-node-eligible zones, plus zones whose
+        # existing nodes admit the group (a pod can land there with no
+        # new claim — scheduler.go:241-246 order)
+        place = list(zones)
+        existing_zones: set = set()
+        if ctx is not None:
+            row = self._existing_compat_row(group, ctx).astype(bool)
+            for z in set(ctx["node_zones"][row].tolist()):
+                if z and allowed(z):
+                    existing_zones.add(z)
+                    if z not in place:
+                        place.append(z)
+        # pod-supported domains D: the full universe filtered by the
+        # merged requirements — supported-but-unplaceable domains pin the
+        # global min at their seed count (topologygroup.go:177,193-212)
+        universe = set(enc.zones) | set(seeds) | existing_zones
+        supported = {d for d in universe if allowed(d)}
+        ext = supported - set(place)
+        ext_min = min((seeds.get(d, 0) for d in ext)) if ext else None
+        min_domains = (
+            c.min_domains if c.when_unsatisfiable != SCHEDULE_ANYWAY else None
+        )
+        counts = np.array([seeds.get(z, 0) for z in place], dtype=np.int64)
+        quotas, unplaced = spread_quotas(
+            counts, ext_min, c.max_skew, min_domains, len(supported), P
+        )
+        parts = interleave_by_quota(g_idx, quotas)
+        if unplaced:
+            # DoNotSchedule overflow fails like the oracle's DoesNotExist
+            # next-domain; ScheduleAnyway groups get the constraint
+            # stripped by the relaxation ladder and retry as plain
+            for i in g_idx[P - unplaced :]:
+                result.pod_errors[pods[i].uid] = (
+                    f"would violate max-skew for topology spread on "
+                    f"{c.topology_key}"
+                )
+        respill: List[np.ndarray] = []
+        for zi, z in enumerate(place):
+            part = parts[zi]
+            if part.size and ctx is not None and z in existing_zones:
+                part = self._pack_spread_existing(part, z, group, ctx, result)
+            if part.size == 0:
+                continue
+            if z in buckets:  # new-node-eligible zone
+                buckets[z].append(part)
+            else:
+                respill.append(part)
+        if respill:
+            # existing-only zones out of free capacity: retarget the
+            # least-loaded new-node zone (bounded skew divergence — the
+            # oracle would interleave these per pod)
+            spill = np.concatenate(respill)
+            tgt = min(
+                zones,
+                key=lambda z: seeds.get(z, 0)
+                + sum(int(p.size) for p in buckets[z]),
+            )
+            buckets[tgt].append(spill)
+
+    def _pack_spread_existing(
+        self,
+        part: np.ndarray,
+        zone: str,
+        group: SignatureGroup,
+        ctx: dict,
+        result: SolverResult,
+    ) -> np.ndarray:
+        """First-fit one zone bucket onto that zone's admitting existing
+        nodes (zone-pinned so committed domain counts stay exact);
+        returns the indices that still need a new node."""
+        row = self._existing_compat_row(group, ctx).astype(bool)
+        mask = row & (ctx["node_zones"] == zone)
+        if not mask.any():
+            return part
+        reqs = build_requests_matrix_ids(
+            self._req_ids[part], ctx["axis"], self._req_map
+        )
+        assign, free_out = run_pack_existing(
+            reqs,
+            np.zeros(len(part), dtype=np.int32),
+            mask[None, :].astype(np.uint8),
+            ctx["free"],
+        )
+        ctx["free"] = np.ascontiguousarray(free_out, dtype=np.int32)
+        placed = assign >= 0
+        if placed.any():
+            by_node: Dict[int, List[int]] = {}
+            for j in np.flatnonzero(placed):
+                by_node.setdefault(int(assign[j]), []).append(int(part[j]))
+            for mnode in sorted(by_node):
+                result.existing_plans.append(
+                    ExistingNodePlan(
+                        state_node=ctx["nodes"][mnode], pod_indices=by_node[mnode]
+                    )
+                )
+        return part[~placed]
 
     # ------------------------------------------------------------------
 
