@@ -377,12 +377,32 @@ def config3() -> dict:
         res = solver.solve(pods)
         dt = time.perf_counter() - t0
 
-    # packing parity vs the oracle on a subsample (oracle is O(P·N))
+    # packing parity vs the oracle on a CAPPED catalog (types ≤64 vCPU,
+    # max-pods 110) so node counts are non-degenerate: the mega-type
+    # catalog packs a 5k subsample into ~3 nodes, where the parity ratio
+    # can only take values {1, 2/3, 1/3}. Here the oracle opens 80+
+    # nodes and 1 node of drift moves the metric ~1%.
+    from karpenter_core_tpu.cloudprovider.fake import new_instance_type
+
+    capped_provider = FakeCloudProvider()
+    capped_provider.instance_types = [
+        new_instance_type(
+            f"cap-{i}",
+            {"cpu": str((i % 64) + 1), "memory": f"{2 * ((i % 64) + 1)}Gi", "pods": "110"},
+        )
+        for i in range(64)
+    ]
     sub = pods[: _scale(5000)]
-    oracle = build_scheduler(None, None, [nodepool], provider, sub).solve(sub)
-    tpu_sub = TPUScheduler([nodepool], provider).solve(sub)
+    oracle = build_scheduler(None, None, [nodepool], capped_provider, sub).solve(sub)
+    tpu_sub = TPUScheduler([nodepool], capped_provider).solve(sub)
     o_nodes = len(oracle.new_node_claims)
-    parity = 1.0 - abs(tpu_sub.node_count - o_nodes) / max(o_nodes, 1)
+    o_scheduled = sum(len(c.pods) for c in oracle.new_node_claims)
+    if tpu_sub.pods_scheduled < o_scheduled:
+        parity = 0.0  # scheduling fewer pods is a failure, not "fewer nodes"
+    else:
+        # one-sided: parity asks "not worse than the oracle"; the TPU
+        # path's cross-group merge can legitimately pack FEWER nodes
+        parity = min(1.0, o_nodes / max(tpu_sub.node_count, 1))
     return {
         "config": "3: 50k constrained pods x 2k types (TPU)",
         "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import os
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -59,6 +61,7 @@ class Provisioner:
         self.use_tpu_solver = use_tpu_solver
         self.metrics = metrics
         self._change_monitor = ChangeMonitor()
+        self._parity_solve_count = 0
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -212,7 +215,50 @@ class Provisioner:
 
                 for pod in plan.pods:
                     self.recorder.publish(ev.nominate_pod(pod, plan.state_node.name()))
+        self._maybe_observe_parity(pods, nodepools)
         return results
+
+    # every Nth tensor solve shadows a pod subsample through the oracle
+    # and records node-count parity — the live analogue of the bench's
+    # parity gate; 0 disables
+    PARITY_SAMPLE_EVERY = int(os.environ.get("KARPENTER_TPU_PARITY_SAMPLE", "16"))
+    PARITY_SUBSAMPLE = 500
+
+    def _maybe_observe_parity(self, pods: List[Pod], nodepools) -> None:
+        if self.metrics is None or self.PARITY_SAMPLE_EVERY <= 0 or len(pods) < 8:
+            return
+        self._parity_solve_count += 1
+        if self._parity_solve_count % self.PARITY_SAMPLE_EVERY:
+            return
+        # the shadow only sets a gauge — run it off the provisioning
+        # path so the O(P·N) oracle solve never delays NodeClaim creation
+        sub = pods[: self.PARITY_SUBSAMPLE]
+        threading.Thread(
+            target=self._observe_parity, args=(sub, list(nodepools)), daemon=True
+        ).start()
+
+    def _observe_parity(self, sub: List[Pod], nodepools) -> None:
+        try:
+            from ..scheduler.builder import build_scheduler
+            from ..solver import TPUScheduler
+
+            o = build_scheduler(
+                self.kube_client, None, nodepools, self.cloud_provider, sub
+            ).solve(sub)
+            t = TPUScheduler(
+                nodepools, self.cloud_provider, kube_client=self.kube_client
+            ).solve(sub)
+            o_scheduled = sum(len(c.pods) for c in o.new_node_claims)
+            if t.pods_scheduled < o_scheduled:
+                # scheduling fewer pods must read as a parity failure,
+                # not as "fewer nodes = perfect"
+                parity = 0.0
+            else:
+                # one-sided: fewer nodes than the oracle is no regression
+                parity = min(1.0, len(o.new_node_claims) / max(t.node_count, 1))
+            self.metrics.solver_parity.set(parity)
+        except Exception:  # the shadow must never break provisioning
+            pass
 
     # -- create (provisioner.go:141-153, 341-367) --------------------------
 

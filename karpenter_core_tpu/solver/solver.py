@@ -575,8 +575,133 @@ class TPUScheduler:
             # capacity promised to earlier placements must be visible
             # before the retry packs onto existing nodes again
             self._commit_existing_plans(pods, result)
+            # in-flight claims first: a relaxed pod back-fills a node
+            # plan already emitted this solve before opening a new one
+            # (scheduler.go:163-169 re-queues through existing claims)
+            retry = self._backfill_node_plans(pods, retry, daemonset_pods, result)
+            if not retry:
+                return
             self._solve_tensor(pods, retry, daemonset_pods, result, state_nodes=state_nodes)
             groups = retry
+
+    _BACKFILL_SCAN_CAP = 256  # plans examined per retry group
+
+    def _backfill_node_plans(
+        self,
+        pods: List[Pod],
+        retry: List[SignatureGroup],
+        daemonset_pods: List[Pod],
+        result: SolverResult,
+    ) -> List[SignatureGroup]:
+        """Place relaxed-retry pods onto NodePlans already emitted this
+        solve when the plan's node would admit them and its pinned
+        instance type still has room — the oracle's re-queued pods see
+        earlier in-flight claims (scheduler.go:163-169,241-246); without
+        this, a relaxed pod opens a node the oracle would back-fill.
+        Returns the groups still needing a full retry pass."""
+        from ..scheduling.requirements import (
+            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+            pod_requirements as _pod_reqs,
+        )
+
+        if not result.node_plans:
+            return retry
+        pools_by_name = {np_.name: np_ for np_ in self.nodepools}
+        # per-pool daemon overhead once, not per (pod × plan)
+        daemon_by_pool = {
+            name: self._daemon_overhead_for(np_, daemonset_pods)
+            for name, np_ in pools_by_name.items()
+        }
+        remaining: List[SignatureGroup] = []
+        for g in retry:
+            if (
+                g.zone_spread() is not None
+                or g.hostname_spread() is not None
+                or g.hostname_isolated
+            ):
+                # zone-spread pods must go through the seeded quota path
+                # so domain counts stay exact; hostname topologies cap
+                # pods-per-node (max_per_node) which a plain backfill
+                # append would violate
+                remaining.append(g)
+                continue
+            pod_reqs = _pod_reqs(g.exemplar)
+            unplaced: List[int] = []
+            for i in g.pod_indices:
+                placed = False
+                for plan in result.node_plans[: self._BACKFILL_SCAN_CAP]:
+                    np_ = pools_by_name.get(plan.nodepool_name)
+                    if np_ is None or plan.requirements is None:
+                        continue
+                    if Taints(np_.spec.template.taints).tolerates(g.exemplar):
+                        continue
+                    # the launched node carries the plan's merged labels
+                    # plus its pinned type/zone/capacity-type
+                    node_reqs = Requirements(*plan.requirements.values_list())
+                    node_reqs.add(*plan.instance_type.requirements.values_list())
+                    from ..kube.objects import OP_IN
+                    from ..scheduling import Requirement
+
+                    node_reqs.add(
+                        Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [plan.zone]),
+                        Requirement(
+                            wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [plan.capacity_type]
+                        ),
+                    )
+                    if node_reqs.compatible(
+                        pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                    ):
+                        continue
+                    load = resources.merge(
+                        *(plan._pod_requests or ()),
+                        self._all_requests[i],
+                        daemon_by_pool[plan.nodepool_name],
+                    )
+                    if not resources.fits(load, plan.instance_type.allocatable()):
+                        continue
+                    plan.pod_indices.append(i)
+                    if plan._pod_requests is not None:
+                        plan._pod_requests.append(self._all_requests[i])
+                    plan._requests = None  # recompute lazily
+                    merged = Requirements(*plan.requirements.values_list())
+                    merged.add(*pod_reqs.values_list())
+                    plan.requirements = merged
+                    placed = True
+                    break
+                if not placed:
+                    unplaced.append(i)
+            if unplaced:
+                remaining.append(
+                    SignatureGroup(
+                        signature=g.signature, exemplar=g.exemplar, pod_indices=unplaced
+                    )
+                )
+        return remaining
+
+    def _daemon_overhead_for(self, nodepool, daemonset_pods: List[Pod]) -> dict:
+        """Daemonset request total for a pool's nodes (matches the
+        per-pool computation in _solve_tensor)."""
+        from ..scheduling.requirements import node_selector_requirements
+        from ..scheduling.requirements import label_requirements
+        from ..scheduling.requirements import pod_requirements as _pod_reqs
+
+        if not daemonset_pods:
+            return {}
+        template_reqs = node_selector_requirements(nodepool.spec.template.requirements)
+        template_reqs.add(
+            *label_requirements(
+                {**nodepool.spec.template.metadata.labels, wk.NODEPOOL_LABEL_KEY: nodepool.name}
+            ).values_list()
+        )
+        taints = Taints(nodepool.spec.template.taints)
+        daemons = [
+            p
+            for p in daemonset_pods
+            if taints.tolerates(p) is None
+            and template_reqs.compatible(_pod_reqs(p), frozenset(wk.WELL_KNOWN_LABELS))
+            is None
+        ]
+        return resources.requests_for_pods(*daemons) if daemons else {}
 
     # ------------------------------------------------------------------
 

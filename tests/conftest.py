@@ -23,6 +23,12 @@ except Exception:
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: at-scale gates (parity at 5k+ pods); always run in CI"
+    )
+
+
 @pytest.fixture
 def env():
     """Shared disruption-test environment (helpers.Env); fixtures only

@@ -1,0 +1,78 @@
+"""At-scale packing-parity gate (VERDICT r3 #4): the BASELINE promise is
+≥99% node-count parity vs the oracle. The catalog is capped (types ≤64
+vCPU, max-pods 110) so the oracle opens 80+ nodes and one node of drift
+moves the metric ~1% — on the mega-type catalog a 5k subsample packs
+into ~3 nodes and the ratio is statistically void. This gate FAILED at
+K_OPEN=16 (342 vs 331 nodes at 20k pods = 0.967) and drove the native
+packer's K to 1024."""
+
+import numpy as np
+import pytest
+
+from helpers import make_nodepool, make_pod
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+from karpenter_core_tpu.solver import TPUScheduler
+
+
+def _capped_provider():
+    provider = FakeCloudProvider()
+    provider.instance_types = [
+        new_instance_type(
+            f"cap-{i}",
+            {"cpu": str((i % 64) + 1), "memory": f"{2 * ((i % 64) + 1)}Gi", "pods": "110"},
+        )
+        for i in range(64)
+    ]
+    return provider
+
+
+def _mixed_pods(n, seed=11):
+    rng = np.random.RandomState(seed)
+    pods = []
+    for _ in range(n):
+        cpu = ["100m", "250m", "500m", "1", "1500m", "2"][rng.randint(6)]
+        mem = ["128Mi", "256Mi", "512Mi", "1Gi", "2Gi"][rng.randint(5)]
+        pods.append(make_pod(requests={"cpu": cpu, "memory": mem}))
+    return pods
+
+
+@pytest.mark.slow
+def test_packing_parity_gate_5k():
+    """≥99% node-count parity at 5k pods / ≥80 oracle nodes."""
+    provider = _capped_provider()
+    pods = _mixed_pods(5000)
+    oracle = build_scheduler(None, None, [make_nodepool()], provider, pods).solve(pods)
+    o_nodes = len(oracle.new_node_claims)
+    assert o_nodes >= 50, f"degenerate gate: oracle packed into {o_nodes} nodes"
+    tpu = TPUScheduler([make_nodepool()], provider).solve(pods)
+    # one-sided: the gate asks "not worse than the oracle" — fewer nodes
+    # (the cross-group merge can beat the greedy) is a pass
+    parity = min(1.0, o_nodes / tpu.node_count)
+    assert parity >= 0.99, (
+        f"parity {parity:.4f} below gate: tpu={tpu.node_count} oracle={o_nodes}"
+    )
+    # both paths schedule everything
+    assert tpu.pods_scheduled == 5000
+    assert sum(len(c.pods) for c in oracle.new_node_claims) == 5000
+
+
+def test_parity_gauge_observed_by_shadow_solve():
+    """The karpenter_tpu_solver_packing_parity gauge must be fed by the
+    provisioner's sampled shadow solve (dead code through r3)."""
+    from karpenter_core_tpu.metrics.registry import Metrics, Registry
+    from karpenter_core_tpu.provisioning.provisioner import Provisioner
+
+    provider = _capped_provider()
+    metrics = Metrics(Registry())
+    prov = Provisioner.__new__(Provisioner)
+    prov.kube_client = None
+    prov.cloud_provider = provider
+    prov.metrics = metrics
+    pods = _mixed_pods(200, seed=3)
+    # the sampled wrapper dispatches this to a background thread; call
+    # the worker directly so the assertion is race-free
+    prov._observe_parity(pods, [make_nodepool()])
+    value = metrics.solver_parity.get()
+    assert value is not None, "shadow solve did not set the parity gauge"
+    assert value >= 0.99

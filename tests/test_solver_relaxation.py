@@ -131,3 +131,105 @@ class TestTensorRelaxation:
         res = tpu_solve(pods)
         assert len(res.pod_errors) == 1
         assert res.pods_scheduled == 0
+
+
+class TestRetryBackfillsEarlierPlans:
+    def test_relaxed_pod_lands_on_this_solves_plan(self):
+        """A relaxed retry must back-fill a NodePlan already emitted this
+        solve before opening a new node (scheduler.go:163-169; VERDICT r3
+        weak #7)."""
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+        from karpenter_core_tpu.kube.client import KubeClient
+        from karpenter_core_tpu.kube.objects import (
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        provider = FakeCloudProvider()
+        provider.instance_types = [
+            new_instance_type("one-size", {"cpu": "4", "memory": "16Gi", "pods": "100"})
+        ]
+        filler = [make_pod(requests={"cpu": "1"}) for _ in range(2)]
+        relaxable = make_pod(
+            requests={"cpu": "1"},
+            preferred_node_affinity=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=wk.LABEL_TOPOLOGY_ZONE,
+                                operator="In",
+                                values=["no-such-zone"],
+                            )
+                        ]
+                    ),
+                )
+            ],
+        )
+        res = TPUScheduler([make_nodepool()], provider, kube_client=KubeClient()).solve(
+            filler + [relaxable]
+        )
+        assert res.oracle_results is None
+        assert res.pods_scheduled == 3
+        assert not res.pod_errors
+        # one node total: the relaxed pod back-filled the filler plan
+        assert res.node_count == 1
+        assert 2 in res.node_plans[0].pod_indices
+        # the plan's lazy request merge reflects the back-filled pod
+        assert res.node_plans[0].requests["cpu"] == 3 * 10**9
+
+    def test_hostname_isolated_retry_not_stacked_by_backfill(self):
+        """Backfill must skip hostname-isolated groups: appending a
+        retried self-anti-affinity pod to an existing plan would put two
+        isolated pods on one node."""
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+        from karpenter_core_tpu.kube.client import KubeClient
+        from karpenter_core_tpu.kube.objects import (
+            LabelSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PodAffinityTerm,
+            PreferredSchedulingTerm,
+        )
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        provider = FakeCloudProvider()
+        provider.instance_types = [
+            new_instance_type("one-size", {"cpu": "8", "memory": "32Gi", "pods": "100"})
+        ]
+        pods = [
+            make_pod(
+                requests={"cpu": "1"},
+                labels={"app": "iso"},
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "iso"}),
+                    )
+                ],
+                preferred_node_affinity=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key=wk.LABEL_TOPOLOGY_ZONE,
+                                    operator="In",
+                                    values=["no-such-zone"],
+                                )
+                            ]
+                        ),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        res = TPUScheduler([make_nodepool()], provider, kube_client=KubeClient()).solve(pods)
+        assert res.oracle_results is None
+        assert res.pods_scheduled == 3
+        # one pod per node — never stacked by the backfill
+        assert res.node_count == 3
+        assert all(len(p.pod_indices) == 1 for p in res.node_plans)
