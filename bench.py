@@ -399,10 +399,12 @@ def config3() -> dict:
     o_scheduled = sum(len(c.pods) for c in oracle.new_node_claims)
     if tpu_sub.pods_scheduled < o_scheduled:
         parity = 0.0  # scheduling fewer pods is a failure, not "fewer nodes"
-    else:
+    elif tpu_sub.node_count <= o_nodes:
         # one-sided: parity asks "not worse than the oracle"; the TPU
         # path's cross-group merge can legitimately pack FEWER nodes
-        parity = min(1.0, o_nodes / max(tpu_sub.node_count, 1))
+        parity = 1.0
+    else:
+        parity = o_nodes / tpu_sub.node_count
     return {
         "config": "3: 50k constrained pods x 2k types (TPU)",
         "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,

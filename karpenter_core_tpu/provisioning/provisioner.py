@@ -62,6 +62,7 @@ class Provisioner:
         self.metrics = metrics
         self._change_monitor = ChangeMonitor()
         self._parity_solve_count = 0
+        self._parity_inflight = False
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -231,8 +232,18 @@ class Provisioner:
         if self._parity_solve_count % self.PARITY_SAMPLE_EVERY:
             return
         # the shadow only sets a gauge — run it off the provisioning
-        # path so the O(P·N) oracle solve never delays NodeClaim creation
-        sub = pods[: self.PARITY_SUBSAMPLE]
+        # path so the O(P·N) oracle solve never delays NodeClaim
+        # creation. Single-flight: a slow oracle must not pile threads
+        # up behind the GIL.
+        if getattr(self, "_parity_inflight", False):
+            return
+        self._parity_inflight = True
+        import copy as _copy
+
+        # deep-copy the subsample: the oracle's preference relaxation
+        # mutates pods in place (scheduler.py relax), and these are the
+        # provisioner's LIVE objects, read concurrently by the main loop
+        sub = _copy.deepcopy(pods[: self.PARITY_SUBSAMPLE])
         threading.Thread(
             target=self._observe_parity, args=(sub, list(nodepools)), daemon=True
         ).start()
@@ -249,16 +260,26 @@ class Provisioner:
                 nodepools, self.cloud_provider, kube_client=self.kube_client
             ).solve(sub)
             o_scheduled = sum(len(c.pods) for c in o.new_node_claims)
+            o_nodes = len(o.new_node_claims)
             if t.pods_scheduled < o_scheduled:
                 # scheduling fewer pods must read as a parity failure,
                 # not as "fewer nodes = perfect"
                 parity = 0.0
+            elif t.node_count <= o_nodes:
+                # one-sided: as few or fewer nodes than the oracle (incl.
+                # both opening none) is full parity
+                parity = 1.0
             else:
-                # one-sided: fewer nodes than the oracle is no regression
-                parity = min(1.0, len(o.new_node_claims) / max(t.node_count, 1))
+                parity = o_nodes / t.node_count
             self.metrics.solver_parity.set(parity)
-        except Exception:  # the shadow must never break provisioning
-            pass
+        except Exception:
+            # the shadow must never break provisioning, but a broken
+            # shadow should not fail silently forever either
+            logging.getLogger("karpenter").debug(
+                "parity shadow solve failed", exc_info=True
+            )
+        finally:
+            self._parity_inflight = False
 
     # -- create (provisioner.go:141-153, 341-367) --------------------------
 

@@ -228,18 +228,26 @@ def _pad_class(p: int) -> int:
     return -(-p // 4096) * 4096
 
 
-def batch_pack(jobs: list, engine: str = "auto") -> list:
+def batch_pack(jobs: list, engine: str = "auto", mesh=None) -> list:
     """Run many (requests, frontier, max_per_node) packs.
 
     engine="auto" prefers the native C++ packer (an exact semantic twin
     of ffd_pack — the sequential pack tail is CPU work; see native/
     pack.cc) and falls back to few padded, vmapped device calls (one per
     size class). engine="device" forces the TPU scan; engine="native"
-    requires the C++ path. Each device job's padding pods exceed its own
-    frontier max so they emit -1 without touching state.
+    requires the C++ path. With a ``mesh`` (multi-chip: sharding.
+    active_mesh), device packing shards the group axis over the mesh
+    (SURVEY §5 groups-as-data-parallel mapping) — but the native packer
+    still wins in auto mode even multi-chip: the sequential FFD tail is
+    host-bound work and the device scan's K=16 eviction costs ~3% nodes
+    vs native K=1024 (the r4 parity gate's finding). Each device job's
+    padding pods exceed its own frontier max so they emit -1 without
+    touching state.
     Returns [(node_ids, node_count)] aligned with jobs."""
     if not jobs:
         return []
+    if mesh is not None and engine in ("device", "sharded"):
+        return _batch_pack_sharded(mesh, jobs)
     if engine in ("auto", "native"):
         from .. import native
 
@@ -255,6 +263,9 @@ def batch_pack(jobs: list, engine: str = "auto") -> list:
             ]
         if engine == "native":
             raise RuntimeError("native packer requested but unavailable")
+    if mesh is not None:
+        # no native packer in this deployment: shard the device scan
+        return _batch_pack_sharded(mesh, jobs)
     R = jobs[0][0].shape[1]
     F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
     classes: dict = {}
@@ -276,6 +287,43 @@ def batch_pack(jobs: list, engine: str = "auto") -> list:
             caps[slot] = cap
         node_ids, counts = ffd_pack_batched(
             jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
+        )
+        node_ids = np.asarray(node_ids)
+        counts = np.asarray(counts)
+        for slot, g in enumerate(members):
+            results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
+    return results
+
+
+def _batch_pack_sharded(mesh, jobs: list) -> list:
+    """Device pack with the group axis sharded over the mesh: pad each
+    size class's group count to a multiple of the mesh size (dummy
+    groups have zero frontiers, so every pod emits -1 and count stays
+    0), run sharding.sharded_batch_pack, slice the padding off."""
+    from .sharding import sharded_batch_pack
+
+    D = int(mesh.devices.size)
+    R = jobs[0][0].shape[1]
+    F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
+    classes: dict = {}
+    for g, job in enumerate(jobs):
+        classes.setdefault(_pad_class(job[0].shape[0]), []).append(g)
+
+    results: list = [None] * len(jobs)
+    for p_pad, members in classes.items():
+        G = -(-len(members) // D) * D
+        requests = np.ones((G, p_pad, R), dtype=np.int32)
+        frontiers = np.zeros((G, F_pad, R), dtype=np.int32)
+        caps = np.zeros(G, dtype=np.int32)
+        for slot, g in enumerate(members):
+            reqs, frontier, cap = jobs[g]
+            fmax = frontier.max(axis=0)
+            requests[slot, :, :] = fmax + 1  # sentinel: unschedulable padding
+            requests[slot, : reqs.shape[0]] = reqs
+            frontiers[slot, : len(frontier)] = frontier
+            caps[slot] = cap
+        node_ids, counts, _fleet = sharded_batch_pack(
+            mesh, jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
         )
         node_ids = np.asarray(node_ids)
         counts = np.asarray(counts)

@@ -14,8 +14,9 @@ over candidate-subset scores.
 
 from __future__ import annotations
 
+import os
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,29 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+_MESH: Optional[Mesh] = None
+
+
+def active_mesh(backend: str) -> Optional[Mesh]:
+    """The mesh the solve should shard over, or None for the single-
+    device path. KARPENTER_TPU_SHARDED: 'auto' (shard when the resolved
+    backend is a multi-chip TPU), 'on' (shard whenever >1 device — how
+    the CPU-mesh tests and dryrun drive the integrated path), 'off'."""
+    mode = os.environ.get("KARPENTER_TPU_SHARDED", "auto")
+    if mode == "off":
+        return None
+    try:
+        n = len(jax.devices())
+    except Exception:
+        return None
+    if n < 2 or (mode == "auto" and backend != "tpu"):
+        return None
+    global _MESH
+    if _MESH is None or _MESH.devices.size != n:
+        _MESH = make_mesh()
+    return _MESH
 
 
 def sharded_batch_pack(
@@ -115,6 +139,66 @@ def sharded_prefix_screen(
     return jax.jit(shard(per_device))(
         candidate_loads, candidate_free, fleet_free_local, new_node_cap
     )
+
+
+def prepare_sharded_catalog(
+    mesh: Mesh,
+    type_masks: Dict[str, np.ndarray],
+    type_has: Dict[str, np.ndarray],
+    type_neg: Dict[str, np.ndarray],
+    avail: np.ndarray,
+) -> tuple:
+    """Device-put the catalog side of the compat kernel sharded over the
+    mesh's type axis, padded to a multiple of the mesh size. Callers
+    cache the result per catalog generation (solver._entry_sharded) so
+    the full-catalog transfer happens once, not per solve — the pinned-
+    buffer design _entry_device_packed already uses for pallas. Padded
+    type rows have no available offering, so they read as disallowed."""
+    axis = mesh.axis_names[0]
+    D = int(mesh.devices.size)
+    T = avail.shape[0]
+    Tp = -(-T // D) * D
+
+    def pad_t(a: np.ndarray) -> np.ndarray:
+        a = np.asarray(a)
+        if a.shape[0] == Tp:
+            return a
+        pad = np.zeros((Tp - a.shape[0],) + a.shape[1:], dtype=a.dtype)
+        return np.concatenate([a, pad], axis=0)
+
+    sh = NamedSharding(mesh, P(axis))
+    tm = {k: jax.device_put(pad_t(v), sh) for k, v in type_masks.items()}
+    th = {k: jax.device_put(pad_t(v), sh) for k, v in type_has.items()}
+    tn = {k: jax.device_put(pad_t(v), sh) for k, v in type_neg.items()}
+    av = jax.device_put(pad_t(avail), sh)
+    return tm, th, tn, av, T
+
+
+def allowed_sharded(
+    prepared: tuple,
+    sig_arrays: Dict[str, np.ndarray],
+    zone_ok: np.ndarray,
+    ct_ok: np.ndarray,
+    keys: Tuple[str, ...],
+):
+    """Type-axis-sharded fused compat ∧ offering against a prepared
+    (cached, device-resident) catalog: signatures replicate, GSPMD
+    propagates the shardings through kernels.allowed_kernel, and the
+    (S, T) result's columns come back from an all-gather XLA inserts."""
+    from .kernels import allowed_kernel
+
+    tm, th, tn, av, T = prepared
+    out = allowed_kernel(
+        {k: jnp.asarray(v) for k, v in sig_arrays.items()},
+        tm,
+        th,
+        tn,
+        jnp.asarray(zone_ok),
+        jnp.asarray(ct_ok),
+        av,
+        keys,
+    )
+    return out[:, :T]
 
 
 def sharded_compat(

@@ -80,6 +80,9 @@ class _CatalogEntry:
     # keyed by a vocab-width snapshot so vocab growth triggers repack:
     # (snapshot, (keys, tp, th, tn, offsets, widths, avail_dev))
     device_packed: Optional[tuple] = None
+    # mesh-sharded catalog tensors for the multi-chip compat path,
+    # keyed by (vocab snapshot, mesh size): (key, prepared)
+    sharded_packed: Optional[tuple] = None
 
 
 _CATALOG_CACHE: Dict[tuple, _CatalogEntry] = {}
@@ -211,6 +214,26 @@ def _entry_device_packed(entry: _CatalogEntry):
     return data
 
 
+def _entry_sharded(entry: _CatalogEntry, mesh) -> tuple:
+    """Mesh-sharded, device-resident catalog tensors for `entry` —
+    re-transferred only when the vocab grew or the mesh changed (the
+    same pinned-buffer pattern as _entry_device_packed)."""
+    from .sharding import prepare_sharded_catalog
+
+    enc = entry.enc
+    key = (
+        tuple((k, entry.vocab.key_vocab(k).size) for k in sorted(enc.key_masks.keys())),
+        int(mesh.devices.size),
+    )
+    if entry.sharded_packed is not None and entry.sharded_packed[0] == key:
+        return entry.sharded_packed[1]
+    prepared = prepare_sharded_catalog(
+        mesh, enc.key_masks, enc.key_has, enc.key_neg, enc.offering_avail
+    )
+    entry.sharded_packed = (key, prepared)
+    return prepared
+
+
 def existing_node_compat(groups: List["SignatureGroup"], nodes: list) -> np.ndarray:
     """(S, M) uint8 admissibility of each signature group on each
     existing node: taints tolerated + node labels satisfy the group's
@@ -277,6 +300,9 @@ class NodePlan:
     # onto the NodeClaim so the launched node carries every label the
     # member pods select on (nodeclaimtemplate.go:55)
     requirements: Optional[object] = None
+    # per-node pod cap carried from the packed group (hostname spread /
+    # self-anti-affinity); backfill must not append to capped plans
+    max_pods_per_node: int = 2**31 - 1
     # this plan's pods' exact request dicts (nanos) — merged lazily off
     # the solve's critical path (only read at NodeClaim-creation time)
     _pod_requests: Optional[list] = field(default=None, repr=False)
@@ -633,6 +659,11 @@ class TPUScheduler:
                     np_ = pools_by_name.get(plan.nodepool_name)
                     if np_ is None or plan.requirements is None:
                         continue
+                    if plan.max_pods_per_node < 2**31 - 1:
+                        # capped plans (hostname spread / anti-affinity
+                        # groups) never take foreign pods: the cap models
+                        # a constraint the backfilled pod may violate
+                        continue
                     if Taints(np_.spec.template.taints).tolerates(g.exemplar):
                         continue
                     # the launched node carries the plan's merged labels
@@ -912,6 +943,12 @@ class TPUScheduler:
         from .backend import default_backend
 
         backend = default_backend()
+        # multi-chip: shard the compat type-axis and the pack group-axis
+        # over the mesh (SURVEY §5); None on single-device — behavior
+        # there is untouched
+        from .sharding import active_mesh
+
+        mesh = active_mesh(backend)
         # catalog tensors come from the cross-solve cache (encode once per
         # catalog generation, extend masks as pod batches grow the vocab);
         # the lock covers every in-place mutation of shared cache entries
@@ -938,7 +975,16 @@ class TPUScheduler:
                 keys = tuple(sorted(enc.key_masks.keys()))
                 zone_ok, ct_ok = zone_ct_masks(compats, enc)
                 S_, T_ = len(compats), len(enc.instance_types)
-                if (
+                if mesh is not None:
+                    # multi-chip: cached catalog T-shards live on the
+                    # mesh, signatures replicate, XLA all-gathers the
+                    # result
+                    from .sharding import allowed_sharded
+
+                    fut = allowed_sharded(
+                        _entry_sharded(e, mesh), sig_arrays, zone_ok, ct_ok, keys
+                    )
+                elif (
                     backend == "tpu"
                     and S_ * T_ < COMPAT_MIN_DEVICE_WORK
                     and S_ < _PALLAS_MIN_S
@@ -1106,7 +1152,7 @@ class TPUScheduler:
                 jobs,
                 metas,
             )
-            packed = batch_pack(jobs)
+            packed = batch_pack(jobs, mesh=mesh)
             records: List[dict] = []
             # small plans: every (uncapped) node joins the merge pass — the
             # oracle also back-fills leftover space on full nodes. Large
@@ -1763,6 +1809,7 @@ class TPUScheduler:
                     price=offering_price,
                     pod_indices=members,
                     requirements=meta["merged"],
+                    max_pods_per_node=int(meta["max_per_node"]),
                     _pod_requests=[self._all_requests[i] for i in members],
                 )
             )

@@ -103,3 +103,79 @@ def test_single_screen_matches_bruteforce():
         others = free.sum(axis=0) - free[i]
         expect = bool(np.all(loads[i] <= fleet + others + cap))
         assert bool(got[i]) == expect
+
+
+class TestIntegratedShardedSolve:
+    """VERDICT r3 #6: the FULL TPUScheduler.solve() runs sharded when a
+    mesh is active — not just the kernels."""
+
+    def _pods(self, n=48):
+        from helpers import make_pod
+
+        return [
+            make_pod(requests={"cpu": ["250m", "500m", "1"][i % 3], "memory": "512Mi"})
+            for i in range(n)
+        ]
+
+    def _solve(self, pods):
+        from helpers import make_nodepool
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(20)
+        return TPUScheduler([make_nodepool()], provider).solve(pods)
+
+    def test_full_solve_runs_sharded_and_matches_single_device(self, monkeypatch):
+        import karpenter_core_tpu.solver.sharding as sharding_mod
+
+        pods = self._pods()
+        base = self._solve(pods)  # mesh off (auto + cpu backend)
+
+        calls = {"compat": 0}
+        orig_allowed = sharding_mod.allowed_sharded
+
+        def spy_allowed(*a, **k):
+            calls["compat"] += 1
+            return orig_allowed(*a, **k)
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARDED", "on")
+        monkeypatch.setattr(sharding_mod, "allowed_sharded", spy_allowed)
+        # solver imports allowed_sharded lazily from the module, so the
+        # spy is what it resolves
+        sharded = self._solve(pods)
+
+        assert calls["compat"] >= 1, "compat did not run through the mesh"
+        assert sharded.pods_scheduled == base.pods_scheduled == len(pods)
+        assert sharded.node_count == base.node_count
+        assert sorted(len(p.pod_indices) for p in sharded.node_plans) == sorted(
+            len(p.pod_indices) for p in base.node_plans
+        )
+        assert sharded.total_price == pytest.approx(base.total_price)
+
+    def test_full_solve_pack_shards_without_native(self, monkeypatch):
+        """With no native packer, the group-axis pack itself runs over
+        the mesh (auto mode keeps native when available: the sequential
+        FFD tail is host-bound and native K=1024 packs tighter)."""
+        import karpenter_core_tpu.native as native_mod
+        import karpenter_core_tpu.solver.sharding as sharding_mod
+
+        calls = {"pack": 0}
+        orig_pack = sharding_mod.sharded_batch_pack
+
+        def spy_pack(*a, **k):
+            calls["pack"] += 1
+            return orig_pack(*a, **k)
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARDED", "on")
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        monkeypatch.setattr(sharding_mod, "sharded_batch_pack", spy_pack)
+        pods = self._pods()
+        res = self._solve(pods)
+        assert calls["pack"] >= 1, "pack did not run through the mesh"
+        assert res.pods_scheduled == len(pods)
+
+    def test_mesh_off_is_default_on_cpu(self):
+        from karpenter_core_tpu.solver.sharding import active_mesh
+
+        assert active_mesh("cpu") is None  # auto mode, non-TPU backend
