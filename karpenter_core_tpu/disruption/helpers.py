@@ -88,11 +88,23 @@ def get_candidates(
             instance_type_map[name] = {it.name: it for it in cloud_provider.get_instance_types(np_)}
         except Exception:
             continue
+    pods_by_node: Dict[str, list] = {}
+    for p in kube_client.list("Pod"):
+        if p.spec.node_name and podutils.is_active(p):
+            pods_by_node.setdefault(p.spec.node_name, []).append(p)
     candidates = []
     for node in cluster.deep_copy_nodes():
         try:
             cn = new_candidate(
-                kube_client, recorder, clock, node, nodepool_map, instance_type_map, queue
+                kube_client,
+                recorder,
+                clock,
+                node,
+                nodepool_map,
+                instance_type_map,
+                queue,
+                pods_by_node=pods_by_node,
+                node_owned=True,  # deep_copy_nodes returned fresh copies
             )
         except CandidateError:
             continue

@@ -8,17 +8,118 @@ unmanaged nodes. All scheduling reads go through this merged view.
 
 from __future__ import annotations
 
-import copy
 from typing import Dict, List, Optional
 
 from ..apis import labels as wk
-from ..apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
-from ..kube.objects import EFFECT_NO_SCHEDULE, Node, Pod, ResourceList, Taint
+from ..apis.nodeclaim import (
+    COND_INITIALIZED,
+    COND_REGISTERED,
+    NodeClaim,
+    NodeClaimSpec,
+    NodeClaimStatus,
+)
+from ..kube.objects import (
+    EFFECT_NO_SCHEDULE,
+    Condition,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    ResourceList,
+    Taint,
+)
 from ..scheduling import HostPortUsage, VolumeUsage, resources
 from ..scheduling.taints import KNOWN_EPHEMERAL_TAINTS
 from ..utils import pod as podutils
 
 DISRUPTION_TAINT = podutils.DISRUPTION_NO_SCHEDULE_TAINT
+
+
+# ---------------------------------------------------------------------------
+# structural clones for deep_copy
+#
+# copy.deepcopy over the full Node/NodeClaim graphs dominated the
+# consolidation profile (~70% of the 5k-candidate screen's wall time —
+# every candidate/simulation pass copies the fleet). These hand-rolled
+# clones copy exactly the containers the controllers mutate in place
+# (metadata label/annotation/finalizer containers, taint LISTS,
+# Condition objects — set_condition rewrites fields on the existing
+# object — and capacity/allocatable dicts) and share everything treated
+# as immutable after creation (Taint values, NodeSelectorRequirements,
+# spec resources/kubelet refs, string/number leaves).
+
+
+def _clone_meta(md):
+    return ObjectMeta(
+        name=md.name,
+        namespace=md.namespace,
+        uid=md.uid,
+        labels=dict(md.labels),
+        annotations=dict(md.annotations),
+        finalizers=list(md.finalizers),
+        owner_references=list(md.owner_references),
+        creation_timestamp=md.creation_timestamp,
+        deletion_timestamp=md.deletion_timestamp,
+        resource_version=md.resource_version,
+        generation=md.generation,
+    )
+
+
+def _clone_conditions(conds):
+    return [
+        Condition(
+            type=c.type,
+            status=c.status,
+            reason=c.reason,
+            message=c.message,
+            last_transition_time=c.last_transition_time,
+        )
+        for c in conds
+    ]
+
+
+def _clone_node(n: Optional[Node]) -> Optional[Node]:
+    if n is None:
+        return None
+    return Node(
+        metadata=_clone_meta(n.metadata),
+        spec=NodeSpec(
+            provider_id=n.spec.provider_id,
+            taints=list(n.spec.taints),
+            unschedulable=n.spec.unschedulable,
+        ),
+        status=NodeStatus(
+            capacity=dict(n.status.capacity),
+            allocatable=dict(n.status.allocatable),
+            conditions=_clone_conditions(n.status.conditions),
+            phase=n.status.phase,
+        ),
+    )
+
+
+def _clone_node_claim(c: Optional[NodeClaim]) -> Optional[NodeClaim]:
+    if c is None:
+        return None
+    return NodeClaim(
+        metadata=_clone_meta(c.metadata),
+        spec=NodeClaimSpec(
+            taints=list(c.spec.taints),
+            startup_taints=list(c.spec.startup_taints),
+            requirements=list(c.spec.requirements),
+            resources=c.spec.resources,
+            kubelet=c.spec.kubelet,
+            node_class_ref=c.spec.node_class_ref,
+        ),
+        status=NodeClaimStatus(
+            node_name=c.status.node_name,
+            provider_id=c.status.provider_id,
+            image_id=c.status.image_id,
+            capacity=dict(c.status.capacity),
+            allocatable=dict(c.status.allocatable),
+            conditions=_clone_conditions(c.status.conditions),
+        ),
+    )
 
 
 class StateNode:
@@ -176,7 +277,7 @@ class StateNode:
         self.volume_usage.delete_pod(namespace, name)
 
     def deep_copy(self) -> "StateNode":
-        out = StateNode(copy.deepcopy(self.node), copy.deepcopy(self.node_claim))
+        out = StateNode(_clone_node(self.node), _clone_node_claim(self.node_claim))
         out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
         out.pod_limits = {k: dict(v) for k, v in self.pod_limits.items()}
         out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}

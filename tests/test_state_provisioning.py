@@ -169,3 +169,47 @@ class TestProvisioner:
         claims = kube.list("NodeClaim")
         assert len(claims) >= 1
         assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "default"
+
+
+class TestStateNodeDeepCopyIsolation:
+    """deep_copy switched from copy.deepcopy to structural clones (the
+    consolidation profile's dominant cost); the mutable surfaces the
+    controllers actually touch must stay isolated."""
+
+    def _state_node(self):
+        from helpers import make_node
+        from karpenter_core_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_core_tpu.kube.objects import Taint
+        from karpenter_core_tpu.state.statenode import StateNode
+
+        node = make_node(labels={"a": "1"}, capacity={"cpu": "4"})
+        claim = NodeClaim()
+        claim.metadata.name = "nc-1"
+        claim.set_condition("Registered", "True")
+        return StateNode(node=node, node_claim=claim)
+
+    def test_mutations_do_not_leak_between_copies(self):
+        from karpenter_core_tpu.kube.objects import Taint
+
+        sn = self._state_node()
+        cp = sn.deep_copy()
+        # label/annotation containers
+        cp.node.metadata.labels["a"] = "2"
+        assert sn.node.metadata.labels["a"] == "1"
+        # taint lists
+        cp.node.spec.taints.append(Taint(key="k", effect="NoSchedule"))
+        assert not sn.node.spec.taints
+        # in-place condition rewrite (set_condition mutates the object)
+        cp.node_claim.set_condition("Registered", "False", reason="test")
+        assert sn.node_claim.status_condition_is_true("Registered")
+        # capacity dicts
+        cp.node.status.capacity["cpu"] = 0
+        assert sn.node.status.capacity["cpu"] != 0
+        # finalizers
+        cp.node_claim.metadata.finalizers.append("f")
+        assert not sn.node_claim.metadata.finalizers
+        # pod bookkeeping dicts
+        from helpers import make_pod
+
+        cp.update_for_pod(make_pod(requests={"cpu": "1"}))
+        assert not sn.pod_requests
