@@ -433,26 +433,78 @@ class SignatureGroup:
     exemplar: Pod
     pod_indices: List[int] = field(default_factory=list)  # into the batch array
 
+    def _is_self_term(self, term) -> bool:
+        """The term's selector matches the exemplar's own labels in its
+        own namespace — the per-deployment co-location/isolation pattern
+        that tensorizes (cross-selecting terms anchor to OTHER pods and
+        need the oracle's global view)."""
+        sel = term.label_selector
+        if sel is None or not sel.matches(self.exemplar.metadata.labels):
+            return False
+        if term.namespace_selector is not None:
+            return False
+        ns = list(term.namespaces)
+        return not ns or ns == [self.exemplar.namespace]
+
+    def self_pod_affinity(self) -> Optional[str]:
+        """Topology key of a single self-selecting REQUIRED pod-affinity
+        term on zone/hostname (co-locate a deployment with itself), when
+        that is the group's only affinity shape — else None."""
+        a = self.exemplar.spec.affinity
+        if a is None or a.pod_affinity is None:
+            return None
+        if a.pod_anti_affinity is not None:
+            return None  # affinity+anti interactions stay on the oracle
+        if self.exemplar.spec.topology_spread_constraints:
+            return None  # affinity+spread interactions stay on the oracle
+        if a.pod_affinity.preferred or len(a.pod_affinity.required) != 1:
+            return None
+        term = a.pod_affinity.required[0]
+        if term.topology_key not in (wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME):
+            return None
+        if not self._is_self_term(term):
+            return None
+        return term.topology_key
+
+    @property
+    def zone_anti_isolated(self) -> bool:
+        """Required self-anti-affinity on zone → at most one pod of the
+        group per zone."""
+        a = self.exemplar.spec.affinity
+        if a is None or a.pod_anti_affinity is None:
+            return False
+        for term in a.pod_anti_affinity.required:
+            if term.topology_key == wk.LABEL_TOPOLOGY_ZONE and self._is_self_term(term):
+                return True
+        return False
+
     @property
     def has_relational(self) -> bool:
         """Pod affinity/anti-affinity needs the oracle (SURVEY §7 hard
-        parts) — except self-anti-affinity on hostname, which tensorizes
-        as pods-per-node=1."""
+        parts) — except the self-selecting shapes that tensorize:
+        anti-affinity on hostname (pods-per-node=1) or zone
+        (pods-per-zone=1), and single-term required affinity on
+        zone/hostname (anchor the whole group into one domain)."""
         a = self.exemplar.spec.affinity
         if a is None:
             return False
         if a.pod_affinity is not None and (a.pod_affinity.required or a.pod_affinity.preferred):
-            return True
+            if self.self_pod_affinity() is None:
+                return True
         if a.pod_anti_affinity is not None:
             req = a.pod_anti_affinity.required
             if a.pod_anti_affinity.preferred:
                 return True
             for term in req:
-                if term.topology_key != wk.LABEL_HOSTNAME:
-                    return True
-                sel = term.label_selector
-                if sel is None or not sel.matches(self.exemplar.metadata.labels):
-                    return True  # anti-affinity against other pods — relational
+                if term.topology_key == wk.LABEL_HOSTNAME and self._is_self_term(term):
+                    continue  # tensorizes as pods-per-node=1
+                if (
+                    term.topology_key == wk.LABEL_TOPOLOGY_ZONE
+                    and self._is_self_term(term)
+                    and not self.exemplar.spec.topology_spread_constraints
+                ):
+                    continue  # tensorizes as pods-per-zone=1 (no spread mix)
+                return True  # anti-affinity against other pods — relational
         return False
 
     @property
@@ -476,10 +528,10 @@ class SignatureGroup:
         a = self.exemplar.spec.affinity
         if a is None or a.pod_anti_affinity is None:
             return False
-        for term in a.pod_anti_affinity.required:
-            if term.topology_key == wk.LABEL_HOSTNAME and term.label_selector is not None and term.label_selector.matches(self.exemplar.metadata.labels):
-                return True
-        return False
+        return any(
+            term.topology_key == wk.LABEL_HOSTNAME and self._is_self_term(term)
+            for term in a.pod_anti_affinity.required
+        )
 
     def zone_spread(self):
         """The zone topology-spread constraint, if any."""

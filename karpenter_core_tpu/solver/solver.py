@@ -476,6 +476,19 @@ class TPUScheduler:
                 for c in g.exemplar.spec.topology_spread_constraints
                 if c.label_selector is not None
             ]
+            # self-affinity/anti-affinity selectors too: "self" means the
+            # selector matches the group's own labels, but a broader
+            # selector that ALSO matches another group needs the
+            # oracle's global counting
+            a = g.exemplar.spec.affinity
+            if a is not None and (g.self_pod_affinity() or g.zone_anti_isolated):
+                for pa in (a.pod_affinity, a.pod_anti_affinity):
+                    if pa is not None:
+                        sels.extend(
+                            t.label_selector
+                            for t in pa.required
+                            if t.label_selector is not None
+                        )
             if sels and any(
                 sel.matches(h.exemplar.metadata.labels)
                 for h in groups
@@ -494,27 +507,46 @@ class TPUScheduler:
             tensor_groups = exclude(tensor_groups, spreadish)
             oracle_groups = oracle_groups + spreadish
         # plain groups whose labels match an oracle-routed group's spread
-        # selector must schedule in the same (oracle) world, or the
-        # topology skew counts would miss their placements. Fixpoint: a
-        # pulled group's own spread selectors can pull further groups.
-        frontier = list(oracle_groups)
-        while frontier and tensor_groups:
-            spread_sels = [
+        # OR affinity selectors must schedule in the same (oracle) world,
+        # or the oracle's topology/anchor counts would miss their
+        # placements. Fixpoint: a pulled group's own selectors can pull
+        # further groups.
+        def counting_selectors(g: SignatureGroup) -> list:
+            sels = [
                 c.label_selector
-                for g in frontier
                 for c in g.exemplar.spec.topology_spread_constraints
                 if c.label_selector is not None
             ]
-            if not spread_sels:
+            a = g.exemplar.spec.affinity
+            if a is not None:
+                for pa in (a.pod_affinity, a.pod_anti_affinity):
+                    if pa is None:
+                        continue
+                    sels.extend(
+                        t.label_selector
+                        for t in pa.required
+                        if t.label_selector is not None
+                    )
+                    sels.extend(
+                        w.pod_affinity_term.label_selector
+                        for w in pa.preferred
+                        if w.pod_affinity_term.label_selector is not None
+                    )
+            return sels
+
+        frontier = list(oracle_groups)
+        while frontier and tensor_groups:
+            frontier_sels = [s for g in frontier for s in counting_selectors(g)]
+            if not frontier_sels:
                 break
-            pulled_spread = [
+            pulled_more = [
                 g
                 for g in tensor_groups
-                if any(s.matches(g.exemplar.metadata.labels) for s in spread_sels)
+                if any(s.matches(g.exemplar.metadata.labels) for s in frontier_sels)
             ]
-            tensor_groups = exclude(tensor_groups, pulled_spread)
-            oracle_groups = oracle_groups + pulled_spread
-            frontier = pulled_spread
+            tensor_groups = exclude(tensor_groups, pulled_more)
+            oracle_groups = oracle_groups + pulled_more
+            frontier = pulled_more
         oracle_pods: List[Pod] = [
             pods[i] for g in oracle_groups for i in g.pod_indices
         ]
@@ -644,11 +676,12 @@ class TPUScheduler:
                 g.zone_spread() is not None
                 or g.hostname_spread() is not None
                 or g.hostname_isolated
+                or g.self_pod_affinity() is not None
+                or g.zone_anti_isolated
             ):
-                # zone-spread pods must go through the seeded quota path
-                # so domain counts stay exact; hostname topologies cap
-                # pods-per-node (max_per_node) which a plain backfill
-                # append would violate
+                # topology/affinity-constrained pods must go through
+                # their seeded domain-assignment paths; a plain backfill
+                # append ignores domain counts and per-node caps
                 remaining.append(g)
                 continue
             pod_reqs = _pod_reqs(g.exemplar)
@@ -840,9 +873,16 @@ class TPUScheduler:
             compat_rows={},
         )
 
-        # zone-spread groups are zone-assigned before touching existing
+        # topology-constrained groups (zone spread, self-affinity, zone
+        # anti-affinity) are domain-assigned before touching existing
         # capacity — exclude them from this selector-blind pack
-        pack = [(gi, g) for gi, g in enumerate(groups) if g.zone_spread() is None]
+        pack = [
+            (gi, g)
+            for gi, g in enumerate(groups)
+            if g.zone_spread() is None
+            and g.self_pod_affinity() is None
+            and not g.zone_anti_isolated
+        ]
         if not pack:
             return
         sub_groups = [g for _, g in pack]
@@ -1371,7 +1411,12 @@ class TPUScheduler:
         # groups stay solo (their cap is enforced per job).
         classes: Dict[tuple, List[dict]] = {}
         for info in infos:
-            if int(info["max_per_node"]) < 2**31 - 1:
+            g_ = info["group"]
+            if (
+                int(info["max_per_node"]) < 2**31 - 1
+                or g_.self_pod_affinity() is not None
+                or g_.zone_anti_isolated
+            ):
                 key = ("solo", id(info["group"]))
             else:
                 key = (
@@ -1402,6 +1447,17 @@ class TPUScheduler:
                 # descending by primary then memory (queue.go:76 ordering)
                 order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
                 return idx[order], reqs[order]
+
+            g0 = members[0]["group"]
+            if len(members) == 1 and (
+                g0.self_pod_affinity() is not None or g0.zone_anti_isolated
+            ):
+                idx0, reqs0 = sorted_idx(members[0]["indices"])
+                self._affinity_assign(
+                    members[0], idx0, reqs0, enc, pool, daemon, pods, result,
+                    jobs, metas,
+                )
+                continue
 
             if not spread:
                 idx, reqs = sorted_idx([i for m in members for i in m["indices"]])
@@ -1502,6 +1558,41 @@ class TPUScheduler:
             self._seed_cache[key] = seeds
         return seeds
 
+    def _fold_committed(
+        self,
+        seeds: Dict[str, int],
+        selector,
+        namespace: str,
+        pods: List[Pod],
+        result: SolverResult,
+    ) -> Dict[str, int]:
+        """Per-zone counts of THIS solve's committed placements matching
+        a selector, folded into the seeds — later passes (limit-spill
+        rounds, relaxation retries) must see them: the oracle records
+        every landing immediately (topology.go:125). Free when no plans
+        exist yet (the common single-pass solve)."""
+        if not (result.node_plans or result.existing_plans):
+            return seeds
+        seeds = dict(seeds)
+
+        def _matches(i: int) -> bool:
+            p = pods[i]
+            return p.namespace == namespace and (
+                selector is None or selector.matches(p.metadata.labels)
+            )
+
+        for plan in result.node_plans:
+            n = sum(1 for i in plan.pod_indices if _matches(i))
+            if n:
+                seeds[plan.zone] = seeds.get(plan.zone, 0) + n
+        for eplan in result.existing_plans:
+            z = eplan.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE)
+            if z:
+                n = sum(1 for i in eplan.pod_indices if _matches(i))
+                if n:
+                    seeds[z] = seeds.get(z, 0) + n
+        return seeds
+
     @staticmethod
     def _existing_compat_row(group: SignatureGroup, ctx: dict) -> np.ndarray:
         row = ctx["compat_rows"].get(id(group))
@@ -1531,32 +1622,13 @@ class TPUScheduler:
         P = len(g_idx)
         if P == 0:
             return
-        seeds = self._spread_seeds(group, c)
-        # later passes (limit-spill rounds, relaxation retries) must see
-        # this solve's own committed placements in the counts — the
-        # oracle records every landing immediately (topology.go:125);
-        # free when no plans exist yet (the common single-pass solve)
-        if result.node_plans or result.existing_plans:
-            seeds = dict(seeds)
-            sel = c.label_selector
-            ns = group.exemplar.namespace
-
-            def _matches(i: int) -> bool:
-                p = pods[i]
-                return p.namespace == ns and (
-                    sel is None or sel.matches(p.metadata.labels)
-                )
-
-            for plan in result.node_plans:
-                n = sum(1 for i in plan.pod_indices if _matches(i))
-                if n:
-                    seeds[plan.zone] = seeds.get(plan.zone, 0) + n
-            for eplan in result.existing_plans:
-                z = eplan.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE)
-                if z:
-                    n = sum(1 for i in eplan.pod_indices if _matches(i))
-                    if n:
-                        seeds[z] = seeds.get(z, 0) + n
+        seeds = self._fold_committed(
+            self._spread_seeds(group, c),
+            c.label_selector,
+            group.exemplar.namespace,
+            pods,
+            result,
+        )
         ctx = self._existing_ctx
         merged = m["merged"]
         zone_req = (
@@ -1624,6 +1696,322 @@ class TPUScheduler:
                 + sum(int(p.size) for p in buckets[z]),
             )
             buckets[tgt].append(spill)
+
+    def _affinity_assign(
+        self,
+        m: dict,
+        idx: np.ndarray,  # group's pod indices, descending by size
+        reqs: np.ndarray,
+        enc: EncodedInstanceTypes,
+        pool: PoolEncoding,
+        daemon: np.ndarray,
+        pods: List[Pod],
+        result: SolverResult,
+        jobs: List[tuple],
+        metas: List[dict],
+    ) -> None:
+        """Tensor-path self pod-affinity / zone anti-affinity (the
+        per-deployment co-location/isolation shapes; cross-selecting
+        terms route to the oracle in _solve). Mirrors the oracle's
+        nextDomainAffinity / nextDomainAntiAffinity
+        (topologygroup.go:215-257):
+
+        - affinity on zone: pods may go to any domain that already holds
+          a matching pod (anchors = seeded counts + this solve's
+          placements); with no anchors, bootstrap exactly ONE zone.
+        - affinity on hostname: pods join the anchor nodes' free space;
+          with no anchors, they co-locate onto ONE new node (the
+          largest size-descending prefix some viable type holds —
+          exactly where the oracle stops placing, since a second claim
+          would be a zero-count domain) and the rest fail.
+        - anti-affinity on zone: at most one pod per zone; zones with a
+          matching pod are full, extras fail.
+        """
+        from ..kube.objects import PodAffinityTerm
+        from .topology_tensor import seed_counts_for_selector, water_fill
+
+        group: SignatureGroup = m["group"]
+        zone_ok, ct_ok = m["zone_ok"], m["ct_ok"]
+        viable = m["viable"]
+        P = len(idx)
+        ctx = self._existing_ctx
+        zones = [z for zi, z in enumerate(enc.zones) if zone_ok[zi]]
+        zone_types = {
+            z: viable
+            & enc.offering_avail[:, enc.zones.index(z), :][:, ct_ok].any(axis=1)
+            for z in zones
+        }
+        zones = [z for z in zones if zone_types[z].any()]
+
+        akey = group.self_pod_affinity()
+        a = group.exemplar.spec.affinity
+        if akey is not None:
+            term: PodAffinityTerm = a.pod_affinity.required[0]
+            seeds = seed_counts_for_selector(
+                self.kube_client,
+                group.exemplar,
+                akey,
+                term.label_selector,
+                self._batch_uids,
+            )
+            if akey == wk.LABEL_TOPOLOGY_ZONE:
+                # retries/limit rounds see this solve's landings too
+                seeds = self._fold_committed(
+                    seeds, term.label_selector, group.exemplar.namespace,
+                    pods, result,
+                )
+                have_anchors = any(v > 0 for v in seeds.values())
+                anchors = [z for z in zones if seeds.get(z, 0) > 0]
+                if have_anchors and not anchors:
+                    # matching pods exist, but only in zones this pool
+                    # can't serve — bootstrapping a fresh zone would
+                    # strand the pods (their affinity pins them to the
+                    # anchor zones); fail like the oracle's
+                    # nextDomainAffinity restriction
+                    for i in idx:
+                        result.pod_errors[pods[i].uid] = (
+                            "pod affinity anchors are outside viable zones"
+                        )
+                    return
+                if anchors:
+                    # any anchor zone is admissible: fill anchor-zone
+                    # existing capacity first, then a job with the zone
+                    # mask narrowed to the anchors
+                    part = idx
+                    if ctx is not None:
+                        for z in anchors:
+                            if not part.size:
+                                break
+                            part = self._pack_spread_existing(
+                                part, z, group, ctx, result
+                            )
+                    if part.size:
+                        sub = np.isin(idx, part)
+                        zmask = zone_ok & np.array(
+                            [z in anchors for z in enc.zones], dtype=bool
+                        )
+                        v = viable & enc.offering_avail[:, zmask, :][:, :, ct_ok].any(
+                            axis=(1, 2)
+                        )
+                        self._prepare_job(
+                            idx[sub], reqs[sub], enc, v, zmask, ct_ok, daemon,
+                            m["max_per_node"], pool, pods, result, jobs, metas,
+                            merged=m["merged"],
+                        )
+                elif zones:
+                    # no matching pod anywhere: bootstrap exactly one
+                    # zone — the one whose cheapest viable offering is
+                    # lowest (the oracle picks an arbitrary viable
+                    # domain; cheapest is a strict refinement)
+                    def zone_price(z: str) -> float:
+                        zi = enc.zones.index(z)
+                        p = enc.offering_price[zone_types[z], zi, :][:, ct_ok]
+                        p = np.where(np.isfinite(p), p, np.inf)
+                        return float(p.min()) if p.size else np.inf
+
+                    z_star = min(zones, key=zone_price)
+                    part = idx
+                    if ctx is not None:
+                        part = self._pack_spread_existing(
+                            part, z_star, group, ctx, result
+                        )
+                    if part.size:
+                        sub = np.isin(idx, part)
+                        self._prepare_job(
+                            idx[sub], reqs[sub], enc, zone_types[z_star],
+                            zone_ok, ct_ok, daemon, m["max_per_node"], pool,
+                            pods, result, jobs, metas, zone=z_star,
+                            merged=m["merged"],
+                        )
+                else:
+                    for i in idx:
+                        result.pod_errors[pods[i].uid] = (
+                            "no zone with viable offering for pod affinity"
+                        )
+                return
+            # hostname affinity: anchors are specific nodes. A committed
+            # co-located plan from an earlier pass also anchors the
+            # domain — a retry must not bootstrap a second node.
+            ns = group.exemplar.namespace
+            committed_anchor = any(
+                any(
+                    pods[i].namespace == ns
+                    and (
+                        term.label_selector is None
+                        or term.label_selector.matches(pods[i].metadata.labels)
+                    )
+                    for i in plan.pod_indices
+                )
+                for plan in result.node_plans
+            )
+            if seeds or committed_anchor:
+                anchor_left = idx
+                if ctx is not None and seeds:
+                    anchor_left = self._pack_affinity_hostname_existing(
+                        idx, group, seeds, ctx, result
+                    )
+                # remaining pods cannot join: a fresh claim is a
+                # zero-count domain
+                for i in anchor_left:
+                    result.pod_errors[pods[i].uid] = (
+                        "pod affinity on hostname: anchor nodes are full"
+                    )
+                return
+            self._pack_affinity_hostname_new(
+                idx, reqs, enc, pool, daemon, m, pods, result
+            )
+            return
+
+        # zone anti-affinity: one pod per zone with no matching pod yet
+        term = next(
+            t
+            for t in a.pod_anti_affinity.required
+            if t.topology_key == wk.LABEL_TOPOLOGY_ZONE
+        )
+        seeds = self._fold_committed(
+            seed_counts_for_selector(
+                self.kube_client, group.exemplar, wk.LABEL_TOPOLOGY_ZONE,
+                term.label_selector, self._batch_uids,
+            ),
+            term.label_selector,
+            group.exemplar.namespace,
+            pods,
+            result,
+        )
+        counts = np.array(
+            [min(seeds.get(z, 0), 1) for z in zones], dtype=np.int64
+        )
+        quotas, unplaced = water_fill(counts, P, ceiling=1)
+        pos = 0
+        for zi, z in enumerate(zones):
+            if quotas[zi] <= 0:
+                continue
+            i = idx[pos : pos + 1]
+            r = reqs[pos : pos + 1]
+            pos += 1
+            part = i
+            if ctx is not None:
+                part = self._pack_spread_existing(part, z, group, ctx, result)
+            if part.size:
+                self._prepare_job(
+                    part, r, enc, zone_types[z], zone_ok, ct_ok, daemon,
+                    np.int32(1), pool, pods, result, jobs, metas, zone=z,
+                    merged=m["merged"],
+                )
+        for i in idx[pos:]:
+            result.pod_errors[pods[i].uid] = (
+                "pod anti-affinity on zone: no zone without a matching pod"
+            )
+
+    def _pack_affinity_hostname_existing(
+        self,
+        idx: np.ndarray,
+        group: SignatureGroup,
+        seeds: Dict[str, int],
+        ctx: dict,
+        result: SolverResult,
+    ) -> np.ndarray:
+        """First-fit the group onto existing nodes already holding a
+        matching pod (the only admissible domains once anchors exist)."""
+        row = self._existing_compat_row(group, ctx).astype(bool)
+        anchor = np.array(
+            [n.hostname() in seeds or n.name() in seeds for n in ctx["nodes"]]
+        )
+        mask = row & anchor
+        if not mask.any():
+            return idx
+        reqs = build_requests_matrix_ids(
+            self._req_ids[idx], ctx["axis"], self._req_map
+        )
+        assign, free_out = run_pack_existing(
+            reqs,
+            np.zeros(len(idx), dtype=np.int32),
+            mask[None, :].astype(np.uint8),
+            ctx["free"],
+        )
+        ctx["free"] = np.ascontiguousarray(free_out, dtype=np.int32)
+        placed = assign >= 0
+        by_node: Dict[int, List[int]] = {}
+        for j in np.flatnonzero(placed):
+            by_node.setdefault(int(assign[j]), []).append(int(idx[j]))
+        for mnode in sorted(by_node):
+            result.existing_plans.append(
+                ExistingNodePlan(
+                    state_node=ctx["nodes"][mnode], pod_indices=by_node[mnode]
+                )
+            )
+        return idx[~placed]
+
+    def _pack_affinity_hostname_new(
+        self,
+        idx: np.ndarray,
+        reqs: np.ndarray,
+        enc: EncodedInstanceTypes,
+        pool: PoolEncoding,
+        daemon: np.ndarray,
+        m: dict,
+        pods: List[Pod],
+        result: SolverResult,
+    ) -> None:
+        """Bootstrap ONE co-located node: the largest size-descending
+        prefix some viable type holds becomes a single NodePlan; the
+        rest fail (a second claim would be a zero-count hostname domain
+        the pods cannot join — oracle behavior)."""
+        viable_idx = np.flatnonzero(m["viable"])
+        if len(viable_idx) == 0:
+            for i in idx:
+                result.pod_errors[pods[i].uid] = "no viable instance type"
+            return
+        alloc = self._alloc_full(enc, daemon)[viable_idx]
+        cum = np.cumsum(reqs.astype(np.int64), axis=0)  # (P, R)
+        fits_any = (cum[:, None, :] <= alloc[None, :, :]).all(axis=-1).any(axis=1)
+        n_fit = int(fits_any.sum()) if fits_any.all() else int(np.argmin(fits_any))
+        if n_fit == 0:
+            for i in idx:
+                result.pod_errors[pods[i].uid] = (
+                    "no instance type fits the first co-located pod"
+                )
+            return
+        load = cum[n_fit - 1]
+        fits = (load[None, :] <= alloc).all(axis=1)
+        zone_ok, ct_ok = m["zone_ok"], m["ct_ok"]
+        prices = enc.offering_price[viable_idx][:, zone_ok, :][:, :, ct_ok].reshape(
+            len(viable_idx), -1
+        )
+        p = (
+            np.where(np.isfinite(prices), prices, np.inf).min(axis=1)
+            if prices.size
+            else np.full(len(viable_idx), np.inf)
+        )
+        p = np.where(fits, p, np.inf)
+        t_local = int(np.argmin(p))
+        if not np.isfinite(p[t_local]):
+            for i in idx:
+                result.pod_errors[pods[i].uid] = (
+                    "packed node has no fitting instance type"
+                )
+            return
+        t = int(viable_idx[t_local])
+        offering_zone, offering_ct, offering_price = self._cheapest_offering(
+            enc, t, zone_ok, ct_ok, None
+        )
+        members = idx[:n_fit].tolist()
+        result.node_plans.append(
+            NodePlan(
+                nodepool_name=pool.nodepool.name,
+                instance_type=enc.instance_types[t],
+                zone=offering_zone,
+                capacity_type=offering_ct,
+                price=offering_price,
+                pod_indices=members,
+                requirements=m["merged"],
+                _pod_requests=[self._all_requests[i] for i in members],
+            )
+        )
+        for i in idx[n_fit:]:
+            result.pod_errors[pods[i].uid] = (
+                "pod affinity on hostname: co-located node is full"
+            )
 
     def _pack_spread_existing(
         self,

@@ -128,6 +128,37 @@ def interleave_by_quota(sorted_idx: np.ndarray, quotas: np.ndarray) -> List[np.n
     return [prefix[assigned_zone == z] for z in range(Z)]
 
 
+def seed_counts_for_selector(
+    kube_client,
+    exemplar,
+    topology_key: str,
+    label_selector,
+    excluded_uids,
+) -> Dict[str, int]:
+    """Existing matching-pod counts per domain for a pod-affinity /
+    anti-affinity term (no node filter — affinity counts every node,
+    topologygroup.go:70-76 nil filter)."""
+    if kube_client is None:
+        return {}
+    from ..scheduler.topology import (
+        TOPOLOGY_TYPE_POD_AFFINITY,
+        TopologyGroup,
+        count_matching_pods_by_domain,
+    )
+
+    tg = TopologyGroup(
+        TOPOLOGY_TYPE_POD_AFFINITY,
+        topology_key,
+        None,
+        {exemplar.namespace},
+        label_selector,
+        0,
+        None,
+        set(),
+    )
+    return count_matching_pods_by_domain(kube_client, tg, excluded_uids)
+
+
 def seed_counts_for_constraint(
     kube_client,
     exemplar,

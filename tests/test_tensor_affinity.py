@@ -1,0 +1,216 @@
+"""Tensor-path self pod-affinity / zone anti-affinity (VERDICT r3
+missing #4: the last oracle-only relational feature). The tensorized
+shapes are the per-deployment patterns — a group co-locating with or
+isolating from ITSELF on zone/hostname; cross-selecting terms still
+route to the oracle (asserted here too)."""
+
+import numpy as np
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+from karpenter_core_tpu.solver import TPUScheduler
+from karpenter_core_tpu.state.statenode import StateNode
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def _provider(n=10):
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(n)
+    return provider
+
+
+def _aff_pod(app="co", key=wk.LABEL_TOPOLOGY_ZONE, anti=False, cpu="500m", sel=None):
+    term = PodAffinityTerm(
+        topology_key=key,
+        label_selector=LabelSelector(match_labels=sel or {"app": app}),
+    )
+    kw = {"pod_anti_affinity": [term]} if anti else {"pod_affinity": [term]}
+    return make_pod(labels={"app": app}, requests={"cpu": cpu, "memory": "512Mi"}, **kw)
+
+
+def _solve(pods, state_nodes=None, kube=None, provider=None):
+    return TPUScheduler(
+        [make_nodepool()], provider or _provider(), kube_client=kube or KubeClient()
+    ).solve(pods, state_nodes=state_nodes)
+
+
+def _oracle(pods, state_nodes=None, kube=None, provider=None):
+    return build_scheduler(
+        kube or KubeClient(), None, [make_nodepool()], provider or _provider(), pods,
+        state_nodes=state_nodes,
+    ).solve(pods)
+
+
+class TestSelfZoneAffinity:
+    def test_all_pods_one_zone_matches_oracle(self):
+        pods = [_aff_pod() for _ in range(9)]
+        t = _solve(pods)
+        o = _oracle(pods)
+        assert t.oracle_results is None  # tensor path handled it
+        assert t.pods_scheduled == sum(len(c.pods) for c in o.new_node_claims) == 9
+        zones = {p.zone for p in t.node_plans}
+        assert len(zones) == 1  # co-located into a single zone
+
+    def test_anchors_to_zone_with_existing_matching_pods(self):
+        kube = KubeClient()
+        nodes, sns = [], []
+        for z in ZONES:
+            node = make_node(
+                labels={
+                    wk.NODEPOOL_LABEL_KEY: "default",
+                    wk.NODE_REGISTERED_LABEL_KEY: "true",
+                    wk.NODE_INITIALIZED_LABEL_KEY: "true",
+                    wk.LABEL_TOPOLOGY_ZONE: z,
+                },
+                capacity={"cpu": "8", "memory": "32Gi", "pods": "100"},
+            )
+            kube.create(node)
+            nodes.append(node)
+            sns.append(StateNode(node=node))
+        # a matching pod already runs in zone-2
+        anchor = make_pod(
+            labels={"app": "co"},
+            node_name=nodes[1].name,
+            phase="Running",
+            pending_unschedulable=False,
+        )
+        kube.create(anchor)
+        pods = [_aff_pod(cpu="1") for _ in range(4)]
+        t = _solve(pods, state_nodes=sns, kube=kube)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 4
+        placed_zones = {p.zone for p in t.node_plans} | {
+            p.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE) for p in t.existing_plans
+        }
+        assert placed_zones == {"test-zone-2"}
+
+    def test_cross_selecting_affinity_routes_to_oracle(self):
+        pods = [_aff_pod(app="a", sel={"app": "b"})] + [
+            make_pod(labels={"app": "b"}) for _ in range(2)
+        ]
+        t = _solve(pods)
+        o = _oracle(pods)
+        assert t.oracle_results is not None  # global counting needed
+        # identical outcome to the pure oracle (including its ordering
+        # behavior for anchors that land later in the same batch)
+        assert t.pods_scheduled == sum(len(c.pods) for c in o.new_node_claims)
+        assert set(t.pod_errors) == set(o.pod_errors)
+
+
+class TestSelfHostnameAffinity:
+    def test_colocated_onto_one_node(self):
+        pods = [_aff_pod(key=wk.LABEL_HOSTNAME, cpu="250m") for _ in range(6)]
+        t = _solve(pods)
+        o = _oracle(pods)
+        assert t.oracle_results is None
+        assert t.node_count == len(o.new_node_claims) == 1
+        assert t.pods_scheduled == sum(len(c.pods) for c in o.new_node_claims) == 6
+
+    def test_overflow_fails_like_oracle(self):
+        # 6 pods x 4cpu cannot share any node in a 10-type catalog
+        # (largest ~10 cpu): both paths co-locate a prefix and fail the rest
+        pods = [_aff_pod(key=wk.LABEL_HOSTNAME, cpu="4") for _ in range(6)]
+        t = _solve(pods)
+        o = _oracle(pods)
+        o_sched = sum(len(c.pods) for c in o.new_node_claims)
+        assert t.oracle_results is None
+        assert len(o.new_node_claims) == 1
+        assert t.node_count == 1
+        assert t.pods_scheduled == o_sched
+        assert len(t.pod_errors) == 6 - o_sched > 0
+
+
+class TestSelfZoneAntiAffinity:
+    def test_one_pod_per_zone_beats_pessimistic_oracle(self):
+        """Deliberate divergence: the oracle (like the reference,
+        topology.go:131-139) records anti-affinity against EVERY zone a
+        zone-flexible claim could land in, so it schedules only 1 of 5.
+        Tensor plans pin their zone, so per-zone isolation is exact:
+        one pod in each of the 3 zones, 2 fail."""
+        pods = [_aff_pod(anti=True) for _ in range(5)]
+        t = _solve(pods)
+        o = _oracle(pods)
+        o_sched = sum(len(c.pods) for c in o.new_node_claims)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 3  # exactly one per zone
+        assert t.pods_scheduled >= o_sched  # never worse than the oracle
+        assert len(t.pod_errors) == 2
+        zones = [p.zone for p in t.node_plans] + [
+            p.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE) for p in t.existing_plans
+        ]
+        assert sorted(zones) == sorted(ZONES)
+
+    def test_zone_with_existing_matching_pod_is_excluded(self):
+        kube = KubeClient()
+        node = make_node(
+            labels={
+                wk.NODEPOOL_LABEL_KEY: "default",
+                wk.NODE_REGISTERED_LABEL_KEY: "true",
+                wk.NODE_INITIALIZED_LABEL_KEY: "true",
+                wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            capacity={"cpu": "8", "memory": "32Gi", "pods": "100"},
+        )
+        kube.create(node)
+        blocker = make_pod(
+            labels={"app": "co"},
+            node_name=node.name,
+            phase="Running",
+            pending_unschedulable=False,
+        )
+        kube.create(blocker)
+        pods = [_aff_pod(anti=True) for _ in range(3)]
+        t = _solve(pods, state_nodes=[StateNode(node=node)], kube=kube)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 2  # zone-1 is taken by the blocker
+        placed = {p.zone for p in t.node_plans}
+        assert placed == {"test-zone-2", "test-zone-3"}
+
+
+class TestAntiAffinityRetrySeesCommittedPlacements:
+    def test_relaxed_retry_cannot_double_occupy_a_zone(self):
+        """Round 1 pins the group to its preferred zone and places one
+        pod there; the relaxed retry must see that committed placement
+        in its zone counts, or it would put a second matching pod into
+        the same zone (required anti-affinity violation)."""
+        from karpenter_core_tpu.kube.objects import (
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+
+        def pod():
+            p = _aff_pod(anti=True)
+            p.spec.affinity.node_affinity = None  # set below
+            from karpenter_core_tpu.kube.objects import NodeAffinity
+
+            p.spec.affinity.node_affinity = NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key=wk.LABEL_TOPOLOGY_ZONE,
+                                    operator="In",
+                                    values=["test-zone-1"],
+                                )
+                            ]
+                        ),
+                    )
+                ]
+            )
+            return p
+
+        pods = [pod() for _ in range(5)]
+        t = _solve(pods)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 3
+        assert len(t.pod_errors) == 2
+        zones = [p.zone for p in t.node_plans]
+        assert len(zones) == len(set(zones)) == 3  # never two in one zone
