@@ -534,6 +534,93 @@ def config5() -> dict:
     }
 
 
+def config6() -> dict:
+    """The reference benchmark's own diverse pod mix, faithfully: 3/7
+    generic, 1/7 zone-spread, 1/7 hostname-spread, 1/7 hostname pod-
+    affinity, 1/7 zone pod-affinity, labels/selectors drawn from the
+    same 7-value pool (scheduling_benchmark_test.go:184-287 —
+    makeDiversePods, randomAffinityLabels, randomCPU/Memory). Affinity
+    selectors are mostly cross-matching, so those pods exercise the
+    oracle routing; self-matching draws exercise the tensor affinity
+    path. 7000 pods x 400 types exceeds the reference's largest grid
+    point (5000 x 400)."""
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.kube.objects import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    rng = np.random.RandomState(17)
+    vals = ["a", "b", "c", "d", "e", "f", "g"]
+    cpus = ["100m", "250m", "500m", "1", "1500m"]
+    mems = ["100Mi", "256Mi", "512Mi", "1Gi", "2Gi", "4Gi"]
+
+    def rnd(seq):
+        return seq[rng.randint(len(seq))]
+
+    n = _scale(7000)
+    seventh = n // 7
+    pods = []
+
+    def base(i, labels):
+        return _mk_pod(i, rnd(cpus), rnd(mems), labels=labels)
+
+    for i in range(3 * seventh + (n - 7 * seventh)):
+        pods.append(base(i, {"my-label": rnd(vals)}))
+    for key in (wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME):
+        for i in range(seventh):
+            p = base(len(pods), {"my-label": rnd(vals)})
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=key,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"my-label": rnd(vals)}),
+                )
+            ]
+            pods.append(p)
+    for key in (wk.LABEL_HOSTNAME, wk.LABEL_TOPOLOGY_ZONE):
+        for i in range(seventh):
+            p = base(len(pods), {"my-affininity": rnd(vals)})
+            p.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    required=[
+                        PodAffinityTerm(
+                            topology_key=key,
+                            label_selector=LabelSelector(
+                                match_labels={"my-affininity": rnd(vals)}
+                            ),
+                        )
+                    ]
+                )
+            )
+            pods.append(p)
+
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(_scale(400))
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+    solver = TPUScheduler([nodepool], provider)
+    solver.solve(pods)
+    with nogc():
+        t0 = time.perf_counter()
+        res = solver.solve(pods)
+        dt = time.perf_counter() - t0
+    return {
+        "config": "6: reference diverse mix (3/7 generic, 2/7 spread, 2/7 pod-affinity), 7k pods x 400 types",
+        "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
+        "pods_scheduled": res.pods_scheduled,
+        "pod_errors": len(res.pod_errors),
+        **packing_stats(res),
+    }
+
+
 # ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
@@ -647,7 +734,7 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5):
+        for fn in (config1, config2, config3, config4, config5, config6):
             try:
                 configs.append(fn())
             except Exception:

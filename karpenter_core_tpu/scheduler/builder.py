@@ -77,6 +77,14 @@ def build_scheduler(
 
     domains = build_domains(pool_types)
 
+    # register each pool's catalog with the vectorized-filter bridge once
+    # per build: the catalog fingerprint check (in-place offering
+    # mutation) happens here, not per filter call
+    from ..solver.oracle_bridge import refresh as _bridge_refresh
+
+    for _, options in pool_types:
+        _bridge_refresh(options)
+
     if kube_client is not None:
         vt = VolumeTopology(kube_client)
         for p in pods:

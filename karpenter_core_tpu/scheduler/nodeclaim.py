@@ -247,8 +247,30 @@ def filter_instance_types_by_requirements(
 ) -> FilterResults:
     """No short-circuit: each criterion is tracked independently so the
     error message can name what excluded everything (nodeclaim.go:225).
-    The TPU path computes the same three masks batched (solver.kernels)."""
+
+    The hot path evaluates the three criteria as vectors against the
+    tensor path's cached catalog encodings (solver.oracle_bridge) —
+    per-pod-per-claim Python set algebra dominated the diverse-mix
+    profile; the exact per-type loop remains as the fallback for
+    shapes the bridge doesn't vectorize (Gt/Lt bounds, unregistered
+    type lists)."""
     results = FilterResults(requests=requests)
+    from ..solver.oracle_bridge import fast_filter
+
+    vec = fast_filter(instance_types, requirements, requests)
+    if vec is not None:
+        compat, fits, offering = vec
+        results.requirements_met = bool(compat.any())
+        results.fits = bool(fits.any())
+        results.has_offering = bool(offering.any())
+        results.requirements_and_fits = bool((compat & fits & ~offering).any())
+        results.requirements_and_offering = bool((compat & offering & ~fits).any())
+        results.fits_and_offering = bool((fits & offering & ~compat).any())
+        keep = compat & fits & offering
+        results.remaining = [
+            it for j, it in enumerate(instance_types) if keep[j]
+        ]
+        return results
     for it in instance_types:
         it_compat = _compatible(it, requirements)
         it_fits = _fits(it, requests)

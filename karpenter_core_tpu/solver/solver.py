@@ -108,6 +108,23 @@ class _DeferredHostCompat:
         return allowed_host(*self.args)
 
 
+def _viable_zones(
+    enc: EncodedInstanceTypes,
+    viable: np.ndarray,
+    zone_ok: np.ndarray,
+    ct_ok: np.ndarray,
+) -> Tuple[List[str], Dict[str, np.ndarray]]:
+    """Zones the signature allows that have ≥1 viable type with an
+    available allowed offering, plus each zone's viable-type mask —
+    shared by the spread and affinity assignment paths."""
+    zones = [z for zi, z in enumerate(enc.zones) if zone_ok[zi]]
+    zone_types = {
+        z: viable & enc.offering_avail[:, enc.zones.index(z), :][:, ct_ok].any(axis=1)
+        for z in zones
+    }
+    return [z for z in zones if zone_types[z].any()], zone_types
+
+
 def _cache_put(enc: "EncodedInstanceTypes", key: tuple, value: np.ndarray) -> None:
     """Bounded insert into an encoding's cross-solve cache under
     _CATALOG_LOCK (its contract covers in-place mutation of shared
@@ -1467,16 +1484,11 @@ class TPUScheduler:
                 )
                 continue
 
-            # zone buckets: every spread GROUP round-robins its own pods
-            # (per-group balance = min-skew, topologygroup.go:93); plain
-            # pods of the class ride along round-robin — they must land
+            # zone buckets: every spread GROUP water-fills its own pods
+            # (per-group min-skew, topologygroup.go:93); plain pods of
+            # the class ride along round-robin — they must land
             # somewhere, and these nodes already exist
-            zones = [z for zi, z in enumerate(enc.zones) if zone_ok[zi]]
-            zone_types = {
-                z: viable & enc.offering_avail[:, enc.zones.index(z), :][:, ct_ok].any(axis=1)
-                for z in zones
-            }
-            zones = [z for z in zones if zone_types[z].any()]
+            zones, zone_types = _viable_zones(enc, viable, zone_ok, ct_ok)
             if not zones:
                 for m in spread:
                     for i in m["indices"]:
@@ -1735,13 +1747,7 @@ class TPUScheduler:
         viable = m["viable"]
         P = len(idx)
         ctx = self._existing_ctx
-        zones = [z for zi, z in enumerate(enc.zones) if zone_ok[zi]]
-        zone_types = {
-            z: viable
-            & enc.offering_avail[:, enc.zones.index(z), :][:, ct_ok].any(axis=1)
-            for z in zones
-        }
-        zones = [z for z in zones if zone_types[z].any()]
+        zones, zone_types = _viable_zones(enc, viable, zone_ok, ct_ok)
 
         akey = group.self_pod_affinity()
         a = group.exemplar.spec.affinity
