@@ -43,6 +43,13 @@ from ..scheduling import Requirements
 # catalogs alive, so registered ids stay stable while mapped)
 _IT_ROWS: Dict[int, tuple] = {}
 _IT_ROWS_MAX = 65536
+# id(list) → (entry, rows, the list itself): a claim re-filters the
+# SAME remaining-list object on every pod add, so row resolution is
+# amortized to one identity check instead of an O(T) per-type walk.
+# The cached strong ref keeps the list alive, so its id can never be
+# recycled onto a different list while mapped.
+_LIST_ROWS: Dict[int, tuple] = {}
+_LIST_ROWS_MAX = 4096
 
 
 def refresh(instance_types: List) -> None:
@@ -55,9 +62,9 @@ def refresh(instance_types: List) -> None:
 
     with _CATALOG_LOCK:
         entry = _catalog_entry(instance_types)
-        # prune mappings whose entry fell out of the catalog cache, so
-        # dead encodings (full mask/offering tensors) aren't pinned by
-        # this map between the rare wholesale clears
+        # prune mappings whose entry fell out of the catalog cache (or
+        # was replaced by a fingerprint change), so dead encodings
+        # aren't pinned and stale offering tensors are never served
         live = {id(e) for e in _CATALOG_CACHE.values()}
         if len(_IT_ROWS) > _IT_ROWS_MAX:
             _IT_ROWS.clear()
@@ -65,6 +72,9 @@ def refresh(instance_types: List) -> None:
             stale = [k for k, (e, _) in _IT_ROWS.items() if id(e) not in live]
             for k in stale:
                 del _IT_ROWS[k]
+        stale_lists = [k for k, v in _LIST_ROWS.items() if id(v[0]) not in live]
+        for k in stale_lists:
+            del _LIST_ROWS[k]
         for row, it in enumerate(entry.catalog):
             _IT_ROWS[id(it)] = (entry, row)
 
@@ -73,6 +83,8 @@ def _bounded_keys(enc) -> frozenset:
     """Catalog keys carrying Gt/Lt bounds (cached on the encoding)."""
     cached = enc.runtime_caches.get(("bounded_keys",))
     if cached is None:
+        from .solver import _cache_put
+
         cached = frozenset(
             key
             for key, reqs in enc.key_reqs.items()
@@ -81,7 +93,7 @@ def _bounded_keys(enc) -> frozenset:
                 for _, r in reqs
             )
         )
-        enc.runtime_caches[("bounded_keys",)] = cached
+        _cache_put(enc, ("bounded_keys",), cached)
     return cached
 
 
@@ -108,7 +120,9 @@ def _alloc_milli(enc) -> Tuple[np.ndarray, Dict[str, int], np.ndarray]:
                 neg[t] |= v < 0
                 mat[t, cols[k]] = min(max(int(v), 0) // _MILLI, _CLAMP)
         cached = (mat, cols, neg)
-        enc.runtime_caches[("alloc_milli",)] = cached
+        from .solver import _cache_put
+
+        _cache_put(enc, ("alloc_milli",), cached)
     return cached
 
 
@@ -125,33 +139,48 @@ def fast_filter(
     from .encode import _is_neg
     from .solver import _CATALOG_LOCK
 
-    # resolve rows through the identity map; one shared entry required
-    first = _IT_ROWS.get(id(instance_types[0]))
-    if first is None or first[0].catalog[first[1]] is not instance_types[0]:
-        refresh(instance_types)
+    # amortized row resolution: same list object ⇒ same rows
+    lkey = id(instance_types)
+    cached = _LIST_ROWS.get(lkey)
+    if cached is not None and cached[2] is instance_types:
+        entry, rows = cached[0], cached[1]
+    else:
+        # resolve through the identity map; one shared entry required.
+        # Unregistered lists BAIL to the exact loop (re-encoding here
+        # would thrash the 8-entry catalog cache when more pools are
+        # live than it holds) — builder.refresh registers each pool's
+        # catalog once per scheduler build.
         first = _IT_ROWS.get(id(instance_types[0]))
-        if first is None:
+        if first is None or first[0].catalog[first[1]] is not instance_types[0]:
             return None
-    entry = first[0]
-    rows = np.empty(len(instance_types), dtype=np.int64)
-    for j, it in enumerate(instance_types):
-        hit = _IT_ROWS.get(id(it))
-        if hit is None or hit[0] is not entry or entry.catalog[hit[1]] is not it:
-            return None
-        rows[j] = hit[1]
+        entry = first[0]
+        rows = np.empty(len(instance_types), dtype=np.int64)
+        for j, it in enumerate(instance_types):
+            hit = _IT_ROWS.get(id(it))
+            if hit is None or hit[0] is not entry or entry.catalog[hit[1]] is not it:
+                return None
+            rows[j] = hit[1]
+        if len(_LIST_ROWS) > _LIST_ROWS_MAX:
+            _LIST_ROWS.clear()
+        _LIST_ROWS[lkey] = (entry, rows, instance_types)
     enc = entry.enc
 
     with _CATALOG_LOCK:
         bounded = _bounded_keys(enc)
+        # pass 1 — bail decisions BEFORE any vocab mutation: interning a
+        # novel value and then bailing would leave the shared vocab
+        # wider than the cached masks (poisoning later calls)
+        for key, req in requirements.items():
+            if key not in enc.key_masks:
+                continue
+            if key in bounded or req.greater_than is not None or req.less_than is not None:
+                return None  # inexact both-negative carve-out for ranges
+        # pass 2 — intern + collect
         sig_masks: List[tuple] = []
         zone_allowed = None
         ct_allowed = None
         grew = False
         for key, req in requirements.items():
-            if req.greater_than is not None or req.less_than is not None:
-                if key in enc.key_masks:
-                    return None  # inexact carve-out for ranges — exact loop
-                continue
             if key == wk.LABEL_TOPOLOGY_ZONE:
                 zone_allowed = np.array([req.has(z) for z in enc.zones], dtype=bool)
             elif key == wk.CAPACITY_TYPE_LABEL_KEY:
@@ -160,15 +189,18 @@ def fast_filter(
                 )
             if key not in enc.key_masks:
                 continue  # type side lacks the key entirely → Intersects passes
-            if key in bounded:
-                return None
             kv = entry.vocab.key_vocab(key)
             before = kv.size
             for v in req.values:
                 kv.intern(v)
             grew = grew or kv.size != before
             sig_masks.append((key, req))
-        if grew:
+        # self-healing width check: extend also when a past caller grew
+        # the vocab without extending (belt over the pass-1 ordering)
+        if grew or any(
+            enc.key_masks[key].shape[1] != entry.vocab.key_vocab(key).size
+            for key, _ in sig_masks
+        ):
             from .encode import extend_encoded_masks
 
             extend_encoded_masks(enc, entry.vocab)
@@ -178,7 +210,7 @@ def fast_filter(
             kv = entry.vocab.key_vocab(key)
             smask = entry.vocab.encode_mask(req, kv.size)
             tmask = enc.key_masks[key][rows]
-            overlap = (tmask[:, : smask.shape[0]] & smask[None, :]).any(axis=1)
+            overlap = (tmask & smask[None, :]).any(axis=1)
             both_neg = enc.key_neg[key][rows] & _is_neg(req)
             # sig side has the key by construction; type side may not
             compat &= (~enc.key_has[key][rows]) | overlap | both_neg

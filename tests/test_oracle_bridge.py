@@ -114,3 +114,39 @@ def test_gt_lt_bounds_bail_to_exact_loop(catalog):
     res = filter_instance_types_by_requirements(catalog, reqs2, {"cpu": parse_quantity("1")})
     expect = [it for it in catalog if _compatible(it, reqs2) and _fits(it, {"cpu": parse_quantity("1")}) and _has_offering(it, reqs2)]
     assert [it.name for it in res.remaining] == [it.name for it in expect]
+
+
+def test_bail_never_poisons_the_vocab(catalog):
+    """A call that interns a novel value must not bail AFTER interning:
+    the vocab would outgrow the cached masks and crash later calls
+    (repro from review: Gt on a catalog key + novel label value)."""
+    from karpenter_core_tpu.cloudprovider.fake import INTEGER_INSTANCE_LABEL_KEY
+    from karpenter_core_tpu.kube.objects import OP_GT
+
+    poisoned = Requirements(
+        Requirement(wk.LABEL_ARCH, OP_IN, ["amd64", "novel-arch-zzz"]),
+        Requirement(INTEGER_INSTANCE_LABEL_KEY, OP_GT, ["4"]),
+    )
+    assert oracle_bridge.fast_filter(catalog, poisoned, {"cpu": parse_quantity("1")}) is None
+    follow = Requirements(Requirement(wk.LABEL_ARCH, OP_IN, ["amd64", "novel-arch-zzz"]))
+    vec = oracle_bridge.fast_filter(catalog, follow, {"cpu": parse_quantity("1")})
+    assert vec is not None  # no broadcast crash
+    for j, it in enumerate(catalog):
+        assert bool(vec[0][j]) == _compatible(it, follow)
+
+
+def test_refresh_invalidates_stale_list_rows(catalog):
+    """In-place offering mutation + refresh must invalidate the cached
+    list-row mapping, or the bridge serves pre-mutation availability."""
+    reqs = Requirements()
+    requests = {"cpu": parse_quantity("1")}
+    vec = oracle_bridge.fast_filter(catalog, reqs, requests)
+    assert vec is not None and bool(vec[2][0])
+    for o in catalog[0].offerings:
+        o.available = False
+    oracle_bridge.refresh(catalog)
+    vec2 = oracle_bridge.fast_filter(catalog, reqs, requests)
+    assert vec2 is not None
+    assert bool(vec2[2][0]) == _has_offering(catalog[0], reqs) == False  # noqa: E712
+    for o in catalog[0].offerings:  # restore (fixture-scoped catalog)
+        o.available = True
