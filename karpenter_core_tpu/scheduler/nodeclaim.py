@@ -254,9 +254,9 @@ def filter_instance_types_by_requirements(
     profile; the exact per-type loop remains as the fallback for
     shapes the bridge doesn't vectorize (Gt/Lt bounds, unregistered
     type lists)."""
-    results = FilterResults(requests=requests)
     from ..solver.oracle_bridge import fast_filter
 
+    results = FilterResults(requests=requests)
     vec = fast_filter(instance_types, requirements, requests)
     if vec is not None:
         compat, fits, offering = vec

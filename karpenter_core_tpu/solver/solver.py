@@ -498,7 +498,9 @@ class TPUScheduler:
             # selector that ALSO matches another group needs the
             # oracle's global counting
             a = g.exemplar.spec.affinity
-            if a is not None and (g.self_pod_affinity() or g.zone_anti_isolated):
+            if a is not None and (
+                g.self_pod_affinity() or g.zone_anti_isolated or g.hostname_isolated
+            ):
                 for pa in (a.pod_affinity, a.pod_anti_affinity):
                     if pa is not None:
                         sels.extend(
@@ -515,14 +517,11 @@ class TPUScheduler:
                 cross.append(g)
         tensor_groups = exclude(tensor_groups, cross)
         oracle_groups = oracle_groups + cross
-        if state_nodes:
-            spreadish = [
-                g
-                for g in tensor_groups
-                if g.hostname_spread() is not None or g.hostname_isolated
-            ]
-            tensor_groups = exclude(tensor_groups, spreadish)
-            oracle_groups = oracle_groups + spreadish
+        # hostname topologies stay tensor even with existing capacity:
+        # hostname domains always see a global min of 0
+        # (topologygroup.go:193-196), so the semantics reduce to a
+        # per-node quota of max_skew minus the node's existing matching
+        # count — handled by _pack_hostname_existing + max_per_node
         # plain groups whose labels match an oracle-routed group's spread
         # OR affinity selectors must schedule in the same (oracle) world,
         # or the oracle's topology/anchor counts would miss their
@@ -899,6 +898,8 @@ class TPUScheduler:
             if g.zone_spread() is None
             and g.self_pod_affinity() is None
             and not g.zone_anti_isolated
+            and g.hostname_spread() is None
+            and not g.hostname_isolated
         ]
         if not pack:
             return
@@ -1475,6 +1476,26 @@ class TPUScheduler:
                     jobs, metas,
                 )
                 continue
+            if (
+                len(members) == 1
+                and int(max_per_node) < 2**31 - 1
+                and self._existing_ctx is not None
+                and g0.zone_spread() is None
+            ):
+                # hostname-capped group with existing capacity: fill the
+                # per-node quota (max_skew minus the node's existing
+                # matching count) before opening capped new nodes.
+                # Groups that ALSO zone-spread skip this (their pods
+                # must be zone-assigned first; they take new zone-pinned
+                # nodes where max_per_node still applies).
+                idx0, _ = sorted_idx(members[0]["indices"])
+                left = self._pack_hostname_existing(
+                    members[0], idx0, int(max_per_node), pods, result
+                )
+                if not left:
+                    continue
+                members[0] = dict(members[0], indices=left)
+                spread, plain = [], [members[0]]
 
             if not spread:
                 idx, reqs = sorted_idx([i for m in members for i in m["indices"]])
@@ -1687,9 +1708,14 @@ class TPUScheduler:
                     f"{c.topology_key}"
                 )
         respill: List[np.ndarray] = []
+        # hostname-capped groups never first-fit onto existing nodes
+        # here: this pack has no per-node matching-count quota, so it
+        # could stack pods past the hostname cap — they take capped new
+        # nodes instead
+        can_use_existing = ctx is not None and int(m["max_per_node"]) >= 2**31 - 1
         for zi, z in enumerate(place):
             part = parts[zi]
-            if part.size and ctx is not None and z in existing_zones:
+            if part.size and can_use_existing and z in existing_zones:
                 part = self._pack_spread_existing(part, z, group, ctx, result)
             if part.size == 0:
                 continue
@@ -2018,6 +2044,105 @@ class TPUScheduler:
             result.pod_errors[pods[i].uid] = (
                 "pod affinity on hostname: co-located node is full"
             )
+
+    def _pack_hostname_existing(
+        self,
+        m: dict,
+        idx: np.ndarray,  # group's pod indices, descending by size
+        cap: int,
+        pods: List[Pod],
+        result: SolverResult,
+    ) -> List[int]:
+        """Fill existing nodes up to each node's hostname-topology quota
+        (cap minus its existing matching-pod count — hostname domains
+        always see a global min of 0, topologygroup.go:193-196).
+        Host-side first-fit: group sizes here are small relative to the
+        batch (the capped shapes), and the oracle this replaces was
+        O(P·M) anyway. Returns the indices still needing new nodes."""
+        from .encode import _selector_key
+        from .topology_tensor import seed_counts_for_selector
+
+        group: SignatureGroup = m["group"]
+        ctx = self._existing_ctx
+        nodes = ctx["nodes"]
+        if not nodes:
+            return list(idx)
+        hs = group.hostname_spread()
+        if hs is not None:
+            selector = hs.label_selector
+            seeds = self._spread_seeds(group, hs)  # cached per solve
+        else:  # hostname_isolated: the self anti-affinity term's selector
+            term = next(
+                t
+                for t in group.exemplar.spec.affinity.pod_anti_affinity.required
+                if t.topology_key == wk.LABEL_HOSTNAME
+            )
+            selector = term.label_selector
+            skey = ("anti-host", _selector_key(selector), group.exemplar.namespace)
+            seeds = self._seed_cache.get(skey)
+            if seeds is None:
+                seeds = seed_counts_for_selector(
+                    self.kube_client,
+                    group.exemplar,
+                    wk.LABEL_HOSTNAME,
+                    selector,
+                    self._batch_uids,
+                )
+                self._seed_cache[skey] = seeds
+        # fold THIS solve's committed existing-node placements (matching
+        # pods this batch already put on a node — e.g. earlier rounds or
+        # retries — count against that node's quota, like the oracle's
+        # immediate Record)
+        committed: Dict[str, int] = {}
+        ns = group.exemplar.namespace
+        for eplan in result.existing_plans:
+            n = sum(
+                1
+                for i in eplan.pod_indices
+                if pods[i].namespace == ns
+                and (selector is None or selector.matches(pods[i].metadata.labels))
+            )
+            if n:
+                name = eplan.state_node.hostname() or eplan.state_node.name()
+                committed[name] = committed.get(name, 0) + n
+        row = self._existing_compat_row(group, ctx).astype(bool)
+        def _count(n) -> int:
+            return max(seeds.get(n.hostname(), 0), seeds.get(n.name(), 0)) + max(
+                committed.get(n.hostname(), 0), committed.get(n.name(), 0)
+            )
+
+        quota = np.array(
+            [
+                max(0, cap - _count(n)) if row[mi] else 0
+                for mi, n in enumerate(nodes)
+            ],
+            dtype=np.int64,
+        )
+        if not quota.any():
+            return list(idx)
+        reqs = build_requests_matrix_ids(
+            self._req_ids[idx], ctx["axis"], self._req_map
+        )
+        free = ctx["free"]
+        by_node: Dict[int, List[int]] = {}
+        leftover: List[int] = []
+        eligible = np.flatnonzero(quota > 0)
+        for j, i in enumerate(idx):
+            placed = False
+            for mi in eligible:
+                if quota[mi] > 0 and (free[mi] >= reqs[j]).all():
+                    free[mi] -= reqs[j]
+                    quota[mi] -= 1
+                    by_node.setdefault(int(mi), []).append(int(i))
+                    placed = True
+                    break
+            if not placed:
+                leftover.append(int(i))
+        for mi in sorted(by_node):
+            result.existing_plans.append(
+                ExistingNodePlan(state_node=nodes[mi], pod_indices=by_node[mi])
+            )
+        return leftover
 
     def _pack_spread_existing(
         self,

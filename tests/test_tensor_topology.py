@@ -302,3 +302,165 @@ class TestCommittedPlacementAccounting:
         # counts are (4,0,0): the two remaining pods must avoid zone-1
         assert "test-zone-1" not in placed_zones
         assert len(placed_zones) == 2
+
+
+class TestHostnameTopologyWithStateNodes:
+    """Hostname topologies stay tensor with existing capacity: hostname
+    domains always see a global min of 0 (topologygroup.go:193-196), so
+    the semantics reduce to per-node quotas of max_skew minus the
+    node's existing matching count."""
+
+    def _env(self, existing_per_node=(0, 0)):
+        kube = KubeClient()
+        sns = []
+        for i, n_existing in enumerate(existing_per_node):
+            node, sn = _state_node(ZONES[i % 3], cpu="8", name=f"hn-{i}")
+            kube.create(node)
+            sns.append(sn)
+            for _ in range(n_existing):
+                p = make_pod(
+                    labels={"app": "web"},
+                    node_name=node.name,
+                    phase="Running",
+                    pending_unschedulable=False,
+                )
+                kube.create(p)
+        return kube, sns
+
+    def test_hostname_spread_fills_node_quotas(self):
+        kube, sns = self._env((1, 0))
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "500m"},
+                topology_spread=[
+                    spread(wk.LABEL_HOSTNAME, max_skew=2, labels={"app": "web"})
+                ],
+            )
+            for _ in range(4)
+        ]
+        res = TPUScheduler([make_nodepool()], _provider(), kube_client=kube).solve(
+            pods, state_nodes=sns
+        )
+        assert res.oracle_results is None  # tensor path, no oracle fallback
+        assert res.pods_scheduled == 4
+        # node hn-0 already holds 1 matching pod -> quota 1; hn-1 quota 2;
+        # the remaining pod opens a new node (capped at 2)
+        by_node = {
+            p.state_node.name(): len(p.pod_indices) for p in res.existing_plans
+        }
+        assert by_node.get("hn-0", 0) <= 1
+        assert by_node.get("hn-1", 0) <= 2
+        assert sum(by_node.values()) + sum(
+            len(p.pod_indices) for p in res.node_plans
+        ) == 4
+        assert all(len(p.pod_indices) <= 2 for p in res.node_plans)
+
+    def test_hostname_isolated_skips_occupied_nodes(self):
+        from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+
+        kube, sns = self._env((1, 0))
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "500m"},
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        res = TPUScheduler([make_nodepool()], _provider(), kube_client=kube).solve(
+            pods, state_nodes=sns
+        )
+        assert res.oracle_results is None
+        assert res.pods_scheduled == 3
+        # hn-0 holds a matching pod (quota 0): nothing may land there
+        for p in res.existing_plans:
+            if p.state_node.name() == "hn-0":
+                assert not p.pod_indices
+        # every pod alone on its node
+        assert all(len(p.pod_indices) == 1 for p in res.existing_plans)
+        assert all(len(p.pod_indices) == 1 for p in res.node_plans)
+
+    def test_anti_affinity_not_stacked_with_matching_batch_pods(self):
+        """A broad anti-affinity selector matching ANOTHER group routes
+        both to the oracle (global counting); a self-only group's quotas
+        fold this solve's own committed placements (review repro)."""
+        from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+
+        kube, sns = KubeClient(), []
+        node, sn = _state_node(ZONES[0], cpu="8", name="hn-0")
+        kube.create(node)
+        sns.append(sn)
+        plain = [
+            make_pod(labels={"app": "web"}, requests={"cpu": "1"}) for _ in range(2)
+        ]
+        anti = make_pod(
+            labels={"app": "web"},
+            requests={"cpu": "1"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+        res = TPUScheduler([make_nodepool()], _provider(), kube_client=kube).solve(
+            plain + [anti], state_nodes=sns
+        )
+        assert res.pods_scheduled == 3
+        # the anti pod must never share a node with the matching plain pods
+        for p in res.existing_plans:
+            if 2 in p.pod_indices:
+                assert p.pod_indices == [2]
+                assert not any(
+                    2 in q.pod_indices and (0 in q.pod_indices or 1 in q.pod_indices)
+                    for q in res.existing_plans
+                )
+        on_same = [
+            p for p in res.existing_plans if 2 in p.pod_indices and len(p.pod_indices) > 1
+        ]
+        assert not on_same
+        if res.oracle_results is not None:
+            # oracle-routed: its claims/nominations enforce the constraint
+            return
+        # tensor path: pod 2 is alone wherever it landed
+        for p in list(res.existing_plans) + list(res.node_plans):
+            if 2 in p.pod_indices:
+                assert p.pod_indices == [2]
+
+    def test_zone_and_hostname_spread_combined_keeps_zone_skew(self):
+        """Combined zone (max_skew 1) + hostname (max_skew 3) spread:
+        the hostname pre-pack must not dump everything into the zone
+        that happens to have existing nodes (review repro)."""
+        kube, sns = KubeClient(), []
+        for i in range(2):  # both existing nodes in zone-1
+            node, sn = _state_node(ZONES[0], cpu="8", name=f"z1-{i}")
+            kube.create(node)
+            sns.append(sn)
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "500m"},
+                topology_spread=[
+                    spread(wk.LABEL_TOPOLOGY_ZONE, max_skew=1, labels={"app": "web"}),
+                    spread(wk.LABEL_HOSTNAME, max_skew=3, labels={"app": "web"}),
+                ],
+            )
+            for _ in range(6)
+        ]
+        res = TPUScheduler([make_nodepool()], _provider(), kube_client=kube).solve(
+            pods, state_nodes=sns
+        )
+        assert res.oracle_results is None
+        assert res.pods_scheduled == 6
+        counts = _zone_counts(res, pods)
+        vals = [counts.get(z, 0) for z in ZONES]
+        assert max(vals) - min(vals) <= 1, counts
+        # hostname cap respected everywhere
+        assert all(len(p.pod_indices) <= 3 for p in res.node_plans)
+        assert all(len(p.pod_indices) <= 3 for p in res.existing_plans)
