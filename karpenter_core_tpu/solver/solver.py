@@ -1673,10 +1673,15 @@ class TPUScheduler:
 
         # placement domains A: new-node-eligible zones, plus zones whose
         # existing nodes admit the group (a pod can land there with no
-        # new claim — scheduler.go:241-246 order)
+        # new claim — scheduler.go:241-246 order). Hostname-capped
+        # groups can't use the existing-node first-fit (it has no
+        # per-node matching-count quota), so for them existing-only
+        # zones are NOT placement domains — adding them would assign
+        # quotas that respill and break the zone skew.
+        can_use_existing = ctx is not None and int(m["max_per_node"]) >= 2**31 - 1
         place = list(zones)
         existing_zones: set = set()
-        if ctx is not None:
+        if can_use_existing:
             row = self._existing_compat_row(group, ctx).astype(bool)
             for z in set(ctx["node_zones"][row].tolist()):
                 if z and allowed(z):
@@ -1708,11 +1713,6 @@ class TPUScheduler:
                     f"{c.topology_key}"
                 )
         respill: List[np.ndarray] = []
-        # hostname-capped groups never first-fit onto existing nodes
-        # here: this pack has no per-node matching-count quota, so it
-        # could stack pods past the hostname cap — they take capped new
-        # nodes instead
-        can_use_existing = ctx is not None and int(m["max_per_node"]) >= 2**31 - 1
         for zi, z in enumerate(place):
             part = parts[zi]
             if part.size and can_use_existing and z in existing_zones:
@@ -2067,57 +2067,77 @@ class TPUScheduler:
         nodes = ctx["nodes"]
         if not nodes:
             return list(idx)
+        ns = group.exemplar.namespace
+        # EVERY hostname constraint the group carries contributes its own
+        # (cap, selector, seeds) triple — a group can have both a
+        # hostname spread (cap=max_skew, spread selector) and self
+        # anti-affinity (cap=1, anti selector); a node's quota is the
+        # minimum over all of them
+        constraints: List[tuple] = []
         hs = group.hostname_spread()
         if hs is not None:
-            selector = hs.label_selector
-            seeds = self._spread_seeds(group, hs)  # cached per solve
-        else:  # hostname_isolated: the self anti-affinity term's selector
+            constraints.append(
+                (int(hs.max_skew), hs.label_selector, self._spread_seeds(group, hs))
+            )
+        if group.hostname_isolated:
             term = next(
                 t
                 for t in group.exemplar.spec.affinity.pod_anti_affinity.required
                 if t.topology_key == wk.LABEL_HOSTNAME
             )
-            selector = term.label_selector
-            skey = ("anti-host", _selector_key(selector), group.exemplar.namespace)
+            skey = ("anti-host", _selector_key(term.label_selector), ns)
             seeds = self._seed_cache.get(skey)
             if seeds is None:
                 seeds = seed_counts_for_selector(
                     self.kube_client,
                     group.exemplar,
                     wk.LABEL_HOSTNAME,
-                    selector,
+                    term.label_selector,
                     self._batch_uids,
                 )
                 self._seed_cache[skey] = seeds
+            constraints.append((1, term.label_selector, seeds))
+        if not constraints:
+            return list(idx)
+
         # fold THIS solve's committed existing-node placements (matching
         # pods this batch already put on a node — e.g. earlier rounds or
         # retries — count against that node's quota, like the oracle's
         # immediate Record)
-        committed: Dict[str, int] = {}
-        ns = group.exemplar.namespace
-        for eplan in result.existing_plans:
-            n = sum(
-                1
-                for i in eplan.pod_indices
-                if pods[i].namespace == ns
-                and (selector is None or selector.matches(pods[i].metadata.labels))
-            )
-            if n:
-                name = eplan.state_node.hostname() or eplan.state_node.name()
-                committed[name] = committed.get(name, 0) + n
-        row = self._existing_compat_row(group, ctx).astype(bool)
-        def _count(n) -> int:
-            return max(seeds.get(n.hostname(), 0), seeds.get(n.name(), 0)) + max(
-                committed.get(n.hostname(), 0), committed.get(n.name(), 0)
-            )
+        def _committed(selector) -> Dict[str, int]:
+            out: Dict[str, int] = {}
+            for eplan in result.existing_plans:
+                n = sum(
+                    1
+                    for i in eplan.pod_indices
+                    if pods[i].namespace == ns
+                    and (selector is None or selector.matches(pods[i].metadata.labels))
+                )
+                if n:
+                    name = eplan.state_node.hostname() or eplan.state_node.name()
+                    out[name] = out.get(name, 0) + n
+            return out
 
-        quota = np.array(
-            [
-                max(0, cap - _count(n)) if row[mi] else 0
-                for mi, n in enumerate(nodes)
-            ],
-            dtype=np.int64,
-        )
+        row = self._existing_compat_row(group, ctx).astype(bool)
+        quota = np.where(row, np.int64(cap), np.int64(0)).astype(np.int64)
+        for c_cap, selector, seeds in constraints:
+            committed = _committed(selector)
+            q_c = np.array(
+                [
+                    max(
+                        0,
+                        c_cap
+                        - max(seeds.get(n.hostname(), 0), seeds.get(n.name(), 0))
+                        - max(
+                            committed.get(n.hostname(), 0),
+                            committed.get(n.name(), 0),
+                        ),
+                    )
+                    for n in nodes
+                ],
+                dtype=np.int64,
+            )
+            quota = np.minimum(quota, q_c)
         if not quota.any():
             return list(idx)
         reqs = build_requests_matrix_ids(

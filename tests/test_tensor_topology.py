@@ -464,3 +464,77 @@ class TestHostnameTopologyWithStateNodes:
         # hostname cap respected everywhere
         assert all(len(p.pod_indices) <= 3 for p in res.node_plans)
         assert all(len(p.pod_indices) <= 3 for p in res.existing_plans)
+
+    def test_capped_group_ignores_existing_only_zone(self):
+        """A hostname-capped zone-spread group can't use the existing-
+        node first-fit, so an existing-only zone (no offerings) must not
+        receive quotas that respill and break zone skew (review repro)."""
+        kube = KubeClient()
+        # an existing node in a zone the catalog has NO offerings for
+        node, sn = _state_node("test-zone-9", cpu="8", name="z9-0")
+        kube.create(node)
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "500m"},
+                topology_spread=[
+                    spread(wk.LABEL_TOPOLOGY_ZONE, max_skew=1, labels={"app": "web"}),
+                    spread(wk.LABEL_HOSTNAME, max_skew=2, labels={"app": "web"}),
+                ],
+            )
+            for _ in range(6)
+        ]
+        res = TPUScheduler([make_nodepool()], _provider(), kube_client=kube).solve(
+            pods, state_nodes=[sn]
+        )
+        assert res.oracle_results is None
+        counts = _zone_counts(res, pods)
+        sched = [counts.get(z, 0) for z in ZONES]
+        # offerings exist only in the 3 catalog zones; counts balanced
+        assert max(sched) - min(sched) <= 1, counts
+        assert counts.get("test-zone-9", 0) == 0
+        assert all(len(p.pod_indices) <= 2 for p in res.node_plans)
+
+    def test_hostname_spread_plus_anti_uses_both_selectors(self):
+        """Spread(app=web, skew 3) + self anti (tier=db): a node holding
+        an existing tier=db pod (not app=web) must get quota 0 via the
+        ANTI selector even though the spread selector counts 0 there
+        (review repro)."""
+        from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+
+        kube = KubeClient()
+        node, sn = _state_node(ZONES[0], cpu="8", name="occupied")
+        kube.create(node)
+        blocker = make_pod(
+            labels={"tier": "db"},  # matches the ANTI selector only
+            node_name=node.name,
+            phase="Running",
+            pending_unschedulable=False,
+        )
+        kube.create(blocker)
+        pods = [
+            make_pod(
+                labels={"app": "web", "tier": "db"},
+                requests={"cpu": "500m"},
+                topology_spread=[
+                    spread(wk.LABEL_HOSTNAME, max_skew=3, labels={"app": "web"})
+                ],
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"tier": "db"}),
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        res = TPUScheduler([make_nodepool()], _provider(), kube_client=kube).solve(
+            pods, state_nodes=[sn]
+        )
+        assert res.pods_scheduled == 2
+        # nothing may land on the occupied node (anti selector matches
+        # its existing pod), and each pod is alone on its node (cap 1)
+        assert not any(
+            p.pod_indices for p in res.existing_plans if p.state_node.name() == "occupied"
+        )
+        assert all(len(p.pod_indices) == 1 for p in res.node_plans)
