@@ -277,6 +277,7 @@ class Topology:
         self.domain_universe = domains
         self.topologies: Dict[tuple, TopologyGroup] = {}
         self.inverse_topologies: Dict[tuple, TopologyGroup] = {}
+        self._owner_index: Dict[str, List[TopologyGroup]] = {}
         # pods being scheduled don't count against existing topologies
         # (topology.go:71-75)
         self.excluded_pods: Set[str] = {p.uid for p in pods}
@@ -296,6 +297,10 @@ class Topology:
             self._update_inverse_anti_affinity(pod, None)
 
         groups = self._new_for_topologies(pod) + self._new_for_affinities(pod)
+        # dedup by hash key: two of a pod's terms can hash to the same
+        # group (e.g. identical required+preferred affinity terms), and
+        # the old full-dict scan naturally returned each group once
+        owned: Dict[tuple, TopologyGroup] = {}
         for tg in groups:
             key = tg.hash_key()
             existing = self.topologies.get(key)
@@ -305,6 +310,11 @@ class Topology:
             else:
                 tg = existing
             tg.add_owner(pod.uid)
+            owned[key] = tg
+        # pod → owned groups index: _matching_topologies runs per
+        # pod-per-claim attempt, and a full scan of every group there
+        # dominated the diverse-mix profile
+        self._owner_index[pod.uid] = list(owned.values())
 
     def record(
         self, pod: Pod, requirements: Requirements, allow_undefined: AbstractSet[str] = frozenset()
@@ -470,8 +480,9 @@ class Topology:
     def _matching_topologies(
         self, p: Pod, requirements: Requirements, allow_undefined: AbstractSet[str]
     ) -> List[TopologyGroup]:
-        """Groups owning p, plus inverse groups selecting p (topology.go:366)."""
-        matching = [tg for tg in self.topologies.values() if tg.is_owned_by(p.uid)]
+        """Groups owning p (indexed — update() maintains it), plus
+        inverse groups selecting p (topology.go:366)."""
+        matching = list(self._owner_index.get(p.uid, ()))
         matching += [
             tg
             for tg in self.inverse_topologies.values()
