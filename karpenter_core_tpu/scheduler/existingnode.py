@@ -33,8 +33,9 @@ class ExistingNode:
         remaining = resources.subtract(daemon_resources, state_node.daemonset_request_total())
         self.requests = {k: max(v, 0) for k, v in remaining.items()}
         self.requirements = label_requirements(state_node.labels())
-        self.requirements.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [state_node.hostname()]))
-        topology.register(wk.LABEL_HOSTNAME, state_node.hostname())
+        hostname = state_node.hostname()
+        self.requirements.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [hostname]))
+        topology.register(wk.LABEL_HOSTNAME, hostname)
 
     # pass-throughs
     def name(self) -> str:

@@ -20,8 +20,10 @@ from ..kube.objects import EFFECT_PREFER_NO_SCHEDULE, Pod, ResourceList
 from ..scheduling import Taints, resources
 from ..scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    has_preferred_node_affinity,
     label_requirements,
     pod_requirements,
+    strict_pod_requirements,
 )
 from ..state.statenode import StateNode
 from ..utils import pod as podutils
@@ -166,14 +168,41 @@ class Scheduler:
     # -- add one pod (scheduler.go:238) ------------------------------------
 
     def _add(self, pod: Pod) -> Optional[str]:
+        # topology outcomes per claim depend only on per-domain counts and
+        # the claim's concrete value set per key, so compute the admissible
+        # domains once and skip claims that would be rejected anyway
+        # (loops 1-2 discard the per-claim error strings, so skipping is
+        # behavior-identical)
+        strict_reqs = (
+            strict_pod_requirements(pod)
+            if has_preferred_node_affinity(pod)
+            else pod_requirements(pod)
+        )
+        adm = self.topology.admissible_by_key(pod, strict_reqs)
+
+        def claim_viable(reqs) -> bool:
+            if adm is None:
+                return True
+            for key, allowed in adm.items():
+                r = reqs.get_req(key)
+                if r.complement:
+                    continue  # NotIn/Exists/Gt/Lt: no concrete value set
+                if allowed.isdisjoint(r.values):
+                    return False
+            return True
+
         # 1. in-flight real nodes
         for node in self.existing_nodes:
+            if not claim_viable(node.requirements):
+                continue
             if node.add(self.kube_client, pod) is None:
                 return None
 
         # 2. already-planned claims, fewest pods first (scheduler.go:247)
         self.new_node_claims.sort(key=lambda c: len(c.pods))
         for claim in self.new_node_claims:
+            if not claim_viable(claim.requirements):
+                continue
             if claim.add(pod) is None:
                 return None
 

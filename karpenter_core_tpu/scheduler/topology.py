@@ -221,6 +221,31 @@ class TopologyGroup:
                 options.insert(domain)
         return options
 
+    def admissible_domains(self, pod: Pod, pod_domains: Requirement) -> Optional[Set[str]]:
+        """The single-valued node domains {d} for which get() would return
+        a non-empty requirement — i.e. the claims this group could accept
+        the pod on, as a function of per-domain counts only. Returns None
+        when the outcome is not claim-independent (affinity bootstrap,
+        where get() may offer a domain outside node_domains)."""
+        if self.type == TOPOLOGY_TYPE_SPREAD:
+            min_count = self._domain_min_count(pod_domains)
+            bump = 1 if self.selects(pod) else 0
+            return {
+                d
+                for d, c in self.domains.items()
+                if (c + bump) - min_count <= self.max_skew
+            }
+        if self.type == TOPOLOGY_TYPE_POD_AFFINITY:
+            anchored = {
+                d for d, c in self.domains.items() if c > 0 and pod_domains.has(d)
+            }
+            if anchored:
+                return anchored
+            if self.selects(pod):
+                return None  # bootstrap: get() falls back past node_domains
+            return set()
+        return {d for d, c in self.domains.items() if c == 0 and pod_domains.has(d)}
+
 
 def _ignored_for_topology(p: Pod) -> bool:
     return not podutils.is_scheduled(p) or podutils.is_terminal(p) or podutils.is_terminating(p)
@@ -362,6 +387,41 @@ class Topology:
         for tg in self.inverse_topologies.values():
             if tg.key == topology_key:
                 tg.register(domain)
+
+    def admissible_by_key(
+        self, pod: Pod, pod_requirements: Requirements
+    ) -> Optional[Dict[str, Set[str]]]:
+        """Per topology key, the domain values on which some claim could
+        still accept this pod; None when no group constrains it claim-
+        independently. get()'s per-claim outcome depends only on
+        per-domain counts and the claim's value set for the key, so the
+        scheduler's claim loop computes this once per pod and skips
+        claims whose concrete values are disjoint from the admissible
+        set, instead of paying add()'s requirement/topology machinery
+        per doomed attempt (which dominated the diverse-mix profile)."""
+        result: Optional[Dict[str, Set[str]]] = None
+
+        def fold(tg: TopologyGroup) -> None:
+            nonlocal result
+            dom = tg.admissible_domains(pod, pod_requirements.get_req(tg.key))
+            if dom is None:
+                return
+            if result is None:
+                result = {tg.key: dom}
+            elif tg.key in result:
+                result[tg.key] &= dom
+            else:
+                result[tg.key] = dom
+
+        for tg in self._owner_index.get(pod.uid, ()):
+            fold(tg)
+        for tg in self.inverse_topologies.values():
+            if tg.node_filter.requirements:
+                continue  # claim-dependent membership: cannot prefilter
+            if not tg.selects(pod):
+                continue
+            fold(tg)
+        return result
 
     # -- internals ---------------------------------------------------------
 

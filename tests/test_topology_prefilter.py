@@ -1,0 +1,164 @@
+"""The scheduler's claim-viability prefilter (topology.admissible_by_key)
+must be a pure optimization: a claim it skips would have been rejected by
+the full add() path anyway (scheduler.go:247 tries every claim; we skip
+only provably-doomed attempts)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.kube.objects import LabelSelector, OP_EXISTS, OP_IN, TopologySpreadConstraint
+from karpenter_core_tpu.scheduler.topology import (
+    TOPOLOGY_TYPE_POD_AFFINITY,
+    TOPOLOGY_TYPE_POD_ANTI_AFFINITY,
+    TOPOLOGY_TYPE_SPREAD,
+    Topology,
+    TopologyGroup,
+)
+from karpenter_core_tpu.scheduling import Requirement
+
+from helpers import make_nodepool, make_pod
+
+
+class TestAdmissibleDomainsContract:
+    """For every group type: get(pod, pod_domains, {d}) is non-empty
+    exactly when d is in admissible_domains (whenever the latter is not
+    None) — the prefilter may only skip what get() would reject."""
+
+    @pytest.mark.parametrize("seed", range(40))
+    def test_randomized_equivalence(self, seed):
+        rng = random.Random(seed)
+        topo_type = rng.choice(
+            [TOPOLOGY_TYPE_SPREAD, TOPOLOGY_TYPE_POD_AFFINITY, TOPOLOGY_TYPE_POD_ANTI_AFFINITY]
+        )
+        key = rng.choice([wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME])
+        domains = {f"d{i}" for i in range(rng.randint(1, 6))}
+        selector = LabelSelector(match_labels={"app": "x"})
+        pod = make_pod(labels={"app": rng.choice(["x", "y"])})
+        tg = TopologyGroup(
+            topo_type,
+            key,
+            pod,
+            {"default"},
+            selector,
+            max_skew=rng.randint(1, 3),
+            min_domains=rng.choice([None, 2]),
+            domains=domains,
+        )
+        for d in domains:
+            tg.domains[d] = rng.randint(0, 3)
+
+        # pod_domains: sometimes restricted, sometimes open
+        if rng.random() < 0.5:
+            sub = rng.sample(sorted(domains), rng.randint(1, len(domains)))
+            pod_domains = Requirement(key, OP_IN, sub)
+        else:
+            pod_domains = Requirement(key, OP_EXISTS)
+
+        adm = tg.admissible_domains(pod, pod_domains)
+        if adm is None:
+            return  # prefilter abstains: nothing to check
+        for d in sorted(domains):
+            node_domains = Requirement(key, OP_IN, [d])
+            got = tg.get(pod, pod_domains, node_domains)
+            if tg.type == TOPOLOGY_TYPE_SPREAD:
+                # get() restricted to {d} succeeds iff d admissible
+                assert (got.len() > 0) == (d in adm), (topo_type, d, tg.domains)
+            else:
+                # affinity/anti-affinity ignore node_domains in get();
+                # the claim dies at the later compatibility check, which
+                # passes iff d is among the returned options
+                assert got.has(d) == (d in adm), (topo_type, d, tg.domains)
+
+
+class TestPrefilterBehaviorIdentical:
+    def test_diverse_mix_same_plans(self, monkeypatch):
+        """Same workload with the prefilter disabled produces the same
+        nodes and pod placements."""
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_core_tpu.scheduler.builder import build_scheduler
+
+        def build_pods():
+            rng = random.Random(7)
+            pods = []
+            for i in range(120):
+                labels = {"app": rng.choice(["a", "b", "c"])}
+                name = f"p{i:03d}"
+                kind = i % 4
+                if kind == 0:
+                    pods.append(make_pod(name=name, requests={"cpu": "100m"}, labels=labels))
+                elif kind == 1:
+                    pods.append(
+                        make_pod(
+                            name=name,
+                            requests={"cpu": "100m"},
+                            labels=labels,
+                            topology_spread=[
+                                TopologySpreadConstraint(
+                                    max_skew=1,
+                                    topology_key=wk.LABEL_HOSTNAME,
+                                    when_unsatisfiable="DoNotSchedule",
+                                    label_selector=LabelSelector(match_labels=labels),
+                                )
+                            ],
+                        )
+                    )
+                elif kind == 2:
+                    pods.append(
+                        make_pod(
+                            name=name,
+                            requests={"cpu": "100m"},
+                            labels=labels,
+                            topology_spread=[
+                                TopologySpreadConstraint(
+                                    max_skew=1,
+                                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                                    when_unsatisfiable="DoNotSchedule",
+                                    label_selector=LabelSelector(match_labels=labels),
+                                )
+                            ],
+                        )
+                    )
+                else:
+                    from karpenter_core_tpu.kube.objects import PodAffinityTerm
+
+                    pods.append(
+                        make_pod(
+                            name=name,
+                            requests={"cpu": "100m"},
+                            labels=labels,
+                            pod_affinity=[
+                                PodAffinityTerm(
+                                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                                    label_selector=LabelSelector(
+                                        match_labels={"app": rng.choice(["a", "b", "c"])}
+                                    ),
+                                )
+                            ],
+                        )
+                    )
+            return pods
+
+        def run():
+            import itertools
+
+            import karpenter_core_tpu.scheduler.nodeclaim as ncmod
+
+            ncmod._hostname_counter = itertools.count(1)
+            provider = FakeCloudProvider()
+            provider.instance_types = instance_types(10)
+            pods = build_pods()
+            sched = build_scheduler(None, None, [make_nodepool()], provider, pods)
+            results = sched.solve(pods)
+            return sorted(
+                tuple(sorted(p.metadata.name for p in c.pods))
+                for c in results.new_node_claims
+            )
+
+        base = run()
+        monkeypatch.setattr(Topology, "admissible_by_key", lambda self, pod, pr: None)
+        off = run()
+        assert base == off
