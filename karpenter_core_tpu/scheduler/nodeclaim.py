@@ -8,6 +8,8 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..apis import labels as wk
 from ..apis.nodeclaim import NodeClaim, NodeClaimResources, NodeClaimSpec
 from ..apis.nodepool import NodePool
@@ -254,7 +256,7 @@ def filter_instance_types_by_requirements(
     profile; the exact per-type loop remains as the fallback for
     shapes the bridge doesn't vectorize (Gt/Lt bounds, unregistered
     type lists)."""
-    from ..solver.oracle_bridge import fast_filter
+    from ..solver.oracle_bridge import fast_filter, register_filtered
 
     results = FilterResults(requests=requests)
     vec = fast_filter(instance_types, requirements, requests)
@@ -267,9 +269,8 @@ def filter_instance_types_by_requirements(
         results.requirements_and_offering = bool((compat & offering & ~fits).any())
         results.fits_and_offering = bool((fits & offering & ~compat).any())
         keep = compat & fits & offering
-        results.remaining = [
-            it for j, it in enumerate(instance_types) if keep[j]
-        ]
+        results.remaining = [instance_types[j] for j in np.flatnonzero(keep)]
+        register_filtered(instance_types, keep, results.remaining)
         return results
     for it in instance_types:
         it_compat = _compatible(it, requirements)

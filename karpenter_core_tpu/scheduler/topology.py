@@ -303,6 +303,11 @@ class Topology:
         self.topologies: Dict[tuple, TopologyGroup] = {}
         self.inverse_topologies: Dict[tuple, TopologyGroup] = {}
         self._owner_index: Dict[str, List[TopologyGroup]] = {}
+        # (namespace, labels) → groups selecting such pods, invalidated
+        # by generation when a group is registered: record() runs per
+        # landed pod and a full selector scan there dominated profiles
+        self._select_cache: Dict[tuple, Tuple[int, List[TopologyGroup]]] = {}
+        self._groups_generation = 0
         # pods being scheduled don't count against existing topologies
         # (topology.go:71-75)
         self.excluded_pods: Set[str] = {p.uid for p in pods}
@@ -315,7 +320,9 @@ class Topology:
     def update(self, pod: Pod) -> None:
         """(Re)register the pod as owner of its constraint groups; called
         after relaxation to drop stale ownership (topology.go:91)."""
-        for tg in self.topologies.values():
+        # ownership only ever lands via this method, which also indexes
+        # it — so the index is a complete view for removal
+        for tg in self._owner_index.get(pod.uid, ()):
             tg.remove_owner(pod.uid)
 
         if podutils.has_pod_anti_affinity(pod):
@@ -332,6 +339,7 @@ class Topology:
             if existing is None:
                 self._count_domains(tg)
                 self.topologies[key] = tg
+                self._groups_generation += 1
             else:
                 tg = existing
             tg.add_owner(pod.uid)
@@ -341,12 +349,25 @@ class Topology:
         # dominated the diverse-mix profile
         self._owner_index[pod.uid] = list(owned.values())
 
+    def _groups_selecting(self, pod: Pod) -> List[TopologyGroup]:
+        """Groups whose selector/namespaces match the pod, cached per
+        (namespace, labels) — selects() depends on nothing else."""
+        key = (pod.namespace, tuple(sorted(pod.metadata.labels.items())))
+        hit = self._select_cache.get(key)
+        if hit is not None and hit[0] == self._groups_generation:
+            return hit[1]
+        out = [tg for tg in self.topologies.values() if tg.selects(pod)]
+        if len(self._select_cache) > 4096:
+            self._select_cache.clear()
+        self._select_cache[key] = (self._groups_generation, out)
+        return out
+
     def record(
         self, pod: Pod, requirements: Requirements, allow_undefined: AbstractSet[str] = frozenset()
     ) -> None:
         """Commit domain counts once the pod lands (topology.go:125)."""
-        for tg in self.topologies.values():
-            if tg.counts(pod, requirements, allow_undefined):
+        for tg in self._groups_selecting(pod):
+            if tg.node_filter.matches_requirements(requirements, allow_undefined):
                 domains = requirements.get_req(tg.key)
                 if tg.type == TOPOLOGY_TYPE_POD_ANTI_AFFINITY:
                     tg.record(*sorted(domains.values))
@@ -415,12 +436,12 @@ class Topology:
 
         for tg in self._owner_index.get(pod.uid, ()):
             fold(tg)
+        # inverse groups are always anti-affinity with an empty node
+        # filter (built that way in _update_inverse_anti_affinity), so
+        # their per-claim membership reduces to selects(pod)
         for tg in self.inverse_topologies.values():
-            if tg.node_filter.requirements:
-                continue  # claim-dependent membership: cannot prefilter
-            if not tg.selects(pod):
-                continue
-            fold(tg)
+            if tg.selects(pod):
+                fold(tg)
         return result
 
     # -- internals ---------------------------------------------------------

@@ -126,6 +126,25 @@ def _alloc_milli(enc) -> Tuple[np.ndarray, Dict[str, int], np.ndarray]:
     return cached
 
 
+def register_filtered(parent: List, keep: np.ndarray, remaining: List) -> None:
+    """Pre-register the row mapping for a filtered sublist of `parent`.
+
+    A claim commits ``filtered.remaining`` (a NEW list object) after
+    every successful add, so without this the next filter call pays an
+    O(T) identity-map walk to re-resolve rows — at the diverse mix that
+    walk was ~5M id() lookups per solve. The child's rows are just the
+    parent's rows masked by `keep`."""
+    if len(remaining) < 32:
+        return  # fast_filter bails below 32 types: entry would be dead
+    cached = _LIST_ROWS.get(id(parent))
+    if cached is None or cached[2] is not parent:
+        return
+    if len(_LIST_ROWS) > _LIST_ROWS_MAX:
+        _LIST_ROWS.clear()
+        return  # parent mapping gone too; next call re-resolves both
+    _LIST_ROWS[id(remaining)] = (cached[0], cached[1][keep], remaining)
+
+
 def fast_filter(
     instance_types: List, requirements: Requirements, requests: Dict[str, int]
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
