@@ -117,6 +117,10 @@ def screen_singles(ctx, candidates: List[Candidate]) -> np.ndarray:
     simulation."""
     if not candidates:
         return np.zeros(0, dtype=bool)
+    from ..solver.backend import default_backend
+
+    default_backend()  # pin/probe BEFORE any jnp op: a dead TPU plugin
+    # must cost a bounded probe timeout + CPU fallback, not a hung loop
     candidate_names, axis, loads, free = _encode_candidates(candidates)
     fleet_free = _fleet_free(ctx, axis, candidate_names)
     new_node_cap = _largest_launchable(ctx, axis)
@@ -174,6 +178,9 @@ def repack_prefixes(ctx, candidates: List[Candidate]) -> int:
 
     if len(candidates) < 2:
         return 0
+    from ..solver.backend import default_backend
+
+    default_backend()  # see screen_singles: resolve before any device op
     candidate_names = {c.name() for c in candidates}
     pods_per_candidate = [
         [p for p in (c.pods or []) if podutils.is_reschedulable(p)] for c in candidates
@@ -246,6 +253,9 @@ def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
     """Largest prefix size (≥0) that passes the capacity screen."""
     if len(candidates) < 2:
         return 0
+    from ..solver.backend import default_backend
+
+    default_backend()  # see screen_singles: resolve before any jnp op
     candidate_names, axis, loads, free = _encode_candidates(candidates)
 
     fleet_free = _fleet_free(ctx, axis, candidate_names)

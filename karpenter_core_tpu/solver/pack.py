@@ -177,6 +177,10 @@ def run_pack_existing(
             return assign, free
         if engine == "native":
             raise RuntimeError("native packer requested but unavailable")
+    from .backend import default_backend
+
+    default_backend()  # device boundary: pin/probe before the first jnp
+    # op so a dead TPU plugin costs a bounded fallback, not a hang
     assign, free_out = pack_existing(
         jnp.asarray(requests),
         jnp.asarray(sig_ids),
@@ -266,6 +270,9 @@ def batch_pack(jobs: list, engine: str = "auto", mesh=None) -> list:
     if mesh is not None:
         # no native packer in this deployment: shard the device scan
         return _batch_pack_sharded(mesh, jobs)
+    from .backend import default_backend
+
+    default_backend()  # device boundary (see run_pack_existing)
     R = jobs[0][0].shape[1]
     F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
     classes: dict = {}
