@@ -15,6 +15,7 @@ from ..scheduling import Requirement
 from ..kube.objects import OP_IN
 from .helpers import (
     CandidateDeletingError,
+    _blocked,
     filter_by_price,
     filter_candidates,
     get_candidate_prices,
@@ -61,14 +62,35 @@ class ConditionMethod(Method):
         nc = candidate.state_node.node_claim
         return nc is not None and nc.status_condition_is_true(self.condition)
 
+    def _condition_time(self, candidate: Candidate) -> float:
+        nc = candidate.state_node.node_claim
+        cond = nc.get_condition(self.condition) if nc is not None else None
+        return cond.last_transition_time if cond is not None else 0.0
+
     def compute_command(self, candidates: List[Candidate]) -> Command:
         candidates = filter_candidates(self.ctx.kube_client, self.ctx.recorder, candidates)
         if not candidates:
             return Command()
+        # earliest condition transition disrupts first — "most expired" /
+        # "earliest drifted" (drift.go:62-71, expiration.go:66-75)
+        candidates.sort(key=self._condition_time)
         if not self.needs_replacement:
             return Command(candidates=candidates)
-        # disrupt candidates one at a time, launching replacement capacity
-        # for displaced pods (expiration.go:80-123, drift.go:75-121)
+        # all EMPTY candidates disrupt in one command — they need no
+        # scheduling simulation (drift.go:86-93, expiration.go:90-97;
+        # the reference's candidate pods pre-exclude daemonset/node-owned
+        # pods, node.go:40-46 — ours hold all active pods, so filter here)
+        from ..utils import pod as podutils
+
+        empty = [
+            c
+            for c in candidates
+            if not any(podutils.is_reschedulable(p) for p in c.pods)
+        ]
+        if empty:
+            return Command(candidates=empty)
+        # non-empty: one at a time, launching replacement capacity for
+        # displaced pods (expiration.go:80-123, drift.go:75-121)
         for candidate in candidates:
             try:
                 results = simulate_scheduling(
@@ -77,6 +99,11 @@ class ConditionMethod(Method):
             except CandidateDeletingError:
                 continue
             if not results.all_non_pending_pods_scheduled():
+                _blocked(
+                    self.ctx.recorder,
+                    candidate,
+                    "Scheduling simulation failed to schedule all pods",
+                )
                 continue
             return Command(candidates=[candidate], replacements=results.new_node_claims)
         return Command()

@@ -239,3 +239,94 @@ class TestTpuScreens:
         # the 4-cpu daemonset load must not be counted: both candidates'
         # RESCHEDULABLE load (100m) fits the other node's free capacity
         assert feasible.all(), feasible
+
+
+class TestConditionMethodSemantics:
+    """Ports of drift_test.go / expiration_test.go ordering + batching
+    specs: empty candidates disrupt in parallel without simulation,
+    non-empty ones one at a time starting from the earliest condition
+    transition, skipping (with a Blocked event) any whose pods can't
+    reschedule."""
+
+    def _candidates(self, env, method):
+        assert env.cluster.synced()
+        return get_candidates(
+            env.cluster, env.kube, env.recorder, env.clock, env.provider,
+            method.should_disrupt,
+        )
+
+    def _mark(self, env, nc, condition, when):
+        nc.set_condition(condition, "True")
+        nc.get_condition(condition).last_transition_time = when
+        env.kube.apply(nc)
+
+    @pytest.mark.parametrize("condition,method_name", [
+        (COND_DRIFTED, "drift"), (COND_EXPIRED, "expiration"),
+    ])
+    def test_all_empty_candidates_disrupt_in_parallel(self, env, condition, method_name):
+        from karpenter_core_tpu.disruption.methods import Drift, Expiration
+
+        method = {"drift": Drift, "expiration": Expiration}[method_name](env.controller.ctx)
+        empty_names = set()
+        for _ in range(3):
+            node, nc = env.make_initialized_node()
+            self._mark(env, nc, condition, env.now)
+            empty_names.add(node.name)
+        # daemonset-only nodes count as empty too (node.go:40-46: the
+        # reference's candidate pods exclude daemonset-owned pods)
+        ds_node, ds_nc = env.make_initialized_node(
+            pods=[make_pod(requests={"cpu": "100m"}, owner_kind="DaemonSet",
+                           phase="Running", pending_unschedulable=False)]
+        )
+        self._mark(env, ds_nc, condition, env.now)
+        empty_names.add(ds_node.name)
+        busy_node, busy_nc = env.make_initialized_node(pods=[running_pod()])
+        self._mark(env, busy_nc, condition, env.now - 1000)  # earliest transition
+        cmd = method.compute_command(self._candidates(env, method))
+        # the empties win as a batch even though the busy node drifted first
+        assert {c.state_node.node.name for c in cmd.candidates} == empty_names
+        assert not cmd.replacements
+
+    @pytest.mark.parametrize("condition,method_name", [
+        (COND_DRIFTED, "drift"), (COND_EXPIRED, "expiration"),
+    ])
+    def test_earliest_transition_disrupts_first(self, env, condition, method_name):
+        from karpenter_core_tpu.disruption.methods import Drift, Expiration
+
+        method = {"drift": Drift, "expiration": Expiration}[method_name](env.controller.ctx)
+        late_node, late_nc = env.make_initialized_node(pods=[running_pod()])
+        early_node, early_nc = env.make_initialized_node(pods=[running_pod()])
+        self._mark(env, late_nc, condition, env.now)
+        self._mark(env, early_nc, condition, env.now - 5000)
+        cmd = method.compute_command(self._candidates(env, method))
+        assert len(cmd.candidates) == 1
+        assert cmd.candidates[0].state_node.node.name == early_node.name
+
+    def test_unschedulable_candidate_skipped_with_blocked_event(self, env):
+        from karpenter_core_tpu.disruption.methods import Drift
+
+        method = Drift(env.controller.ctx)
+        # earliest candidate's pod can never reschedule (larger than any type)
+        stuck_node, stuck_nc = env.make_initialized_node(
+            instance_type_name="fake-it-9", pods=[running_pod(cpu="11")]
+        )
+        ok_node, ok_nc = env.make_initialized_node(pods=[running_pod()])
+        self._mark(env, stuck_nc, COND_DRIFTED, env.now - 5000)
+        self._mark(env, ok_nc, COND_DRIFTED, env.now)
+        cmd = method.compute_command(self._candidates(env, method))
+        assert len(cmd.candidates) == 1
+        assert cmd.candidates[0].state_node.node.name == ok_node.name
+        assert any(
+            "failed to schedule all pods" in (e.message or "")
+            for e in env.recorder.events
+        )
+
+    def test_condition_false_or_absent_not_candidate(self, env):
+        from karpenter_core_tpu.disruption.methods import Drift
+
+        method = Drift(env.controller.ctx)
+        node_f, nc_f = env.make_initialized_node()
+        nc_f.set_condition(COND_DRIFTED, "False")
+        env.kube.apply(nc_f)
+        env.make_initialized_node()  # no condition at all
+        assert self._candidates(env, method) == []
