@@ -330,3 +330,101 @@ class TestConditionMethodSemantics:
         env.kube.apply(nc_f)
         env.make_initialized_node()  # no condition at all
         assert self._candidates(env, method) == []
+
+
+class TestConsolidationPricing:
+    """Ports of consolidation_test.go price-sanity specs: a replacement
+    must be strictly cheaper, and spot nodes are never replaced with
+    spot (consolidation.go:142-169)."""
+
+    def test_spot_node_not_replaced_with_spot(self, env):
+        # lone spot node: pods can't fit elsewhere, so only the replace
+        # path is available — and spot→spot replacement is disallowed
+        env.make_initialized_node("fake-it-4", capacity_type="spot",
+                                  pods=[running_pod()])
+        assert env.cluster.synced()
+        executed = env.controller.reconcile()
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert executed is None and not marked
+
+    def test_on_demand_node_replaced_with_cheaper(self, env):
+        env.make_initialized_node("fake-it-4", pods=[running_pod()])
+        assert env.cluster.synced()
+        executed = env.controller.reconcile()
+        assert executed == "consolidation"
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert len(marked) == 1
+        new_claims = [
+            c for c in env.kube.list("NodeClaim")
+            if not c.status_condition_is_true(COND_INITIALIZED)
+        ]
+        assert len(new_claims) == 1
+
+    def test_no_cheaper_type_no_action(self, env):
+        # lone node already on the cheapest type: filter_by_price keeps
+        # only STRICTLY cheaper offerings, so nothing qualifies
+        env.make_initialized_node("fake-it-0", pods=[running_pod()])
+        assert env.cluster.synced()
+        executed = env.controller.reconcile()
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert executed is None and not marked
+
+
+class TestConsolidationBlockers:
+    """consolidation_test.go: deletes that would violate scheduling
+    constraints or pick up blocking pods during the TTL wait must not
+    happen."""
+
+    def test_anti_affinity_blocks_delete(self, env):
+        from karpenter_core_tpu.kube.objects import PodAffinityTerm
+
+        def iso_pod():
+            return make_pod(
+                requests={"cpu": "100m"},
+                labels={"app": "iso"},
+                pod_anti_affinity=[PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "iso"}),
+                )],
+                pending_unschedulable=False,
+            )
+
+        # cheapest type: a replacement can never be cheaper, so DELETE is
+        # the only possible action — and anti-affinity forbids it
+        env.make_initialized_node("fake-it-0", pods=[iso_pod()])
+        env.make_initialized_node("fake-it-0", pods=[iso_pod()])
+        assert env.cluster.synced()
+        executed = env.controller.reconcile()
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert executed is None and not marked
+
+    def test_without_anti_affinity_same_shape_deletes(self, env):
+        env.make_initialized_node("fake-it-0", pods=[running_pod()])
+        env.make_initialized_node("fake-it-0", pods=[running_pod()])
+        assert env.cluster.synced()
+        executed = env.controller.reconcile()
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert executed == "consolidation" and len(marked) == 1
+
+    def test_do_not_disrupt_pod_during_ttl_wait_aborts(self, env):
+        node, nc = env.make_initialized_node("fake-it-4", pods=[running_pod()])
+        # the big node carries a pod, so EmptyNodeConsolidation skips it
+        # and SingleNodeConsolidation's validate() is the path that runs
+        env.make_initialized_node("fake-it-9", pods=[running_pod()])
+        assert env.cluster.synced()
+
+        def schedule_blocker(_ttl):
+            blocker = make_pod(
+                annotations={wk.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"},
+                requests={"cpu": "100m"},
+                pending_unschedulable=False,
+            )
+            blocker.spec.node_name = node.name
+            blocker.status.phase = "Running"
+            blocker.status.conditions = []
+            env.kube.create(blocker)
+
+        env.controller.ctx.validation_sleep = schedule_blocker
+        executed = env.controller.reconcile()
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert not any(n.node_claim is not None and n.node_claim.name == nc.name for n in marked)
