@@ -213,3 +213,130 @@ class TestStateNodeDeepCopyIsolation:
 
         cp.update_for_pod(make_pod(requests={"cpu": "1"}))
         assert not sn.pod_requests
+
+
+class TestClusterStateSemantics:
+    """Ports of state/suite_test.go behaviors: terminal pods release
+    usage, nominations expire, anti-affinity tracking is required-only,
+    late provider-id registration re-keys the node, and daemonset
+    requests are accounted separately."""
+
+    def test_terminal_pod_releases_usage(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node(labels={wk.NODEPOOL_LABEL_KEY: "default"},
+                         capacity={"cpu": "4", "memory": "8Gi", "pods": "10"},
+                         provider_id="fake:///t1")
+        kube.create(node)
+        pod = make_pod(requests={"cpu": "1"}, node_name=node.name,
+                       phase="Running", pending_unschedulable=False)
+        kube.create(pod)
+        state = cluster.deep_copy_nodes()[0]
+        assert state.pod_request_total().get("cpu") == parse_quantity("1")
+        pod.status.phase = "Succeeded"
+        kube.apply(pod)
+        state = cluster.deep_copy_nodes()[0]
+        assert state.pod_request_total().get("cpu", 0) == 0
+
+    def test_nomination_expires(self):
+        from helpers import Env
+
+        e = Env()
+        try:
+            node = make_node(provider_id="fake:///n1")
+            e.kube.create(node)
+            e.cluster.nominate_node_for_pod("fake:///n1")
+            assert e.cluster.is_node_nominated("fake:///n1")
+            e.now += 21.0  # past the 20s nomination window
+            assert not e.cluster.is_node_nominated("fake:///n1")
+        finally:
+            e.stop()
+
+    def test_anti_affinity_tracking_required_only(self, env):
+        from karpenter_core_tpu.kube.objects import (
+            Affinity,
+            LabelSelector,
+            PodAffinityTerm,
+            PodAntiAffinity,
+            WeightedPodAffinityTerm,
+        )
+
+        kube, _, cluster, _, _ = env
+        node = make_node(provider_id="fake:///a1")
+        kube.create(node)
+
+        def seen():
+            out = []
+            cluster.for_pods_with_anti_affinity(lambda p, n: (out.append(p.metadata.name), True)[1])
+            return sorted(out)
+
+        required = make_pod(
+            name="req-anti", node_name=node.name, phase="Running",
+            pending_unschedulable=False,
+            pod_anti_affinity=[PodAffinityTerm(
+                topology_key=wk.LABEL_HOSTNAME,
+                label_selector=LabelSelector(match_labels={"a": "b"}))],
+        )
+        kube.create(required)
+        preferred = make_pod(name="pref-anti", node_name=node.name, phase="Running",
+                             pending_unschedulable=False)
+        preferred.spec.affinity = Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                preferred=[WeightedPodAffinityTerm(
+                    weight=1,
+                    pod_affinity_term=PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"a": "b"})))],
+            )
+        )
+        kube.create(preferred)
+        assert seen() == ["req-anti"]
+        kube.delete("Pod", "req-anti", namespace=required.namespace)
+        assert seen() == []
+
+    def test_provider_id_registered_late(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node()  # no provider id yet
+        node.spec.provider_id = ""
+        kube.create(node)
+        pod = make_pod(requests={"cpu": "1"}, node_name=node.name,
+                       phase="Running", pending_unschedulable=False)
+        kube.create(pod)
+        # keyed by name until registration
+        assert len(cluster.deep_copy_nodes()) == 1
+        node.spec.provider_id = "fake:///late"
+        kube.apply(node)
+        states = cluster.deep_copy_nodes()
+        assert len(states) == 1  # no leaked duplicate under the name key
+        assert states[0].provider_id() == "fake:///late"
+        # usage carried across the re-key
+        assert states[0].pod_request_total().get("cpu") == parse_quantity("1")
+
+    def test_daemonset_requests_tracked_separately(self, env):
+        kube, _, cluster, _, _ = env
+        node = make_node(provider_id="fake:///d1")
+        kube.create(node)
+        ds_pod = make_pod(requests={"cpu": "500m"}, node_name=node.name,
+                          owner_kind="DaemonSet", phase="Running",
+                          pending_unschedulable=False)
+        kube.create(ds_pod)
+        app_pod = make_pod(requests={"cpu": "1"}, node_name=node.name,
+                           phase="Running", pending_unschedulable=False)
+        kube.create(app_pod)
+        state = cluster.deep_copy_nodes()[0]
+        assert state.daemonset_request_total().get("cpu") == parse_quantity("500m")
+        assert state.pod_request_total().get("cpu") == parse_quantity("1500m")
+
+    def test_nodepool_update_changes_consolidation_state(self):
+        from helpers import Env
+
+        e = Env()
+        try:
+            np_ = make_nodepool("np-consol")
+            e.kube.create(np_)
+            before = e.cluster.consolidation_state()
+            e.now += 1.0  # deterministic clock tick, no wall-clock sleep
+            np_.spec.weight = 7
+            e.kube.apply(np_)
+            assert e.cluster.consolidation_state() != before
+        finally:
+            e.stop()
