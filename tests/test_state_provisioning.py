@@ -17,6 +17,17 @@ from karpenter_core_tpu.state.informers import Informers
 
 
 @pytest.fixture
+def clock_env():
+    """Full Env (controllable e.now clock) for tests that need
+    deterministic time."""
+    from helpers import Env
+
+    e = Env()
+    yield e
+    e.stop()
+
+
+@pytest.fixture
 def env():
     kube = KubeClient()
     provider = FakeCloudProvider()
@@ -237,19 +248,14 @@ class TestClusterStateSemantics:
         state = cluster.deep_copy_nodes()[0]
         assert state.pod_request_total().get("cpu", 0) == 0
 
-    def test_nomination_expires(self):
-        from helpers import Env
-
-        e = Env()
-        try:
-            node = make_node(provider_id="fake:///n1")
-            e.kube.create(node)
-            e.cluster.nominate_node_for_pod("fake:///n1")
-            assert e.cluster.is_node_nominated("fake:///n1")
-            e.now += 21.0  # past the 20s nomination window
-            assert not e.cluster.is_node_nominated("fake:///n1")
-        finally:
-            e.stop()
+    def test_nomination_expires(self, clock_env):
+        e = clock_env
+        node = make_node(provider_id="fake:///n1")
+        e.kube.create(node)
+        e.cluster.nominate_node_for_pod("fake:///n1")
+        assert e.cluster.is_node_nominated("fake:///n1")
+        e.now += 21.0  # past the 20s nomination window
+        assert not e.cluster.is_node_nominated("fake:///n1")
 
     def test_anti_affinity_tracking_required_only(self, env):
         from karpenter_core_tpu.kube.objects import (
@@ -326,17 +332,12 @@ class TestClusterStateSemantics:
         assert state.daemonset_request_total().get("cpu") == parse_quantity("500m")
         assert state.pod_request_total().get("cpu") == parse_quantity("1500m")
 
-    def test_nodepool_update_changes_consolidation_state(self):
-        from helpers import Env
-
-        e = Env()
-        try:
-            np_ = make_nodepool("np-consol")
-            e.kube.create(np_)
-            before = e.cluster.consolidation_state()
-            e.now += 1.0  # deterministic clock tick, no wall-clock sleep
-            np_.spec.weight = 7
-            e.kube.apply(np_)
-            assert e.cluster.consolidation_state() != before
-        finally:
-            e.stop()
+    def test_nodepool_update_changes_consolidation_state(self, clock_env):
+        e = clock_env
+        np_ = make_nodepool("np-consol")
+        e.kube.create(np_)
+        before = e.cluster.consolidation_state()
+        e.now += 1.0  # deterministic clock tick, no wall-clock sleep
+        np_.spec.weight = 7
+        e.kube.apply(np_)
+        assert e.cluster.consolidation_state() != before
