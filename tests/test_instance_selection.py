@@ -125,19 +125,21 @@ class TestCheapestInstanceSelection:
         # fake prices ignore arch/os/zone, so price parity alone can't
         # catch a wrong-dimension pick: every surviving option must
         # satisfy the combined constraints outright
+        offering_keys = (wk.LABEL_TOPOLOGY_ZONE, wk.CAPACITY_TYPE_LABEL_KEY)
         for it in claim.instance_type_options:
             for key, allowed in constraints.items():
-                if key in (wk.LABEL_TOPOLOGY_ZONE, wk.CAPACITY_TYPE_LABEL_KEY):
-                    assert any(
-                        (o.zone in constraints.get(wk.LABEL_TOPOLOGY_ZONE, [o.zone]))
-                        and (o.capacity_type in constraints.get(wk.CAPACITY_TYPE_LABEL_KEY, [o.capacity_type]))
-                        for o in it.offerings.available()
-                    ), (it.name, key)
-                else:
-                    assert any(it.requirements.get_req(key).has(v) for v in allowed), (
-                        it.name,
-                        key,
-                    )
+                if key in offering_keys:
+                    continue  # offering-scoped: checked once below
+                assert any(it.requirements.get_req(key).has(v) for v in allowed), (
+                    it.name,
+                    key,
+                )
+            if any(k in constraints for k in offering_keys):
+                assert any(
+                    (o.zone in constraints.get(wk.LABEL_TOPOLOGY_ZONE, [o.zone]))
+                    and (o.capacity_type in constraints.get(wk.CAPACITY_TYPE_LABEL_KEY, [o.capacity_type]))
+                    for o in it.offerings.available()
+                ), (it.name, "zone/capacity-type offerings")
 
     @pytest.mark.parametrize("pod_sel", [
         {wk.LABEL_ARCH: "arm"},  # no such arch in the catalog

@@ -317,3 +317,136 @@ class TestEndToEndSlice:
         # no more pending pods → provisioner goes quiet
         names2, _ = provisioner.reconcile()
         assert not names2
+
+
+class TestRegistrationSync:
+    """Ports of registration_test.go sync specs: labels, annotations,
+    taints, startup taints, owner ref, and the registered label all
+    propagate to the Node exactly once — removed startup taints are not
+    re-synced after registration."""
+
+    def _launched(self, kube, provider, recorder, **claim_kwargs):
+        lc = NodeClaimLifecycleController(kube, provider, recorder)
+        nc = make_claim(kube, **claim_kwargs)
+        nc.metadata.annotations["custom/anno"] = "v"
+        nc.metadata.labels["custom-label"] = "w"
+        lc.reconcile(nc)  # launch
+        return lc, nc
+
+    def test_node_sync_on_registration(self, env):
+        kube, provider, _, recorder = env
+        lc, nc = self._launched(
+            kube, provider, recorder,
+            startup_taints=[Taint(key="boot", effect="NoSchedule")],
+        )
+        nc.spec.taints = [Taint(key="dedicated", value="gpu", effect="NoSchedule")]
+        node = join_node_for_claim(kube, nc)
+        node.spec.taints = []  # kubelet joined without the taints
+        kube.apply(node)
+        lc.reconcile(nc)  # registration pass
+        node = kube.get("Node", node.name)
+        assert node.metadata.labels["custom-label"] == "w"
+        assert node.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] == "true"
+        assert node.metadata.annotations["custom/anno"] == "v"
+        assert any(t.key == "dedicated" for t in node.spec.taints)
+        assert any(t.key == "boot" for t in node.spec.taints)
+        assert wk.TERMINATION_FINALIZER in node.metadata.finalizers
+        owners = node.metadata.owner_references
+        assert len(owners) == 1 and owners[0].kind == "NodeClaim" and owners[0].name == nc.name
+        assert nc.status_condition_is_true(COND_REGISTERED)
+
+    def test_startup_taints_not_resynced_after_removal(self, env):
+        kube, provider, _, recorder = env
+        lc, nc = self._launched(
+            kube, provider, recorder,
+            startup_taints=[Taint(key="boot", effect="NoSchedule")],
+        )
+        node = join_node_for_claim(kube, nc)
+        lc.reconcile(nc)  # registration synced the startup taint
+        node = kube.get("Node", node.name)
+        assert any(t.key == "boot" for t in node.spec.taints)
+        # the startup system removes the taint; later reconciles must
+        # not add it back (sync runs only at registration)
+        node.spec.taints = [t for t in node.spec.taints if t.key != "boot"]
+        kube.apply(node)
+        lc.reconcile(nc)
+        node = kube.get("Node", node.name)
+        assert not any(t.key == "boot" for t in node.spec.taints)
+
+    def test_ephemeral_taint_blocks_initialization(self, env):
+        kube, provider, _, recorder = env
+        lc, nc = self._launched(kube, provider, recorder)
+        node = join_node_for_claim(kube, nc)
+        node.spec.taints = [Taint(key=wk.TAINT_NODE_NOT_READY, effect="NoSchedule")]
+        kube.apply(node)
+        lc.reconcile(nc)
+        assert nc.status_condition_is_true(COND_REGISTERED)
+        assert not nc.status_condition_is_true(COND_INITIALIZED)
+        node.spec.taints = []
+        kube.apply(node)
+        lc.reconcile(nc)
+        assert nc.status_condition_is_true(COND_INITIALIZED)
+
+    def test_extended_resource_gates_initialization(self, env):
+        from karpenter_core_tpu.cloudprovider.fake import new_instance_type
+        from karpenter_core_tpu.kube.quantity import parse_quantity
+
+        kube, provider, _, recorder = env
+        provider.instance_types = provider.instance_types + [
+            new_instance_type("gpu-it", {"cpu": "4", "memory": "8Gi", "nvidia.com/gpu": "2"})
+        ]
+        lc, nc = self._launched(
+            kube, provider, recorder,
+            requests={"nvidia.com/gpu": parse_quantity("1")},
+        )
+        node = join_node_for_claim(kube, nc)
+        node.status.allocatable.pop("nvidia.com/gpu", None)
+        kube.apply(node)
+        lc.reconcile(nc)
+        assert nc.status_condition_is_true(COND_REGISTERED)
+        assert not nc.status_condition_is_true(COND_INITIALIZED)
+        # device plugin registers the resource → initializes
+        node.status.allocatable["nvidia.com/gpu"] = parse_quantity("1")
+        kube.apply(node)
+        lc.reconcile(nc)
+        assert nc.status_condition_is_true(COND_INITIALIZED)
+
+    def test_liveness_spares_registered_claims(self, env):
+        kube, provider, _, recorder = env
+        fake_now = [1000.0]
+        lc = NodeClaimLifecycleController(kube, provider, recorder, clock=lambda: fake_now[0])
+        nc = make_claim(kube)
+        nc.metadata.creation_timestamp = 1000.0
+        lc.reconcile(nc)  # launch
+        join_node_for_claim(kube, nc)
+        lc.reconcile(nc)  # register
+        assert nc.status_condition_is_true(COND_REGISTERED)
+        fake_now[0] += 16 * 60  # past the 15 min registration TTL
+        lc.reconcile(nc)
+        assert kube.get("NodeClaim", nc.name) is not None
+
+
+class TestGcAndTerminationNegatives:
+    def test_gc_keeps_claim_while_instance_exists(self, env):
+        kube, provider, _, recorder = env
+        fake_now = [1000.0]
+        lc = NodeClaimLifecycleController(kube, provider, recorder, clock=lambda: fake_now[0])
+        nc = make_claim(kube)
+        lc.reconcile(nc)  # launch: provider holds the instance
+        nc.get_condition(COND_LAUNCHED).last_transition_time = fake_now[0]
+        fake_now[0] += 60.0  # past the 10s launch grace
+        gc = NodeClaimGarbageCollectionController(kube, provider, clock=lambda: fake_now[0])
+        assert gc.reconcile() == 0
+        assert kube.get("NodeClaim", nc.name) is not None
+
+    def test_unlaunched_claim_termination_skips_cloud_delete(self, env):
+        kube, provider, _, _ = env
+        nc = make_claim(kube)  # never launched: no provider id
+        nc.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        kube.delete(nc)
+        before = len(provider.delete_calls)
+        NodeClaimTerminationController(kube, provider).reconcile(
+            kube.get("NodeClaim", nc.name)
+        )
+        assert len(provider.delete_calls) == before
+        assert kube.get("NodeClaim", nc.name) is None

@@ -16,15 +16,9 @@ from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.state.informers import Informers
 
 
-@pytest.fixture
-def clock_env():
-    """Full Env (controllable e.now clock) for tests that need
-    deterministic time."""
-    from helpers import Env
-
-    e = Env()
-    yield e
-    e.stop()
+from conftest import env as clock_env  # noqa: F401 — full Env with a
+# controllable e.now clock, re-exported because this module's local
+# tuple-style `env` fixture shadows the conftest name
 
 
 @pytest.fixture
