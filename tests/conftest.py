@@ -38,3 +38,14 @@ def env():
     e = Env()
     yield e
     e.stop()
+
+
+@pytest.fixture
+def clock_env():
+    """helpers.Env under its deterministic-clock alias, for modules
+    whose local `env` fixture shadows the one above."""
+    from helpers import Env
+
+    e = Env()
+    yield e
+    e.stop()

@@ -423,7 +423,10 @@ class TestRegistrationSync:
         assert nc.status_condition_is_true(COND_REGISTERED)
         fake_now[0] += 16 * 60  # past the 15 min registration TTL
         lc.reconcile(nc)
-        assert kube.get("NodeClaim", nc.name) is not None
+        survivor = kube.get("NodeClaim", nc.name)
+        # finalizer-aware delete only stamps deletion_timestamp, so
+        # presence alone wouldn't catch a wrongful delete
+        assert survivor is not None and survivor.metadata.deletion_timestamp is None
 
 
 class TestGcAndTerminationNegatives:

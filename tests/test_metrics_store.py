@@ -1,0 +1,135 @@
+"""Metrics store/scraper specs (ports of pkg/metrics/suite_test.go and
+the node/nodepool/pod metrics controllers): series are created on
+scrape, replaced on state change, and deleted when the object
+disappears — no stale series leak."""
+
+from __future__ import annotations
+
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.quantity import parse_quantity
+from karpenter_core_tpu.metrics.registry import Metrics
+from karpenter_core_tpu.metrics.store import MetricsStore
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informers import Informers
+
+
+@pytest.fixture
+def cluster_env():
+    kube = KubeClient()
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(5)
+    cluster = Cluster(kube, provider)
+    informers = Informers(kube, cluster)
+    informers.start()
+    yield kube, cluster
+    informers.stop()
+
+
+def _series(gauge, **labels):
+    want = set(labels.items())
+    return [k for k in gauge.values if want <= set(k)]
+
+
+class TestNodeSeries:
+    def test_create_then_delete_on_node_removal(self, cluster_env):
+        kube, cluster = cluster_env
+        m = Metrics()
+        store = MetricsStore(m)
+        node = make_node(capacity={"cpu": "4", "memory": "8Gi", "pods": "10"},
+                         provider_id="fake:///m1")
+        kube.create(node)
+        store.scrape_nodes(cluster)
+        assert _series(m.node_allocatable, node=node.name)
+        kube.delete(node)
+        store.scrape_nodes(cluster)
+        assert not _series(m.node_allocatable, node=node.name)
+
+    def test_usage_series_update_with_pods(self, cluster_env):
+        kube, cluster = cluster_env
+        m = Metrics()
+        store = MetricsStore(m)
+        node = make_node(capacity={"cpu": "4", "memory": "8Gi", "pods": "10"},
+                         provider_id="fake:///m2")
+        kube.create(node)
+        pod = make_pod(requests={"cpu": "1"}, node_name=node.name,
+                       phase="Running", pending_unschedulable=False)
+        kube.create(pod)
+        store.scrape_nodes(cluster)
+        key = [k for k in _series(m.node_pod_requests, node=node.name)
+               if ("resource", "cpu") in k]
+        assert key and m.node_pod_requests.values[key[0]] == 1.0
+
+
+class TestNodePoolSeries:
+    def test_replace_and_delete(self):
+        kube = KubeClient()
+        m = Metrics()
+        store = MetricsStore(m)
+        np_ = make_nodepool("pool-a", limits={"cpu": "100"})
+        np_.status.resources = {"cpu": parse_quantity("10")}
+        kube.create(np_)
+        store.scrape_nodepools(kube)
+        lim = _series(m.nodepool_limit, nodepool="pool-a")
+        assert lim and m.nodepool_limit.values[lim[0]] == 100.0
+        # limit changes → same series replaced, not duplicated
+        np_.spec.limits = {"cpu": parse_quantity("50")}
+        kube.apply(np_)
+        store.scrape_nodepools(kube)
+        lim = _series(m.nodepool_limit, nodepool="pool-a")
+        assert len(lim) == 1 and m.nodepool_limit.values[lim[0]] == 50.0
+        kube.delete(np_)
+        store.scrape_nodepools(kube)
+        assert not _series(m.nodepool_limit, nodepool="pool-a")
+
+
+class TestPodSeries:
+    def test_phase_transition_replaces_series(self):
+        kube = KubeClient()
+        m = Metrics()
+        store = MetricsStore(m)
+        pod = make_pod(name="web-1", phase="Pending")
+        kube.create(pod)
+        store.scrape_pods(kube)
+        assert _series(m.pod_state, name="web-1", phase="Pending")
+        pod.status.phase = "Running"
+        pod.status.start_time = pod.metadata.creation_timestamp + 3.0
+        kube.apply(pod)
+        store.scrape_pods(kube)
+        # exactly one phase series: Pending gone, Running present
+        assert not _series(m.pod_state, name="web-1", phase="Pending")
+        assert _series(m.pod_state, name="web-1", phase="Running")
+
+    def test_startup_time_observed_once_until_recreated(self):
+        kube = KubeClient()
+        m = Metrics()
+        store = MetricsStore(m)
+        pod = make_pod(name="web-2", phase="Running", pending_unschedulable=False)
+        pod.status.start_time = pod.metadata.creation_timestamp + 2.0
+        kube.create(pod)
+        store.scrape_pods(kube)
+        store.scrape_pods(kube)
+        assert sum(m.pod_startup_time.totals.values()) == 1
+        # delete + recreate same name: observed again
+        kube.delete(pod)
+        store.scrape_pods(kube)
+        pod2 = make_pod(name="web-2", phase="Running", pending_unschedulable=False)
+        pod2.status.start_time = pod2.metadata.creation_timestamp + 4.0
+        kube.create(pod2)
+        store.scrape_pods(kube)
+        assert sum(m.pod_startup_time.totals.values()) == 2
+
+    def test_deleted_pod_series_removed(self):
+        kube = KubeClient()
+        m = Metrics()
+        store = MetricsStore(m)
+        pod = make_pod(name="web-3", phase="Pending")
+        kube.create(pod)
+        store.scrape_pods(kube)
+        assert _series(m.pod_state, name="web-3")
+        kube.delete(pod)
+        store.scrape_pods(kube)
+        assert not _series(m.pod_state, name="web-3")

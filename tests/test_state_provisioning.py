@@ -16,11 +16,6 @@ from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.state.informers import Informers
 
 
-from conftest import env as clock_env  # noqa: F401 — full Env with a
-# controllable e.now clock, re-exported because this module's local
-# tuple-style `env` fixture shadows the conftest name
-
-
 @pytest.fixture
 def env():
     kube = KubeClient()
