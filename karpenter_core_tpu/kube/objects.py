@@ -318,6 +318,7 @@ class PodSpec:
     overhead: ResourceList = field(default_factory=dict)
     volumes: List[Volume] = field(default_factory=list)
     priority: Optional[int] = None
+    priority_class_name: str = ""
     preemption_policy: str = "PreemptLowerPriority"
     scheduler_name: str = "default-scheduler"
 

@@ -115,20 +115,34 @@ class Terminator:
             p for p in self.kube_client.list("Pod") if p.spec.node_name == node.name
         ]
         draining = []
+        # graceful-node-shutdown eviction waves (terminator.go:113-146):
+        # (critical?, daemonset?) → pods, evicted one group per pass
+        waves = {
+            (False, False): [],
+            (False, True): [],
+            (True, False): [],
+            (True, True): [],
+        }
         for p in pods:
             if podutils.is_owned_by_node(p):
                 continue  # static pods
             if podutils.is_terminal(p):
                 continue
-            if podutils.tolerates_disruption_no_schedule_taint(p) and podutils.is_owned_by_daemonset(p):
-                continue  # daemonsets tolerating the taint stay until the end
+            if podutils.tolerates_disruption_no_schedule_taint(p):
+                # tolerating the disruption taint means "stay until node
+                # deletion" — never evicted, never blocks (terminator.go:91)
+                continue
             if podutils.is_terminating(p):
                 if self.clock() - p.metadata.deletion_timestamp > self.STUCK_TERMINATING:
                     continue  # stuck terminating; don't block forever
                 draining.append(p)
                 continue
-            self.eviction_queue.add(p)
             draining.append(p)
+            waves[(podutils.is_critical(p), podutils.is_owned_by_daemonset(p))].append(p)
+        for key in ((False, False), (False, True), (True, False), (True, True)):
+            if waves[key]:
+                self.eviction_queue.add(*waves[key])
+                break
         if draining:
             self.eviction_queue.reconcile()
             raise NodeDrainError(f"{len(draining)} pods are waiting to be evicted")

@@ -82,6 +82,14 @@ def tolerates_disruption_no_schedule_taint(pod: Pod) -> bool:
     return Taints([DISRUPTION_NO_SCHEDULE_TAINT]).tolerates(pod) is None
 
 
+def is_critical(pod: Pod) -> bool:
+    """System-critical priority classes (utils/pod/scheduling.go)."""
+    return pod.spec.priority_class_name in (
+        "system-cluster-critical",
+        "system-node-critical",
+    )
+
+
 def has_pod_anti_affinity(pod: Pod) -> bool:
     a = pod.spec.affinity
     return a is not None and a.pod_anti_affinity is not None and (

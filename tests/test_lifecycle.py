@@ -453,3 +453,87 @@ class TestGcAndTerminationNegatives:
         )
         assert len(provider.delete_calls) == before
         assert kube.get("NodeClaim", nc.name) is None
+
+
+class TestDrainSemantics:
+    """Ports of node/termination/suite_test.go drain specs: pods
+    tolerating the disruption taint are never evicted and never block
+    deletion; static pods are untouched; eviction proceeds in
+    graceful-shutdown waves (non-critical non-daemon first)."""
+
+    def _node_with(self, kube, provider, recorder, pods):
+        lc = NodeClaimLifecycleController(kube, provider, recorder)
+        nc = make_claim(kube)
+        lc.reconcile(nc)  # launch
+        node = join_node_for_claim(kube, nc)
+        lc.reconcile(nc)  # registration: adds the termination finalizer
+        node = kube.get("Node", node.name)
+        bind_pods_to_node(kube, node, *pods)
+        return node
+
+    @pytest.mark.parametrize("operator", ["Equal", "Exists"])
+    def test_tolerating_pods_not_evicted_and_not_blocking(self, env, operator):
+        from karpenter_core_tpu.kube.objects import Toleration
+
+        kube, provider, _, recorder = env
+        tol = (
+            Toleration(key=wk.DISRUPTION_TAINT_KEY, operator="Equal",
+                       value=wk.DISRUPTION_NO_SCHEDULE_VALUE, effect="NoSchedule")
+            if operator == "Equal"
+            else Toleration(key=wk.DISRUPTION_TAINT_KEY, operator="Exists")
+        )
+        pod = make_pod(tolerations=[tol], pending_unschedulable=False)
+        node = self._node_with(kube, provider, recorder, [pod])
+        eviction = EvictionQueue(kube, recorder)
+        ntc = NodeTerminationController(kube, provider, Terminator(kube, eviction), recorder)
+        kube.delete(node)
+        err = ntc.reconcile(kube.get("Node", node.name))
+        # the tolerating pod neither blocks the drain nor gets evicted
+        assert err is None
+        assert kube.get("Node", node.name) is None
+        assert kube.get("Pod", pod.metadata.name, namespace="default") is not None
+
+    def test_static_pods_not_evicted(self, env):
+        kube, provider, _, recorder = env
+        static = make_pod(pending_unschedulable=False, owner_kind="Node")
+        node = self._node_with(kube, provider, recorder, [static])
+        eviction = EvictionQueue(kube, recorder)
+        ntc = NodeTerminationController(kube, provider, Terminator(kube, eviction), recorder)
+        kube.delete(node)
+        err = ntc.reconcile(kube.get("Node", node.name))
+        assert err is None  # static pod doesn't block
+        assert kube.get("Node", node.name) is None
+        assert kube.get("Pod", static.metadata.name, namespace="default") is not None
+
+    def test_eviction_waves_noncritical_first(self, env):
+        kube, provider, _, recorder = env
+        app = make_pod(name="wave-app", pending_unschedulable=False)
+        daemon = make_pod(name="wave-daemon", owner_kind="DaemonSet",
+                          pending_unschedulable=False)
+        critical = make_pod(name="wave-critical", pending_unschedulable=False)
+        critical.spec.priority_class_name = "system-cluster-critical"
+        node = self._node_with(kube, provider, recorder, [app, daemon, critical])
+        eviction = EvictionQueue(kube, recorder)
+        terminator = Terminator(kube, eviction)
+        ntc = NodeTerminationController(kube, provider, terminator, recorder)
+        kube.delete(node)
+
+        err = ntc.reconcile(kube.get("Node", node.name))
+        assert err is not None
+        # wave 1: only the non-critical non-daemon pod is gone
+        assert kube.get("Pod", "wave-app", namespace="default") is None
+        assert kube.get("Pod", "wave-daemon", namespace="default") is not None
+        assert kube.get("Pod", "wave-critical", namespace="default") is not None
+
+        err = ntc.reconcile(kube.get("Node", node.name))
+        assert err is not None
+        # wave 2: the non-critical daemonset pod
+        assert kube.get("Pod", "wave-daemon", namespace="default") is None
+        assert kube.get("Pod", "wave-critical", namespace="default") is not None
+
+        err = ntc.reconcile(kube.get("Node", node.name))
+        assert err is not None
+        # wave 3: the critical pod
+        assert kube.get("Pod", "wave-critical", namespace="default") is None
+        assert ntc.reconcile(kube.get("Node", node.name)) is None
+        assert kube.get("Node", node.name) is None
