@@ -135,6 +135,10 @@ class Terminator:
             if podutils.is_terminating(p):
                 if self.clock() - p.metadata.deletion_timestamp > self.STUCK_TERMINATING:
                     continue  # stuck terminating; don't block forever
+                # still blocks the drain but does NOT occupy its wave:
+                # the next wave starts while this pod shuts down
+                # (terminator.go:115-117 skips terminating pods when
+                # grouping, deliberately — do not "fix" this)
                 draining.append(p)
                 continue
             draining.append(p)

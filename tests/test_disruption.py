@@ -428,3 +428,41 @@ class TestConsolidationBlockers:
         executed = env.controller.reconcile()
         marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
         assert not any(n.node_claim is not None and n.node_claim.name == nc.name for n in marked)
+
+
+class TestOrchestrationMultiReplacement:
+    def test_waits_for_all_replacements_initialized(self, env):
+        """orchestration/suite_test.go: a command only completes when
+        EVERY replacement claim is initialized."""
+        env.nodepool.spec.disruption.expire_after = 3600.0
+        env.kube.apply(env.nodepool)
+        # two 6-cpu pods can't share any single type (max 10 vcpu):
+        # expiring this node forces TWO replacement claims
+        node, nc = env.make_initialized_node(
+            "fake-it-9", pods=[running_pod(cpu="6"), running_pod(cpu="6")]
+        )
+        env.now += 3700
+        NodeClaimDisruptionController(
+            env.kube, env.provider, env.cluster, clock=env.clock
+        ).reconcile_all()
+        executed = env.controller.reconcile()
+        assert executed == "expiration"
+        replacements = [
+            c for c in env.kube.list("NodeClaim")
+            if c.name != nc.name and not c.status_condition_is_true(COND_INITIALIZED)
+        ]
+        assert len(replacements) == 2
+
+        def initialize(claim):
+            for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+                claim.set_condition(cond, "True")
+            env.kube.apply(claim)
+
+        initialize(replacements[0])
+        env.controller.queue.reconcile()
+        # one of two initialized: the original claim must still be alive
+        assert env.kube.get("NodeClaim", nc.name).metadata.deletion_timestamp is None
+        initialize(replacements[1])
+        env.controller.queue.reconcile()
+        gone = env.kube.get("NodeClaim", nc.name)
+        assert gone is None or gone.metadata.deletion_timestamp is not None

@@ -1,0 +1,84 @@
+"""Event recorder specs (ports of pkg/events/suite_test.go): dedupe
+window, override, per-entity keys, and rate limiting."""
+
+from __future__ import annotations
+
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.events import events as ev
+from karpenter_core_tpu.events.events import Event
+
+from helpers import make_node, make_pod
+
+
+def _recorder():
+    now = [10_000.0]
+    r = Recorder(clock=lambda: now[0])
+    return r, now
+
+
+class TestEventCreation:
+    def test_factory_events_have_reasons(self):
+        pod = make_pod()
+        node = make_node()
+        assert ev.nominate_pod(pod, node.name).reason == "Nominated"
+        assert ev.pod_failed_to_schedule(pod, "no capacity").reason == "FailedScheduling"
+        assert ev.node_failed_to_drain(node, RuntimeError("x")).reason == "FailedDraining"
+
+
+class TestDedupe:
+    def test_duplicates_within_window_collapse(self):
+        r, now = _recorder()
+        pod = make_pod()
+        for _ in range(5):
+            r.publish(ev.pod_failed_to_schedule(pod, "no capacity"))
+        assert len(r.find("FailedScheduling")) == 1
+        # past the 5 min window: a new event lands
+        now[0] += 301.0
+        r.publish(ev.pod_failed_to_schedule(pod, "no capacity"))
+        assert len(r.find("FailedScheduling")) == 2
+
+    def test_dedupe_timeout_override(self):
+        r, now = _recorder()
+        e1 = Event(reason="Custom", message="m", dedupe_timeout=10.0, dedupe_values=("a",))
+        r.publish(e1)
+        now[0] += 11.0
+        r.publish(Event(reason="Custom", message="m", dedupe_timeout=10.0, dedupe_values=("a",)))
+        assert len(r.find("Custom")) == 2
+
+    def test_different_entities_not_deduped(self):
+        r, _ = _recorder()
+        for name in ("p1", "p2", "p3"):
+            r.publish(ev.pod_failed_to_schedule(make_pod(name=name), "no capacity"))
+        assert len(r.find("FailedScheduling")) == 3
+
+
+class TestRateLimit:
+    def test_burst_capped_per_minute(self):
+        r, _ = _recorder()
+        for i in range(20):
+            r.publish(
+                Event(
+                    reason="Chatty",
+                    message="m",
+                    dedupe_values=(str(i),),  # distinct keys: dedupe passes
+                    rate_limit_per_minute=10,
+                )
+            )
+        assert len(r.find("Chatty")) == 10
+
+    def test_rate_smooths_over_time(self):
+        r, now = _recorder()
+        total = 0
+        for minute in range(3):
+            for i in range(15):
+                r.publish(
+                    Event(
+                        reason="Chatty",
+                        message="m",
+                        dedupe_values=(f"{minute}-{i}",),
+                        rate_limit_per_minute=10,
+                    )
+                )
+            total = len(r.find("Chatty"))
+            now[0] += 61.0
+        assert total == 30  # 10 per minute over 3 minutes
