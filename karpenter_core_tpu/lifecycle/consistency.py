@@ -21,14 +21,49 @@ class Check:
 
 
 class TerminationCheck(Check):
-    """termination.go:42: a deleting claim must carry the termination
-    finalizer — deletion without it means the instance may leak."""
+    """termination.go:41-59: report WHY a deleting claim is stuck — a
+    missing termination finalizer (instance may leak), or a PDB blocking
+    the node's drain."""
+
+    def __init__(self, kube_client=None):
+        self.kube_client = kube_client
+        self._pass: Optional[tuple] = None
+
+    def begin_pass(self) -> None:
+        """Snapshot PDBs + reschedulable pods once for a reconcile_all
+        scan — per-claim construction re-lists the whole cluster per
+        deleting claim, a redundant LIST burst during consolidation
+        waves."""
+        if self.kube_client is None:
+            return
+        self._pass = self._snapshot()
+
+    def end_pass(self) -> None:
+        self._pass = None
+
+    def _snapshot(self) -> tuple:
+        # deferred import: disruption.helpers imports from lifecycle
+        from ..disruption.helpers import PDBLimits
+        from ..utils import pod as podutils
+
+        pods_by_node: dict = {}
+        for p in self.kube_client.list("Pod"):
+            if p.spec.node_name and podutils.is_reschedulable(p):
+                pods_by_node.setdefault(p.spec.node_name, []).append(p)
+        return PDBLimits(self.kube_client), pods_by_node
 
     def check(self, node_claim: NodeClaim, node) -> List[str]:
-        if node_claim.metadata.deletion_timestamp is not None:
-            if wk.TERMINATION_FINALIZER not in node_claim.metadata.finalizers:
-                return ["nodeClaim is terminating without the termination finalizer"]
-        return []
+        if node_claim.metadata.deletion_timestamp is None:
+            return []
+        issues: List[str] = []
+        if wk.TERMINATION_FINALIZER not in node_claim.metadata.finalizers:
+            issues.append("nodeClaim is terminating without the termination finalizer")
+        if self.kube_client is not None and node is not None:
+            pdbs, pods_by_node = self._pass if self._pass is not None else self._snapshot()
+            pdb_name, ok = pdbs.can_evict_pods(pods_by_node.get(node.name, []))
+            if not ok:
+                issues.append(f"can't drain node, PDB {pdb_name} is blocking evictions")
+        return issues
 
 
 class NodeShapeCheck(Check):
@@ -57,7 +92,7 @@ class ConsistencyController:
     def __init__(self, kube_client, recorder=None, checks: Optional[List[Check]] = None, metrics=None):
         self.kube_client = kube_client
         self.recorder = recorder
-        self.checks = checks or [TerminationCheck(), NodeShapeCheck()]
+        self.checks = checks or [TerminationCheck(kube_client), NodeShapeCheck()]
         self.metrics = metrics
 
     def reconcile(self, node_claim: NodeClaim) -> List[str]:
@@ -79,7 +114,17 @@ class ConsistencyController:
         return issues
 
     def reconcile_all(self) -> List[str]:
-        out = []
-        for nc in self.kube_client.list("NodeClaim"):
-            out.extend(self.reconcile(nc))
-        return out
+        for check in self.checks:
+            begin = getattr(check, "begin_pass", None)
+            if begin is not None:
+                begin()
+        try:
+            out = []
+            for nc in self.kube_client.list("NodeClaim"):
+                out.extend(self.reconcile(nc))
+            return out
+        finally:
+            for check in self.checks:
+                end = getattr(check, "end_pass", None)
+                if end is not None:
+                    end()

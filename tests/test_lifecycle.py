@@ -537,3 +537,38 @@ class TestDrainSemantics:
         assert kube.get("Pod", "wave-critical", namespace="default") is None
         assert ntc.reconcile(kube.get("Node", node.name)) is None
         assert kube.get("Node", node.name) is None
+
+
+class TestConsistencyTermination:
+    def test_pdb_stuck_deletion_flagged(self, env):
+        """consistency/termination.go:41-59 port: a deleting claim whose
+        node can't drain because of a PDB is reported with the PDB name."""
+        from karpenter_core_tpu.kube.objects import LabelSelector, PodDisruptionBudget
+
+        kube, provider, _, recorder = env
+        lc = NodeClaimLifecycleController(kube, provider, recorder)
+        nc = make_claim(kube)
+        lc.reconcile(nc)
+        node = join_node_for_claim(kube, nc)
+        lc.reconcile(nc)
+        node = kube.get("Node", node.name)
+        guarded = make_pod(labels={"app": "guarded"}, pending_unschedulable=False)
+        bind_pods_to_node(kube, node, guarded)
+        pdb = PodDisruptionBudget(selector=LabelSelector(match_labels={"app": "guarded"}))
+        pdb.metadata.name = "guard"
+        pdb.disruptions_allowed = 0
+        kube.create(pdb)
+
+        # not deleting: no issue
+        assert ConsistencyController(kube, recorder).reconcile_all() == []
+        kube.delete(nc)  # finalizer keeps it terminating
+        issues = ConsistencyController(kube, recorder).reconcile_all()
+        assert any("guard" in i and "PDB" in i for i in issues), issues
+
+    def test_missing_finalizer_flagged(self, env):
+        kube, provider, _, recorder = env
+        nc = make_claim(kube)
+        nc.metadata.deletion_timestamp = 123.0  # deleting, no finalizer
+        kube.apply(nc)
+        issues = ConsistencyController(kube, recorder).reconcile_all()
+        assert any("finalizer" in i for i in issues)
