@@ -1,0 +1,140 @@
+"""Direct specs for the in-memory apiserver (kube/client.py) — the
+control-plane fake every controller test stands on. Pins the apiserver
+semantics the reference gets from envtest: resource versions, conflict
+on duplicate create, finalizer-aware delete, list+watch replay, and
+admission hooks."""
+
+from __future__ import annotations
+
+import pytest
+
+from helpers import make_pod
+from karpenter_core_tpu.kube.client import Conflict, KubeClient, NotFound
+from karpenter_core_tpu.kube.objects import LabelSelector
+
+
+class TestCrud:
+    def test_create_stamps_resource_version(self):
+        kube = KubeClient()
+        a = kube.create(make_pod(name="a"))
+        b = kube.create(make_pod(name="b"))
+        assert b.metadata.resource_version > a.metadata.resource_version > 0
+
+    def test_duplicate_create_conflicts(self):
+        kube = KubeClient()
+        kube.create(make_pod(name="a"))
+        with pytest.raises(Conflict):
+            kube.create(make_pod(name="a"))
+
+    def test_update_missing_raises(self):
+        kube = KubeClient()
+        with pytest.raises(NotFound):
+            kube.update(make_pod(name="ghost"))
+
+    def test_update_bumps_resource_version(self):
+        kube = KubeClient()
+        pod = kube.create(make_pod(name="a"))
+        rv = pod.metadata.resource_version
+        kube.update(pod)
+        assert pod.metadata.resource_version > rv
+
+    def test_list_filters(self):
+        kube = KubeClient()
+        kube.create(make_pod(name="x", labels={"app": "a"}))
+        kube.create(make_pod(name="y", labels={"app": "b"}))
+        sel = LabelSelector(match_labels={"app": "a"})
+        assert [p.metadata.name for p in kube.list("Pod", label_selector=sel)] == ["x"]
+        assert kube.list("Pod", namespace="other") == []
+        assert len(kube.list("Pod", filter_fn=lambda p: p.metadata.name == "y")) == 1
+
+
+class TestFinalizerDelete:
+    def test_delete_without_finalizer_removes(self):
+        kube = KubeClient()
+        pod = kube.create(make_pod(name="a"))
+        assert kube.delete(pod)
+        assert kube.get("Pod", "a", namespace=pod.namespace) is None
+
+    def test_delete_with_finalizer_marks_terminating(self):
+        kube = KubeClient()
+        pod = make_pod(name="a")
+        pod.metadata.finalizers.append("example.com/hold")
+        kube.create(pod)
+        assert kube.delete(pod)
+        held = kube.get("Pod", "a", namespace=pod.namespace)
+        assert held is not None and held.metadata.deletion_timestamp is not None
+        # idempotent: second delete is a no-op, same timestamp
+        ts = held.metadata.deletion_timestamp
+        assert kube.delete(pod)
+        assert kube.get("Pod", "a", namespace=pod.namespace).metadata.deletion_timestamp == ts
+
+    def test_remove_last_finalizer_completes_deletion(self):
+        kube = KubeClient()
+        pod = make_pod(name="a")
+        pod.metadata.finalizers.append("example.com/hold")
+        kube.create(pod)
+        kube.delete(pod)
+        kube.remove_finalizer(pod, "example.com/hold")
+        assert kube.get("Pod", "a", namespace=pod.namespace) is None
+
+    def test_remove_finalizer_without_deletion_keeps_object(self):
+        kube = KubeClient()
+        pod = make_pod(name="a")
+        pod.metadata.finalizers.append("example.com/hold")
+        kube.create(pod)
+        kube.remove_finalizer(pod, "example.com/hold")
+        assert kube.get("Pod", "a", namespace=pod.namespace) is not None
+
+
+class TestWatch:
+    def test_new_watch_replays_existing_as_added(self):
+        kube = KubeClient()
+        kube.create(make_pod(name="a"))
+        events = []
+        kube.watch("Pod", lambda ev, o: events.append((ev, o.metadata.name)))
+        assert ("ADDED", "a") in events
+
+    def test_watch_sees_lifecycle_events(self):
+        kube = KubeClient()
+        events = []
+        unsub = kube.watch("Pod", lambda ev, o: events.append((ev, o.metadata.name)))
+        pod = kube.create(make_pod(name="a"))
+        kube.update(pod)
+        kube.delete(pod)
+        assert events == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]
+        unsub()
+        kube.create(make_pod(name="b"))
+        assert ("ADDED", "b") not in events
+
+    def test_finalized_delete_emits_modified_then_deleted(self):
+        kube = KubeClient()
+        pod = make_pod(name="a")
+        pod.metadata.finalizers.append("example.com/hold")
+        kube.create(pod)
+        events = []
+        kube.watch("Pod", lambda ev, o: events.append(ev))
+        kube.delete(pod)  # -> MODIFIED (terminating)
+        kube.remove_finalizer(pod, "example.com/hold")  # -> DELETED
+        assert events[-2:] == ["MODIFIED", "DELETED"]
+
+
+class TestAdmission:
+    def test_admission_hook_runs_on_create_and_update(self):
+        kube = KubeClient()
+        seen = []
+        kube.admission.append(lambda o: seen.append(o.metadata.name))
+        pod = kube.create(make_pod(name="a"))
+        kube.update(pod)
+        assert seen == ["a", "a"]
+
+    def test_admission_rejection_blocks_create(self):
+        kube = KubeClient()
+
+        def reject(obj):
+            raise ValueError("denied")
+
+        kube.admission.append(reject)
+        with pytest.raises(ValueError):
+            kube.create(make_pod(name="a"))
+        kube.admission.clear()
+        assert kube.get("Pod", "a", namespace="default") is None
