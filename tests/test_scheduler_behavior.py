@@ -320,3 +320,61 @@ class TestInFlightReuse:
         assert not res2.pod_errors
         assert len(res2.new_node_claims) == 0
         assert len(res2.existing_nodes) == 1
+
+
+class TestAffinityNamespaceFiltering:
+    """topology_test.go:2244-2360 ports: a required pod-affinity term
+    only sees target pods in the pod's own namespace unless the term
+    lists namespaces or carries a namespace selector (empty selector =
+    all namespaces)."""
+
+    def _pods(self, term_namespaces=None, namespace_selector=None):
+        from helpers import make_pod
+
+        target = make_pod(
+            name="target", namespace="other-ns", labels={"security": "s2"}
+        )
+        term = PodAffinityTerm(
+            topology_key=wk.LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"security": "s2"}),
+            namespaces=term_namespaces or [],
+            namespace_selector=namespace_selector,
+        )
+        seeker = make_pod(name="seeker", namespace="default")
+        seeker.spec.affinity = Affinity(pod_affinity=PodAffinity(required=[term]))
+        return target, seeker
+
+    def _solve(self, provider, pods, kube=None):
+        s = build_scheduler(kube, None, [make_nodepool()], provider, pods)
+        results = s.solve(pods)
+        placed = {p.metadata.name for c in results.new_node_claims for p in c.pods}
+        return results, placed
+
+    def test_no_namespace_match_does_not_anchor(self, provider):
+        target, seeker = self._pods()
+        _, placed = self._solve(provider, [target, seeker])
+        assert "target" in placed
+        assert "seeker" not in placed  # target invisible across namespaces
+
+    def test_namespace_list_allows_match(self, provider):
+        target, seeker = self._pods(term_namespaces=["other-ns"])
+        results, placed = self._solve(provider, [target, seeker])
+        assert {"target", "seeker"} <= placed
+        # co-located: the affinity term pins both to one hostname
+        homes = {
+            p.metadata.name: id(c)
+            for c in results.new_node_claims
+            for p in c.pods
+        }
+        assert homes["target"] == homes["seeker"]
+
+    def test_empty_namespace_selector_matches_all(self, provider):
+        from karpenter_core_tpu.kube.objects import Namespace
+
+        kube = KubeClient()
+        ns = Namespace()
+        ns.metadata.name = "other-ns"
+        kube.create(ns)
+        target, seeker = self._pods(namespace_selector=LabelSelector())
+        _, placed = self._solve(provider, [target, seeker], kube=kube)
+        assert {"target", "seeker"} <= placed
