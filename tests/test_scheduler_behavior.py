@@ -329,8 +329,6 @@ class TestAffinityNamespaceFiltering:
     all namespaces)."""
 
     def _pods(self, term_namespaces=None, namespace_selector=None):
-        from helpers import make_pod
-
         target = make_pod(
             name="target", namespace="other-ns", labels={"security": "s2"}
         )
@@ -345,16 +343,17 @@ class TestAffinityNamespaceFiltering:
         return target, seeker
 
     def _solve(self, provider, pods, kube=None):
-        s = build_scheduler(kube, None, [make_nodepool()], provider, pods)
-        results = s.solve(pods)
+        results = solve(pods, [make_nodepool()], provider, kube=kube)
         placed = {p.metadata.name for c in results.new_node_claims for p in c.pods}
         return results, placed
 
     def test_no_namespace_match_does_not_anchor(self, provider):
         target, seeker = self._pods()
-        _, placed = self._solve(provider, [target, seeker])
+        results, placed = self._solve(provider, [target, seeker])
         assert "target" in placed
         assert "seeker" not in placed  # target invisible across namespaces
+        # the seeker surfaces as a pod error, not a silent drop
+        assert seeker.uid in results.pod_errors
 
     def test_namespace_list_allows_match(self, provider):
         target, seeker = self._pods(term_namespaces=["other-ns"])
