@@ -47,9 +47,9 @@ def env():
     informers.stop()
 
 
-def make_claim(kube, requirements=None, requests=None, startup_taints=None):
+def make_claim(kube, requirements=None, requests=None, startup_taints=None, name="claim-1"):
     nc = NodeClaim()
-    nc.metadata.name = "claim-1"
+    nc.metadata.name = name
     nc.metadata.labels = {wk.NODEPOOL_LABEL_KEY: "default"}
     nc.spec.requirements = requirements or []
     if requests:
@@ -572,3 +572,73 @@ class TestConsistencyTermination:
         kube.apply(nc)
         issues = ConsistencyController(kube, recorder).reconcile_all()
         assert any("finalizer" in i for i in issues)
+
+
+class TestTerminationEdges:
+    def test_multiple_nodes_for_one_claim_all_deleted(self, env):
+        """termination/suite_test.go: every Node sharing the claim's
+        provider id is deleted, and the claim waits for all of them."""
+        kube, provider, _, recorder = env
+        lc = NodeClaimLifecycleController(kube, provider, recorder)
+        nc = make_claim(kube)
+        lc.reconcile(nc)
+        n1 = join_node_for_claim(kube, nc)
+        lc.reconcile(nc)
+        # a second node claims the same provider id (duplicate kubelet join)
+        n2 = make_node(provider_id=nc.status.provider_id)
+        kube.create(n2)
+        nct = NodeClaimTerminationController(kube, provider)
+        kube.delete(nc)
+        err = nct.reconcile(kube.get("NodeClaim", nc.name))
+        assert err is not None  # waiting on node termination
+        for name in (n1.name, n2.name):
+            node = kube.get("Node", name)
+            assert node is None or node.metadata.deletion_timestamp is not None
+        # claim must NOT finalize while any matching node remains
+        assert kube.get("NodeClaim", nc.name) is not None
+        # finish the nodes (drain is trivial: no pods bound via claim path)
+        ntc = NodeTerminationController(
+            kube, provider, Terminator(kube, EvictionQueue(kube, recorder)), recorder
+        )
+        for name in (n1.name, n2.name):
+            node = kube.get("Node", name)
+            if node is not None:
+                ntc.reconcile(node)
+        nct.reconcile(kube.get("NodeClaim", nc.name))
+        assert kube.get("NodeClaim", nc.name) is None
+
+    def test_unlaunched_claim_does_not_sweep_pidless_nodes(self, env):
+        """Nodes without provider ids must not be matched by a claim
+        that never launched (empty provider id on both sides)."""
+        kube, provider, _, _ = env
+        bystander = make_node()
+        bystander.spec.provider_id = ""
+        kube.create(bystander)
+        nc = make_claim(kube)  # never launched: no provider id
+        nc.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        kube.delete(nc)
+        NodeClaimTerminationController(kube, provider).reconcile(
+            kube.get("NodeClaim", nc.name)
+        )
+        assert kube.get("NodeClaim", nc.name) is None
+        node = kube.get("Node", bystander.name)
+        assert node is not None and node.metadata.deletion_timestamp is None
+
+    def test_gc_deletes_many_vanished_claims(self, env):
+        kube, provider, _, recorder = env
+        fake_now = [1000.0]
+        lc = NodeClaimLifecycleController(kube, provider, recorder, clock=lambda: fake_now[0])
+        names = []
+        for i in range(5):
+            nc = make_claim(kube, name=f"claim-{i+1}")
+            lc.reconcile(nc)
+            nc.get_condition(COND_LAUNCHED).last_transition_time = fake_now[0]
+            names.append(nc.name)
+        # instances vanish behind karpenter's back
+        provider.created_node_claims.clear()
+        fake_now[0] += 60.0
+        gc = NodeClaimGarbageCollectionController(kube, provider, clock=lambda: fake_now[0])
+        assert gc.reconcile() == 5
+        for n in names:
+            gone = kube.get("NodeClaim", n)
+            assert gone is None or gone.metadata.deletion_timestamp is not None
