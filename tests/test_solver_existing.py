@@ -366,3 +366,30 @@ class TestProvisionerIntegration:
         names, reason = prov.reconcile()
         assert len(names) >= 1  # overflow launched new capacity
         assert kube.list("NodeClaim") != []
+
+
+class TestCatalogMutationTracking:
+    def test_in_place_offering_flip_reencodes(self):
+        """The catalog content fingerprint must catch IN-PLACE offering
+        mutations (spot dry-up) between solves — identical list object,
+        identical InstanceType objects, only Offering.available flips."""
+        pods = [
+            make_pod(
+                requests={"cpu": "500m", "memory": "512Mi"},
+                node_selector={wk.CAPACITY_TYPE_LABEL_KEY: "spot"},
+            )
+            for _ in range(200)
+        ]
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(48)
+        solver = TPUScheduler([make_nodepool()], provider)
+        assert solver.solve(pods).pods_scheduled == 200
+        for it in provider.instance_types:
+            for o in it.offerings:
+                if o.capacity_type == "spot":
+                    o.available = False
+        assert solver.solve(pods).pods_scheduled == 0
+        for it in provider.instance_types:
+            for o in it.offerings:
+                o.available = True
+        assert solver.solve(pods).pods_scheduled == 200
