@@ -373,3 +373,56 @@ class TestAlternatingTopology:
                 node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"}))
         results = schedule(pods, provider=provider)
         assert not results.pod_errors
+
+
+class TestDaemonOverheadFiltering:
+    """provisioning/suite_test.go daemonset-overhead specs: daemonsets
+    that can't land on the template's nodes must not reserve overhead."""
+
+    def _two_cpu_provider(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = [new_instance_type("two-cpu", {"cpu": "2.2", "pods": 10})]
+        return provider
+
+    def test_daemonset_without_matching_toleration_ignored(self):
+        tainted_pool = make_nodepool(taints=[Taint(key="team", value="a", effect="NoSchedule")])
+        daemon = make_pod(requests={"cpu": "1"}, owner_kind="DaemonSet")  # no toleration
+        pod = make_pod(
+            requests={"cpu": "2"},
+            tolerations=[Toleration(key="team", operator="Exists")],
+        )
+        results = schedule(
+            [pod], nodepools=[tainted_pool], provider=self._two_cpu_provider(),
+            daemonsets=[daemon],
+        )
+        # the daemonset can't tolerate the pool taint: its 1 cpu is NOT
+        # reserved, so the 2-cpu pod fits the 2.2-cpu node
+        assert len(results.new_node_claims) == 1 and not results.pod_errors
+
+    def test_daemonset_with_foreign_node_affinity_ignored(self):
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(
+                key=wk.LABEL_TOPOLOGY_ZONE, operator="In", values=["test-zone-1"]
+            )]
+        )
+        daemon = make_pod(
+            requests={"cpu": "1"},
+            owner_kind="DaemonSet",
+            node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"},  # never lands here
+        )
+        pod = make_pod(requests={"cpu": "2"})
+        results = schedule(
+            [pod], nodepools=[pool], provider=self._two_cpu_provider(),
+            daemonsets=[daemon],
+        )
+        assert len(results.new_node_claims) == 1 and not results.pod_errors
+
+    def test_matching_daemonset_still_reserves(self):
+        # control: a compatible daemonset DOES reserve its overhead
+        daemon = make_pod(requests={"cpu": "1"}, owner_kind="DaemonSet")
+        pods = [make_pod(requests={"cpu": "2"})]
+        results = schedule(
+            pods, provider=self._two_cpu_provider(), daemonsets=[daemon]
+        )
+        # 2 cpu pod + 1 cpu daemon > 2.2 cpu node: unschedulable
+        assert results.pod_errors
