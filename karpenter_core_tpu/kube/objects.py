@@ -413,6 +413,21 @@ class PodDisruptionBudget(KubeObject):
 
 
 @dataclass
+class CSINodeDriver:
+    name: str = ""
+    allocatable_count: Optional[int] = None
+
+
+@dataclass
+class CSINode(KubeObject):
+    """Per-node CSI driver registration carrying attachable-volume
+    limits (storage.k8s.io/v1 CSINode; volumeusage.go hydrates limits
+    from spec.drivers[].allocatable.count). Named after its Node."""
+
+    drivers: List[CSINodeDriver] = field(default_factory=list)
+
+
+@dataclass
 class Namespace(KubeObject):
     def __post_init__(self):
         self.metadata.namespace = ""

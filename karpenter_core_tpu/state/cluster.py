@@ -35,6 +35,9 @@ class Cluster:
         self.node_claim_name_to_provider_id: Dict[str, str] = {}
         self.daemonset_pods: Dict[tuple, Pod] = {}
         self.anti_affinity_pods: Dict[tuple, Pod] = {}
+        # node name → {csi driver: attachable-volume limit}, applied to
+        # the state node's volume usage (volumeusage.go CSINode hydration)
+        self._csi_limits_by_node: Dict[str, Dict[str, int]] = {}
         self._unsynced_start: Optional[float] = None
         self._consolidation_timestamp: float = clock()
 
@@ -154,8 +157,13 @@ class Cluster:
                 self.nodes.pop(old_pid, None)
             state = StateNode(node, old.node_claim if old else None)
             self._carry_pods(old, state)
-            # populate CSI limits from annotations if present
-            state.volume_usage.csi_limits = dict(getattr(old, "volume_usage", state.volume_usage).csi_limits) if old else {}
+            # the CSINode cache is the single source of truth for attach
+            # limits: it survives claim-only state (which never enters
+            # node_name_to_provider_id, so update_csi_node can't reach
+            # it) and clears stale limits after CSINode deletion
+            state.volume_usage.csi_limits = dict(
+                self._csi_limits_by_node.get(node.name, {})
+            )
             self.nodes[pid] = state
             self.node_name_to_provider_id[node.name] = pid
             # re-link nodeclaim by provider id
@@ -254,6 +262,29 @@ class Cluster:
             del self.bindings[key]
 
     # -- daemonsets (cluster.go:339-375) -------------------------------------
+
+    def update_csi_node(self, csi_node) -> None:
+        """Hydrate per-driver attachable-volume limits onto the matching
+        state node (CSINode is named after its Node)."""
+        limits = {
+            d.name: d.allocatable_count
+            for d in csi_node.drivers
+            if d.allocatable_count is not None
+        }
+        with self._mu:
+            self._csi_limits_by_node[csi_node.name] = limits
+            pid = self.node_name_to_provider_id.get(csi_node.name)
+            state = self.nodes.get(pid) if pid else None
+            if state is not None:
+                state.volume_usage.csi_limits = dict(limits)
+
+    def delete_csi_node(self, name: str) -> None:
+        with self._mu:
+            self._csi_limits_by_node.pop(name, None)
+            pid = self.node_name_to_provider_id.get(name)
+            state = self.nodes.get(pid) if pid else None
+            if state is not None:
+                state.volume_usage.csi_limits = {}
 
     def update_daemonset(self, daemonset: DaemonSet) -> None:
         with self._mu:

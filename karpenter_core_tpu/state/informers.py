@@ -1,5 +1,6 @@
 """State informers: watch controllers feeding the Cluster cache (ref
-pkg/controllers/state/informer/{node,pod,nodeclaim,nodepool,daemonset}.go)."""
+pkg/controllers/state/informer/{node,pod,nodeclaim,nodepool,daemonset}.go,
+plus CSINode for attach-limit hydration, volumeusage.go)."""
 
 from __future__ import annotations
 
@@ -7,8 +8,8 @@ from ..kube import client as kube
 
 
 class Informers:
-    """Wires KubeClient watches to Cluster.Update*/Delete* — the same five
-    thin controllers as the reference."""
+    """Wires KubeClient watches to Cluster.Update*/Delete* — the
+    reference's five thin controllers plus the CSINode watch."""
 
     def __init__(self, kube_client: kube.KubeClient, cluster) -> None:
         self.kube_client = kube_client
@@ -22,6 +23,7 @@ class Informers:
             self.kube_client.watch("Pod", self._on_pod),
             self.kube_client.watch("DaemonSet", self._on_daemonset),
             self.kube_client.watch("NodePool", self._on_nodepool),
+            self.kube_client.watch("CSINode", self._on_csi_node),
         ]
 
     def stop(self) -> None:
@@ -59,3 +61,9 @@ class Informers:
         # any nodepool change can open consolidation options
         # (informer/nodepool.go)
         self.cluster.mark_unconsolidated()
+
+    def _on_csi_node(self, event: str, obj) -> None:
+        if event == kube.DELETED:
+            self.cluster.delete_csi_node(obj.name)
+        else:
+            self.cluster.update_csi_node(obj)

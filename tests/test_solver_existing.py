@@ -393,3 +393,66 @@ class TestCatalogMutationTracking:
             for o in it.offerings:
                 o.available = True
         assert solver.solve(pods).pods_scheduled == 200
+
+
+class TestCsiAttachLimits:
+    def test_csi_limit_forces_new_node(self):
+        """CSINode-hydrated attach limits (volumeusage.go): a node at its
+        per-driver volume limit rejects further PVC pods, which open a
+        new claim instead."""
+        from karpenter_core_tpu.kube.objects import (
+            CSINode,
+            CSINodeDriver,
+            PersistentVolumeClaim,
+            StorageClass,
+            Volume,
+        )
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        kube = KubeClient()
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(10)
+        cluster = Cluster(kube, provider)
+        informers = Informers(kube, cluster)
+        informers.start()
+        try:
+            sc = StorageClass()
+            sc.metadata.name = "standard"
+            sc.provisioner = "ebs.csi.aws.com"
+            kube.create(sc)
+            for i in range(2):
+                pvc = PersistentVolumeClaim()
+                pvc.metadata.name = f"data-{i}"
+                pvc.storage_class_name = "standard"
+                kube.create(pvc)
+
+            node = make_node(
+                labels={wk.NODEPOOL_LABEL_KEY: "default",
+                        wk.NODE_REGISTERED_LABEL_KEY: "true",
+                        wk.NODE_INITIALIZED_LABEL_KEY: "true"},
+                capacity={"cpu": "8", "memory": "16Gi", "pods": "20"},
+            )
+            kube.create(node)
+            csi = CSINode(drivers=[CSINodeDriver(name="ebs.csi.aws.com", allocatable_count=1)])
+            csi.metadata.name = node.name
+            kube.create(csi)
+
+            pods = []
+            for i in range(2):
+                p = make_pod(name=f"vol-{i}", requests={"cpu": "100m"})
+                p.spec.volumes = [Volume(name="data", persistent_volume_claim=f"data-{i}")]
+                pods.append(p)
+
+            state_nodes = cluster.deep_copy_nodes()
+            assert state_nodes and state_nodes[0].volume_usage.csi_limits == {"ebs.csi.aws.com": 1}
+            results = build_scheduler(
+                kube, None, [make_nodepool()], provider, pods, state_nodes=state_nodes
+            ).solve(pods)
+            assert not results.pod_errors
+            on_existing = sum(len(e.pods) for e in results.existing_nodes)
+            on_new = sum(len(c.pods) for c in results.new_node_claims)
+            # exactly one volume pod fits the limited node; the other opens a claim
+            assert on_existing == 1 and on_new == 1, (on_existing, on_new)
+        finally:
+            informers.stop()
