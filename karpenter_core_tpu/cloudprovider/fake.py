@@ -195,7 +195,7 @@ class FakeCloudProvider(CloudProvider):
             candidates = [
                 it
                 for it in self.get_instance_types(np)
-                if reqs.compatible(it.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
+                if reqs.compatible(it.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False) is None
                 and len(it.offerings.requirements(reqs).available()) > 0
                 and resources.fits(node_claim.spec.resources.requests, it.allocatable())
             ]
@@ -218,7 +218,7 @@ class FakeCloudProvider(CloudProvider):
                     Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [o.zone]),
                     Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [o.capacity_type]),
                 )
-                if reqs.compatible(offer_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None:
+                if reqs.compatible(offer_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False) is None:
                     labels[wk.LABEL_TOPOLOGY_ZONE] = o.zone
                     labels[wk.CAPACITY_TYPE_LABEL_KEY] = o.capacity_type
                     break

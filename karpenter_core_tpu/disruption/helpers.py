@@ -25,14 +25,26 @@ class PDBLimits:
     def __init__(self, kube_client):
         self.kube_client = kube_client
         self.pdbs = kube_client.list("PodDisruptionBudget")
+        # a PDBLimits instance is a point-in-time snapshot (one per
+        # filter/consistency pass), so each PDB's dynamic budget — a full
+        # namespace Pod LIST to compute — is resolved at most once
+        self._allowed: dict = {}
 
-    def can_evict_pods(self, pods: List[Pod]) -> Tuple[str, bool]:
+    def _disruptions_allowed(self, pdb) -> int:
         from ..lifecycle.node_termination import pdb_disruptions_allowed
 
+        key = (pdb.namespace, pdb.name)
+        allowed = self._allowed.get(key)
+        if allowed is None:
+            allowed = pdb_disruptions_allowed(self.kube_client, pdb)
+            self._allowed[key] = allowed
+        return allowed
+
+    def can_evict_pods(self, pods: List[Pod]) -> Tuple[str, bool]:
         for pod in pods:
             for pdb in self.pdbs:
                 if pdb.namespace == pod.namespace and pdb.selector.matches(pod.metadata.labels):
-                    if pdb_disruptions_allowed(self.kube_client, pdb) < 1:
+                    if self._disruptions_allowed(pdb) < 1:
                         return f"{pdb.namespace}/{pdb.name}", False
         return "", True
 

@@ -116,7 +116,7 @@ class NodeClaimDisruptionController:
         longer compatible with the claim's labels."""
         pool_reqs = node_selector_requirements(nodepool.spec.template.requirements)
         claim_labels = label_requirements(nc.metadata.labels)
-        if pool_reqs.compatible(claim_labels, frozenset(wk.WELL_KNOWN_LABELS)) is not None:
+        if pool_reqs.compatible(claim_labels, frozenset(wk.WELL_KNOWN_LABELS), hint=False) is not None:
             return REQUIREMENTS_DRIFTED
         return ""
 

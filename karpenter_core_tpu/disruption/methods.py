@@ -65,7 +65,9 @@ class ConditionMethod(Method):
     def _condition_time(self, candidate: Candidate) -> float:
         nc = candidate.state_node.node_claim
         cond = nc.get_condition(self.condition) if nc is not None else None
-        return cond.last_transition_time if cond is not None else 0.0
+        # should_disrupt guarantees the condition exists; if filtering ever
+        # changes, sort condition-less candidates last, not first
+        return cond.last_transition_time if cond is not None else float("inf")
 
     def compute_command(self, candidates: List[Candidate]) -> Command:
         candidates = filter_candidates(self.ctx.kube_client, self.ctx.recorder, candidates)

@@ -422,9 +422,18 @@ class CSINodeDriver:
 class CSINode(KubeObject):
     """Per-node CSI driver registration carrying attachable-volume
     limits (storage.k8s.io/v1 CSINode; volumeusage.go hydrates limits
-    from spec.drivers[].allocatable.count). Named after its Node."""
+    from spec.drivers[].allocatable.count). Named after its Node;
+    cluster-scoped, like the real resource."""
 
     drivers: List[CSINodeDriver] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""
+
+
+@dataclass
+class ConfigMap(KubeObject):
+    data: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

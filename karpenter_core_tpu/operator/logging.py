@@ -39,6 +39,9 @@ class Logger:
         self._logger.setLevel(_LEVELS.get(level, logging.INFO))
         self.kv = kv or {}
 
+    def set_level(self, level: str) -> None:
+        self._logger.setLevel(_LEVELS.get(level, logging.INFO))
+
     def with_(self, **kv) -> "Logger":
         child = Logger.__new__(Logger)
         child._logger = self._logger
@@ -63,3 +66,66 @@ class Logger:
 
 def new_logger(level: str = "info") -> Logger:
     return Logger(level=level)
+
+
+CONFIG_NAME = "config-logging"
+
+
+def watch_config_logging(
+    kube_client, logger: Logger, component: str = "controller", namespace: str = "default"
+):
+    """Drive the log level from the system namespace's ``config-logging``
+    ConfigMap, live. The reference loads the same keys once at startup
+    from mounted files (pkg/operator/logging/logging.go:47-167:
+    ``loglevel.<component>`` wins, else the zap config JSON's "level")
+    and fails hard on bad config; this build extends that to a live
+    knative-observer-style watch, so bad config is rejected loudly but
+    non-fatally instead. Only the operator's own namespace is honored —
+    any other namespace's config-logging is ignored. Returns the
+    watch's unsubscribe fn."""
+
+    # the level to fall back to when the ConfigMap stops selecting one
+    # (key removed, config deleted) — live config must be revertible
+    base_level = logger._logger.level
+
+    def _reject(value) -> None:
+        # error level so the rejection survives whatever level the
+        # (possibly broken) config itself selected
+        logger.error("ignoring invalid log level %r from %s ConfigMap", value, CONFIG_NAME)
+
+    def _apply(cm) -> None:
+        # user-authored config: malformed JSON / non-dict / unknown
+        # levels must never take down the watch (or Operator.__init__,
+        # which receives a synchronous ADDED replay)
+        try:
+            level = cm.data.get(f"loglevel.{component}")
+            if level is not None and level not in _LEVELS:
+                _reject(level)  # bad override: reject, then fall back
+                level = None
+            if not level:
+                raw = cm.data.get("zap-logger-config")
+                if raw:
+                    parsed = json.loads(raw)
+                    if not isinstance(parsed, dict):
+                        _reject(parsed)
+                    else:
+                        level = parsed.get("level")
+                        if level is not None and not (isinstance(level, str) and level in _LEVELS):
+                            _reject(level)
+                            level = None
+            if level:
+                logger.set_level(level)
+            else:
+                logger._logger.setLevel(base_level)
+        except Exception:
+            logger.error("ignoring malformed %s ConfigMap", CONFIG_NAME)
+
+    def _on_event(event: str, obj) -> None:
+        if obj.name != CONFIG_NAME or obj.namespace != namespace:
+            return
+        if event in ("ADDED", "MODIFIED"):
+            _apply(obj)
+        elif event == "DELETED":
+            logger._logger.setLevel(base_level)
+
+    return kube_client.watch("ConfigMap", _on_event)

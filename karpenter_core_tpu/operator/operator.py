@@ -30,7 +30,7 @@ from ..provisioning import Batcher, Provisioner
 from ..state.cluster import Cluster
 from ..state.informers import Informers
 from .controller import SingletonController
-from .logging import new_logger
+from .logging import new_logger, watch_config_logging
 from .options import Options
 
 
@@ -48,6 +48,10 @@ class Operator:
         self.options = options or Options.from_env()
         self.logger = new_logger(self.options.log_level)
         self.kube_client = kube_client or KubeClient(clock=clock)
+        # live log-level from the config-logging ConfigMap (logging.go:47-167)
+        self._log_config_unsub = watch_config_logging(
+            self.kube_client, self.logger, namespace=self.options.system_namespace
+        )
         if not self.options.disable_webhook:
             install_admission(self.kube_client)
         self.registry = Registry()
@@ -199,6 +203,7 @@ class Operator:
         unsub = getattr(self, "_pod_watch_unsub", None)
         if unsub is not None:
             unsub()
+        self._log_config_unsub()
         self.informers.stop()
         self._started = False
         self._batching = False

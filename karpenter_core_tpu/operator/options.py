@@ -47,6 +47,9 @@ class Options:
     """options.go:47-99 — same knobs, same defaults."""
 
     service_name: str = ""
+    # the namespace the operator runs in (SYSTEM_NAMESPACE downward-API
+    # convention); the only namespace whose config-logging is honored
+    system_namespace: str = "default"
     metrics_port: int = 8000
     health_probe_port: int = 8081
     kube_client_qps: int = 200
@@ -70,6 +73,7 @@ class Options:
     def from_env(cls) -> "Options":
         opts = cls()
         opts.service_name = _env("SYSTEM_NAME", opts.service_name)
+        opts.system_namespace = _env("SYSTEM_NAMESPACE", opts.system_namespace)
         opts.metrics_port = _env("METRICS_PORT", opts.metrics_port)
         opts.health_probe_port = _env("HEALTH_PROBE_PORT", opts.health_probe_port)
         opts.kube_client_qps = _env("KUBE_CLIENT_QPS", opts.kube_client_qps)

@@ -248,7 +248,7 @@ class Scheduler:
             for p in daemonset_pods:
                 if Taints(node.taints()).tolerates(p) is not None:
                     continue
-                if label_requirements(node.labels()).compatible(pod_requirements(p)) is not None:
+                if label_requirements(node.labels()).compatible(pod_requirements(p), hint=False) is not None:
                     continue
                 daemons.append(p)
             self.existing_nodes.append(
@@ -275,7 +275,7 @@ def _daemon_overhead(
             if Taints(template.spec.taints).tolerates(p) is not None:
                 continue
             if template.requirements.compatible(
-                pod_requirements(p), ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                pod_requirements(p), ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False
             ) is not None:
                 continue
             daemons.append(p)

@@ -60,7 +60,7 @@ class TopologyNodeFilter:
     ) -> bool:
         if not self.requirements:
             return True
-        return any(requirements.compatible(req, allow_undefined) is None for req in self.requirements)
+        return any(requirements.compatible(req, allow_undefined, hint=False) is None for req in self.requirements)
 
     def key(self) -> tuple:
         return tuple(

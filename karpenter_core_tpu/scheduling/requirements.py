@@ -3,6 +3,7 @@ rules (ref pkg/scheduling/requirements.go)."""
 
 from __future__ import annotations
 
+import functools
 from typing import AbstractSet, Dict, Iterable, List, Optional
 
 from ..apis import labels as wk
@@ -113,13 +114,18 @@ class Requirements(Dict[str, Requirement]):
     # -- compatibility (requirements.go:163-258) ---------------------------
 
     def compatible(
-        self, incoming: "Requirements", allow_undefined: AbstractSet[str] = frozenset()
+        self,
+        incoming: "Requirements",
+        allow_undefined: AbstractSet[str] = frozenset(),
+        hint: bool = True,
     ) -> Optional[str]:
         """None if compatible, else an error string.
 
         Custom labels must intersect, and are denied when undefined on the
         receiver; labels in ``allow_undefined`` (well-known) must intersect
         only when defined. Mirrors Compatible + AllowUndefinedWellKnownLabels.
+        ``hint=False`` skips the typo-hint edit-distance scan — for
+        boolean screens that discard the error string.
         """
         errs = []
         for key in incoming.keys_set() - allow_undefined:
@@ -128,7 +134,8 @@ class Requirements(Dict[str, Requirement]):
             op = incoming.get_req(key).operator()
             if op in (OP_NOT_IN, OP_DOES_NOT_EXIST):
                 continue
-            errs.append(f'label "{key}" does not have known values')
+            suggestion = _label_hint(self, key, allow_undefined) if hint else ""
+            errs.append(f'label "{key}" does not have known values{suggestion}')
         err = self.intersects(incoming)
         if err:
             errs.append(err)
@@ -163,6 +170,49 @@ class Requirements(Dict[str, Requirement]):
     def __repr__(self) -> str:
         reqs = [repr(r) for k, r in self.items() if k not in wk.RESTRICTED_LABELS]
         return ", ".join(sorted(reqs))
+
+
+def _edit_distance(s: str, t: str) -> int:
+    """Levenshtein distance (same DP as requirements.go:177-209, including
+    its quirk of ignoring index 0 — kept so hint thresholds agree)."""
+    m, n = len(s), len(t)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    prev = list(range(n))
+    prev[0] = 0
+    cur = [0] * n
+    for i in range(1, m):
+        for j in range(1, n):
+            diff = 0 if s[i] == t[j] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + diff)
+        prev, cur = cur, prev
+    return prev[n - 1]
+
+
+def _suffix(key: str) -> str:
+    _, sep, after = key.partition("/")
+    return after if sep else key
+
+
+@functools.lru_cache(maxsize=4096)
+def _cached_hint(key: str, allow_undefined: frozenset, existing_keys: frozenset) -> str:
+    for pool in (allow_undefined, existing_keys):
+        for candidate in pool:
+            if key in candidate or _edit_distance(key, candidate) < len(candidate) // 5:
+                return f' (typo of "{candidate}"?)'
+            if candidate.endswith(_suffix(key)):
+                return f' (typo of "{candidate}"?)'
+    return ""
+
+
+def _label_hint(existing: "Requirements", key: str, allow_undefined: AbstractSet[str]) -> str:
+    """' (typo of "…"?)' when the unknown label is plausibly a typo of a
+    well-known or already-defined label (requirements.go:216-233).
+    Memoized — scheduling simulation retries the same miss thousands of
+    times per solve, and the edit-distance sweep is the expensive part."""
+    return _cached_hint(key, frozenset(allow_undefined), existing.keys_set())
 
 
 # the live well-known set (providers may extend it at import time)

@@ -286,7 +286,7 @@ def existing_node_compat(groups: List["SignatureGroup"], nodes: list) -> np.ndar
                     continue  # resolved per node below
                 col[s] = (
                     node_taints[m].tolerates(g.exemplar) is None
-                    and class_reqs.compatible(sig_reqs[s]) is None
+                    and class_reqs.compatible(sig_reqs[s], hint=False) is None
                 )
             class_cols[ckey] = col
         compat[:, m] = col
@@ -297,7 +297,7 @@ def existing_node_compat(groups: List["SignatureGroup"], nodes: list) -> np.ndar
             node_reqs.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [node.hostname()]))
             compat[s, m] = (
                 node_taints[m].tolerates(g.exemplar) is None
-                and node_reqs.compatible(sig_reqs[s]) is None
+                and node_reqs.compatible(sig_reqs[s], hint=False) is None
             )
     return compat
 
@@ -729,7 +729,7 @@ class TPUScheduler:
                         ),
                     )
                     if node_reqs.compatible(
-                        pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                        pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False
                     ):
                         continue
                     load = resources.merge(
@@ -778,7 +778,7 @@ class TPUScheduler:
             p
             for p in daemonset_pods
             if taints.tolerates(p) is None
-            and template_reqs.compatible(_pod_reqs(p), frozenset(wk.WELL_KNOWN_LABELS))
+            and template_reqs.compatible(_pod_reqs(p), frozenset(wk.WELL_KNOWN_LABELS), hint=False)
             is None
         ]
         return resources.requests_for_pods(*daemons) if daemons else {}
@@ -859,7 +859,7 @@ class TPUScheduler:
                 p
                 for p in daemonset_pods
                 if node_taints[m].tolerates(p) is None
-                and node_label_reqs[m].compatible(_pod_reqs(p)) is None
+                and node_label_reqs[m].compatible(_pod_reqs(p), hint=False) is None
             ]
             expected = resources.requests_for_pods(*daemons) if daemons else {}
             remaining_daemon = {
@@ -1136,7 +1136,7 @@ class TPUScheduler:
                 for p in daemonset_pods
                 if pool.taints.tolerates(p) is None
                 and pool.template_requirements.compatible(
-                    _pod_reqs(p), frozenset(wk.WELL_KNOWN_LABELS)
+                    _pod_reqs(p), frozenset(wk.WELL_KNOWN_LABELS), hint=False
                 )
                 is None
             ]

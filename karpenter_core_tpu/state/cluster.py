@@ -160,10 +160,21 @@ class Cluster:
             # the CSINode cache is the single source of truth for attach
             # limits: it survives claim-only state (which never enters
             # node_name_to_provider_id, so update_csi_node can't reach
-            # it) and clears stale limits after CSINode deletion
-            state.volume_usage.csi_limits = dict(
-                self._csi_limits_by_node.get(node.name, {})
-            )
+            # it) and clears stale limits after CSINode deletion. On a
+            # cache miss (node re-created before its CSINode event
+            # replays) fall back to the stored CSINode so a still-live
+            # registration isn't treated as unlimited.
+            limits = self._csi_limits_by_node.get(node.name)
+            if limits is None:
+                csi = self.kube_client.get("CSINode", node.name)
+                if csi is not None:
+                    limits = {
+                        d.name: d.allocatable_count
+                        for d in csi.drivers
+                        if d.allocatable_count is not None
+                    }
+                    self._csi_limits_by_node[node.name] = limits
+            state.volume_usage.csi_limits = dict(limits or {})
             self.nodes[pid] = state
             self.node_name_to_provider_id[node.name] = pid
             # re-link nodeclaim by provider id
@@ -184,6 +195,9 @@ class Cluster:
 
     def delete_node(self, name: str) -> None:
         with self._mu:
+            # drop cached CSI attach limits so a re-created node with the
+            # same name can't inherit stale limits before its CSINode event
+            self._csi_limits_by_node.pop(name, None)
             pid = self.node_name_to_provider_id.pop(name, None)
             if pid:
                 state = self.nodes.get(pid)

@@ -150,6 +150,29 @@ class TestBlocked:
         executed = env.controller.reconcile()
         assert executed is None
 
+    def test_pdb_budget_resolved_once_per_pass(self, env, monkeypatch):
+        # PDBLimits memoizes the per-PDB dynamic budget (a namespace-wide
+        # Pod LIST) so a pass over many pods/claims computes it once
+        import karpenter_core_tpu.lifecycle.node_termination as nt
+        from karpenter_core_tpu.disruption.helpers import PDBLimits
+
+        pods = [running_pod(labels={"app": "guarded"}) for _ in range(4)]
+        env.make_initialized_node(pods=pods)
+        pdb = PodDisruptionBudget(selector=LabelSelector(match_labels={"app": "guarded"}))
+        pdb.metadata.name = "guard"
+        pdb.disruptions_allowed = 1
+        env.kube.create(pdb)
+
+        calls = []
+        real = nt.pdb_disruptions_allowed
+        monkeypatch.setattr(
+            nt, "pdb_disruptions_allowed", lambda kc, p: calls.append(p.name) or real(kc, p)
+        )
+        limits = PDBLimits(env.kube)
+        limits.can_evict_pods(pods)
+        limits.can_evict_pods(pods)
+        assert calls == ["guard"]
+
     def test_nominated_node_not_candidate(self, env):
         node, nc = env.make_initialized_node()
         env.cluster.nominate_node_for_pod(node.spec.provider_id)

@@ -120,6 +120,54 @@ class TestOperator:
         assert not op.healthy()  # claim without provider id
         op.stop()
 
+    def test_configmap_drives_log_level(self):
+        # logging.go:47-167: the config-logging ConfigMap sets the live
+        # level; loglevel.controller wins over the zap config's level
+        import logging as pylogging
+
+        from karpenter_core_tpu.kube.objects import ConfigMap
+
+        provider = FakeCloudProvider()
+        op = Operator(provider)
+        assert op.logger._logger.level == pylogging.INFO
+        cm = ConfigMap(data={"zap-logger-config": '{"level": "debug"}'})
+        cm.metadata.name = "config-logging"
+        op.kube_client.create(cm)
+        assert op.logger._logger.level == pylogging.DEBUG
+        cm.data["loglevel.controller"] = "error"
+        op.kube_client.update(cm)
+        assert op.logger._logger.level == pylogging.ERROR
+        # malformed user config must not crash the watch; it rejects
+        # loudly and reverts to the boot-time level
+        cm.data = {"zap-logger-config": '"debug"'}
+        op.kube_client.update(cm)
+        assert op.logger._logger.level == pylogging.INFO
+        cm.data = {"loglevel.controller": "error"}
+        op.kube_client.update(cm)
+        assert op.logger._logger.level == pylogging.ERROR
+        # other namespaces' config-logging is ignored (multi-tenant safety)
+        other = ConfigMap(data={"loglevel.controller": "debug"})
+        other.metadata.name = "config-logging"
+        other.metadata.namespace = "tenant"
+        op.kube_client.create(other)
+        assert op.logger._logger.level == pylogging.ERROR
+        # removing the keys reverts to the boot-time level (live config
+        # must be revertible without a restart)
+        cm.data = {}
+        op.kube_client.update(cm)
+        assert op.logger._logger.level == pylogging.INFO
+        cm.data = {"loglevel.controller": "error"}
+        op.kube_client.update(cm)
+        assert op.logger._logger.level == pylogging.ERROR
+        op.kube_client.delete(cm)
+        assert op.logger._logger.level == pylogging.INFO
+        op.stop()
+        # stopped operators no longer react to config events
+        cm2 = ConfigMap(data={"loglevel.controller": "debug"})
+        cm2.metadata.name = "config-logging"
+        op.kube_client.create(cm2)
+        assert op.logger._logger.level == pylogging.INFO
+
 
 class TestUtils:
     def test_change_monitor_dedupes_within_window(self):

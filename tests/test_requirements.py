@@ -194,6 +194,33 @@ class TestRequirements:
         pod = Requirements(Requirement("custom-label", OP_NOT_IN, ["v"]))
         assert node.compatible(pod) is None
 
+    def test_compatible_typo_hint_well_known(self):
+        # requirements.go:216-233 labelHint: a near-miss of a well-known
+        # label gets a "(typo of ...?)" suggestion in the error
+        node = Requirements()
+        pod = Requirements(Requirement("topology.kubernetesio/zone", OP_IN, ["z1"]))
+        err = node.compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+        assert err is not None and "typo of" in err
+
+    def test_compatible_typo_hint_suffix_match(self):
+        # bare suffix ("zone") of a well-known label also hints
+        node = Requirements()
+        pod = Requirements(Requirement("zone", OP_IN, ["z1"]))
+        err = node.compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+        assert err is not None and "typo of" in err
+
+    def test_compatible_typo_hint_existing_key(self):
+        node = Requirements(Requirement("my-custom-label", OP_IN, ["v"]))
+        pod = Requirements(Requirement("my-custom-labell", OP_IN, ["v"]))
+        err = node.compatible(pod)
+        assert err is not None and 'typo of "my-custom-label"?' in err
+
+    def test_compatible_no_hint_when_unrelated(self):
+        node = Requirements()
+        pod = Requirements(Requirement("qqqq-xyzzy-8819", OP_IN, ["v"]))
+        err = node.compatible(pod)
+        assert err is not None and "typo of" not in err
+
     def test_normalized_label_keys(self):
         r = Requirement("beta.kubernetes.io/arch", OP_IN, ["amd64"])
         assert r.key == "kubernetes.io/arch"

@@ -88,6 +88,38 @@ class TestClusterState:
         kube.delete(node)
         assert cluster.consolidation_state() >= t0
 
+    def test_deleted_node_drops_stale_csi_limits(self, env):
+        # a re-created node with the same name must NOT inherit the old
+        # node's CSI attach limits while its CSINode event is in flight
+        from karpenter_core_tpu.kube.objects import CSINode, CSINodeDriver
+
+        kube, _, cluster, _, _ = env
+        node = make_node(capacity={"cpu": "4", "pods": 10})
+        kube.create(node)
+        csi = CSINode(drivers=[CSINodeDriver(name="ebs.csi.aws.com", allocatable_count=3)])
+        csi.metadata.name = node.name
+        kube.create(csi)
+        assert cluster.deep_copy_nodes()[0].volume_usage.csi_limits == {"ebs.csi.aws.com": 3}
+        # node replaced while its CSINode persists: the authoritative
+        # object re-hydrates the limits even though delete_node dropped
+        # the cache entry
+        kube.delete(node)
+        reborn = make_node(capacity={"cpu": "4", "pods": 10})
+        reborn.metadata.name = node.name
+        reborn.spec.provider_id = "fake:///reborn-csi"
+        kube.create(reborn)
+        fresh = [n for n in cluster.deep_copy_nodes() if n.provider_id() == "fake:///reborn-csi"]
+        assert fresh and fresh[0].volume_usage.csi_limits == {"ebs.csi.aws.com": 3}
+        # CSINode gone too: the re-created node must NOT inherit limits
+        kube.delete(csi)
+        kube.delete(reborn)
+        reborn2 = make_node(capacity={"cpu": "4", "pods": 10})
+        reborn2.metadata.name = node.name
+        reborn2.spec.provider_id = "fake:///reborn-csi-2"
+        kube.create(reborn2)
+        fresh = [n for n in cluster.deep_copy_nodes() if n.provider_id() == "fake:///reborn-csi-2"]
+        assert fresh and fresh[0].volume_usage.csi_limits == {}
+
 
 class TestProvisioner:
     def test_provisions_pending_pods(self, env):
