@@ -100,8 +100,23 @@ class KubeClient:
         with self._lock:
             kind = obj.kind
             key = self._key(obj)
-            if key not in self._objects[kind]:
+            stored = self._objects[kind].get(key)
+            if stored is None:
                 raise NotFound(f"{kind} {key} not found")
+            # optimistic concurrency, apiserver-style: an update carrying a
+            # resourceVersion must match the stored one; an unset (0)
+            # resourceVersion is an unconditional update. Same-instance
+            # updates (the in-memory sharing model) always match.
+            if (
+                stored is not obj
+                and obj.metadata.resource_version
+                and obj.metadata.resource_version != stored.metadata.resource_version
+            ):
+                raise Conflict(
+                    f"{kind} {key}: object has been modified "
+                    f"(resourceVersion {obj.metadata.resource_version} != "
+                    f"{stored.metadata.resource_version})"
+                )
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[kind][key] = obj
@@ -114,6 +129,36 @@ class KubeClient:
             if self._key(obj) in self._objects[obj.kind]:
                 return self.update(obj)
             return self.create(obj)
+
+    def retry_on_conflict(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        mutate: Callable[[KubeObject], None] = lambda obj: None,
+        attempts: int = 5,
+    ) -> KubeObject:
+        """controller-runtime ``RetryOnConflict`` equivalent: GET, apply
+        ``mutate``, UPDATE; on Conflict re-GET the current version and
+        retry. The store's controllers share instances and never conflict;
+        adapters over a real apiserver (which hand out copies) do."""
+        import copy
+
+        last: Optional[Conflict] = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace=namespace)
+            if obj is None:
+                raise NotFound(f"{kind} ({namespace!r}, {name!r}) not found")
+            # mutate a copy so a rejected write (conflict, admission)
+            # leaves the stored instance untouched — the copy's matching
+            # resourceVersion lets a clean retry land
+            obj = copy.deepcopy(obj)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except Conflict as err:
+                last = err
+        raise last if last is not None else Conflict(f"{kind} {name}: retries exhausted")
 
     def delete(self, obj_or_kind, name: str = "", namespace: str = "") -> bool:
         """Finalizer-aware delete: sets deletionTimestamp when finalizers

@@ -183,6 +183,12 @@ class Operator:
         """operator.go:203 Start: informers first (cache sync), then all
         controllers."""
         self.informers.start()
+        # start/stop symmetry: re-register the config-logging watch a
+        # previous stop() tore down
+        if self._log_config_unsub is None:
+            self._log_config_unsub = watch_config_logging(
+                self.kube_client, self.logger, namespace=self.options.system_namespace
+            )
         # pod-watch → batcher trigger, the provisioning trigger controller
         # (provisioning/controller.go:58)
         from ..utils import pod as podutils
@@ -203,7 +209,9 @@ class Operator:
         unsub = getattr(self, "_pod_watch_unsub", None)
         if unsub is not None:
             unsub()
-        self._log_config_unsub()
+        if self._log_config_unsub is not None:
+            self._log_config_unsub()
+            self._log_config_unsub = None
         self.informers.stop()
         self._started = False
         self._batching = False

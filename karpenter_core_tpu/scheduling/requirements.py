@@ -198,11 +198,14 @@ def _suffix(key: str) -> str:
 
 @functools.lru_cache(maxsize=4096)
 def _cached_hint(key: str, allow_undefined: frozenset, existing_keys: frozenset) -> str:
+    # deliberate divergence from the Go: a key ending in "/" has an empty
+    # suffix, which would endswith-match an arbitrary candidate
+    suffix = _suffix(key)
     for pool in (allow_undefined, existing_keys):
         for candidate in pool:
             if key in candidate or _edit_distance(key, candidate) < len(candidate) // 5:
                 return f' (typo of "{candidate}"?)'
-            if candidate.endswith(_suffix(key)):
+            if suffix and candidate.endswith(suffix):
                 return f' (typo of "{candidate}"?)'
     return ""
 

@@ -138,3 +138,69 @@ class TestAdmission:
             kube.create(make_pod(name="a"))
         kube.admission.clear()
         assert kube.get("Pod", "a", namespace="default") is None
+
+
+class TestOptimisticConcurrency:
+    def test_stale_copy_update_conflicts(self):
+        import copy
+
+        kube = KubeClient()
+        pod = kube.create(make_pod(name="a"))
+        stale = copy.deepcopy(pod)
+        pod.metadata.labels["touched"] = "1"
+        kube.update(pod)  # same instance: always current
+        with pytest.raises(Conflict):
+            kube.update(stale)
+
+    def test_unset_resource_version_is_unconditional(self):
+        kube = KubeClient()
+        kube.create(make_pod(name="a"))
+        fresh = make_pod(name="a")
+        fresh.metadata.resource_version = 0
+        kube.update(fresh)  # apiserver semantics: no rv, no precondition
+        assert kube.get("Pod", "a", namespace="default") is fresh
+
+    def test_matching_resource_version_update_succeeds(self):
+        import copy
+
+        kube = KubeClient()
+        pod = kube.create(make_pod(name="a"))
+        clone = copy.deepcopy(pod)
+        clone.metadata.labels["from-clone"] = "1"
+        kube.update(clone)
+        assert kube.get("Pod", "a", namespace="default").metadata.labels["from-clone"] == "1"
+
+    def test_retry_on_conflict_lands_the_write(self):
+        import copy
+
+        kube = KubeClient()
+        pod = kube.create(make_pod(name="a"))
+        # a competing writer bumps the rv between GET and UPDATE once
+        calls = []
+        real_update = kube.update
+
+        def racing_update(obj):
+            if not calls:
+                calls.append(1)
+                racer = copy.deepcopy(kube.get("Pod", "a", namespace="default"))
+                real_update(racer)  # now obj's rv is stale
+            return real_update(obj)
+
+        kube.update = racing_update
+        # retry must re-GET (picking up the racer's rv) and land
+        out = kube.retry_on_conflict(
+            "Pod", "a", namespace="default",
+            mutate=lambda o: o.metadata.labels.__setitem__("winner", "retry"),
+        )
+        assert out.metadata.labels["winner"] == "retry"
+
+    def test_retry_on_conflict_exhausts(self):
+        kube = KubeClient()
+        kube.create(make_pod(name="a"))
+
+        def always_conflict(obj):
+            raise Conflict("forced")
+
+        kube.update = always_conflict
+        with pytest.raises(Conflict):
+            kube.retry_on_conflict("Pod", "a", namespace="default", attempts=3)
