@@ -9,6 +9,7 @@ default runtime store for simulation.
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 from collections import defaultdict
@@ -142,8 +143,6 @@ class KubeClient:
         ``mutate``, UPDATE; on Conflict re-GET the current version and
         retry. The store's controllers share instances and never conflict;
         adapters over a real apiserver (which hand out copies) do."""
-        import copy
-
         last: Optional[Conflict] = None
         for _ in range(attempts):
             obj = self.get(kind, name, namespace=namespace)

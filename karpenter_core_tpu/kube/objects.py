@@ -444,7 +444,14 @@ class Namespace(KubeObject):
 
 @dataclass
 class Lease(KubeObject):
+    """coordination.k8s.io/v1 Lease spec surface: node heartbeats
+    (kube-node-lease) and the leader-election resource lock."""
+
     holder: str = ""
+    lease_duration_seconds: Optional[int] = None
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
 
 
 # ---------------------------------------------------------------------------

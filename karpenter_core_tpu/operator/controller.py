@@ -10,6 +10,7 @@ from typing import Callable, Optional
 
 BASE_DELAY = 0.01  # singleton.go:133 rate-limiter base
 MAX_DELAY = 10.0  # singleton.go:141 max
+GATED_POLL = 2.0  # follower re-check cadence (matches elector retry period)
 
 
 class SingletonController:
@@ -22,12 +23,16 @@ class SingletonController:
         metrics=None,
         logger=None,
         period: float = 10.0,
+        gate: Optional[Callable[[], bool]] = None,
     ):
         self.name = name
         self._reconcile = reconcile
         self.metrics = metrics
         self.logger = logger
         self.period = period
+        # leader-election gate: while it returns False (we are a
+        # follower), reconciles are skipped but the loop keeps ticking
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error_streak = 0
@@ -35,6 +40,10 @@ class SingletonController:
     def reconcile_once(self) -> Optional[float]:
         """One reconcile; returns the requeue delay. Errors back off
         exponentially (singleton.go:81-123)."""
+        if self.gate is not None and not self.gate():
+            # short follower poll — a newly promoted leader must start
+            # reconciling promptly, not after e.g. a 600 s consistency period
+            return min(self.period, GATED_POLL)
         start = time.perf_counter()
         try:
             requeue_after = self._reconcile()
@@ -54,10 +63,17 @@ class SingletonController:
         return requeue_after if requeue_after is not None else self.period
 
     def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive() and not self._stop.is_set():
+            return  # already running
+        # fresh stop event per start: a previous loop still draining a
+        # long reconcile keeps its own (set) event and exits at its next
+        # check, so stop() → start() restart can never leak a second loop
+        stop = self._stop = threading.Event()
+
         def loop():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 delay = self.reconcile_once()
-                self._stop.wait(delay)
+                stop.wait(delay)
 
         self._thread = threading.Thread(target=loop, name=self.name, daemon=True)
         self._thread.start()

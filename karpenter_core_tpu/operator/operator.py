@@ -116,26 +116,35 @@ class Operator:
         self.nodepool_hash = NodePoolHashController(self.kube_client)
         self.lease_gc = LeaseGarbageCollectionController(self.kube_client)
         self.metrics_store = MetricsStore(self.metrics)
+        self.elector = None
+        self.http = None
 
         # the reconcile surface, mirroring controllers.go:47-82
         self.controllers: List[SingletonController] = [
-            SingletonController("provisioner", self._reconcile_provisioner, self.metrics, self.logger, period=10.0),
-            SingletonController("disruption", self._reconcile_disruption, self.metrics, self.logger, period=10.0),
-            SingletonController("disruption.queue", self._reconcile_queue, self.metrics, self.logger, period=1.0),
-            SingletonController("nodeclaim.lifecycle", self._reconcile_lifecycle, self.metrics, self.logger, period=2.0),
-            SingletonController("nodeclaim.termination", self._reconcile_nc_termination, self.metrics, self.logger, period=2.0),
-            SingletonController("node.termination", self._reconcile_node_termination, self.metrics, self.logger, period=2.0),
-            SingletonController("nodeclaim.garbagecollection", lambda: self._none(self.nodeclaim_gc.reconcile), self.metrics, self.logger, period=120.0),
-            SingletonController("nodeclaim.disruption", lambda: self._none(self.nodeclaim_disruption.reconcile_all), self.metrics, self.logger, period=10.0),
-            SingletonController("nodeclaim.consistency", lambda: self._none(self.consistency.reconcile_all), self.metrics, self.logger, period=600.0),
-            SingletonController("nodepool.counter", lambda: self._none(self.nodepool_counter.reconcile_all), self.metrics, self.logger, period=10.0),
-            SingletonController("nodepool.hash", lambda: self._none(self.nodepool_hash.reconcile_all), self.metrics, self.logger, period=10.0),
-            SingletonController("lease.garbagecollection", lambda: self._none(self.lease_gc.reconcile), self.metrics, self.logger, period=120.0),
-            SingletonController("metrics.scraper", self._reconcile_metrics, self.metrics, self.logger, period=10.0),
-            SingletonController("eviction.queue", lambda: self._none(self.eviction_queue.reconcile), self.metrics, self.logger, period=1.0),
+            SingletonController("provisioner", self._reconcile_provisioner, self.metrics, self.logger, gate=self._leading, period=10.0),
+            SingletonController("disruption", self._reconcile_disruption, self.metrics, self.logger, gate=self._leading, period=10.0),
+            SingletonController("disruption.queue", self._reconcile_queue, self.metrics, self.logger, gate=self._leading, period=1.0),
+            SingletonController("nodeclaim.lifecycle", self._reconcile_lifecycle, self.metrics, self.logger, gate=self._leading, period=2.0),
+            SingletonController("nodeclaim.termination", self._reconcile_nc_termination, self.metrics, self.logger, gate=self._leading, period=2.0),
+            SingletonController("node.termination", self._reconcile_node_termination, self.metrics, self.logger, gate=self._leading, period=2.0),
+            SingletonController("nodeclaim.garbagecollection", lambda: self._none(self.nodeclaim_gc.reconcile), self.metrics, self.logger, gate=self._leading, period=120.0),
+            SingletonController("nodeclaim.disruption", lambda: self._none(self.nodeclaim_disruption.reconcile_all), self.metrics, self.logger, gate=self._leading, period=10.0),
+            SingletonController("nodeclaim.consistency", lambda: self._none(self.consistency.reconcile_all), self.metrics, self.logger, gate=self._leading, period=600.0),
+            SingletonController("nodepool.counter", lambda: self._none(self.nodepool_counter.reconcile_all), self.metrics, self.logger, gate=self._leading, period=10.0),
+            SingletonController("nodepool.hash", lambda: self._none(self.nodepool_hash.reconcile_all), self.metrics, self.logger, gate=self._leading, period=10.0),
+            SingletonController("lease.garbagecollection", lambda: self._none(self.lease_gc.reconcile), self.metrics, self.logger, gate=self._leading, period=120.0),
+            SingletonController("metrics.scraper", self._reconcile_metrics, self.metrics, self.logger, gate=self._leading, period=10.0),
+            SingletonController("eviction.queue", lambda: self._none(self.eviction_queue.reconcile), self.metrics, self.logger, gate=self._leading, period=1.0),
         ]
         self._started = False
         self._batching = False
+
+    def _leading(self) -> bool:
+        """Leader gate for every controller: standalone (no election) or
+        the current Lease holder. Followers keep their loops ticking but
+        skip reconciles — the reference gets this from controller-
+        runtime's manager (operator.go:121-124)."""
+        return self.elector is None or self.elector.is_leader()
 
     # -- reconcile wrappers -------------------------------------------------
 
@@ -180,9 +189,32 @@ class Operator:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        """operator.go:203 Start: informers first (cache sync), then all
-        controllers."""
+        """operator.go:203 Start: informers first (cache sync), then the
+        operational surface and election, then all controllers."""
         self.informers.start()
+        if self.options.enable_leader_election and self.elector is None:
+            from .leaderelection import LeaderElector
+
+            self.elector = LeaderElector(
+                self.kube_client,
+                namespace=self.options.system_namespace,
+                clock=self.clock,
+                on_started_leading=lambda: self.logger.info("became leader"),
+                on_stopped_leading=lambda: self.logger.info("lost leadership"),
+            )
+            self.elector.start()
+        if self.http is None:
+            from .server import OperationalServer
+
+            self.http = OperationalServer(
+                self.registry,
+                ready_check=self.healthy,
+                metrics_port=self.options.metrics_port,
+                probe_port=self.options.health_probe_port,
+                enable_profiling=self.options.enable_profiling,
+                logger=self.logger,
+            )
+            self.http.start()
         # start/stop symmetry: re-register the config-logging watch a
         # previous stop() tore down
         if self._log_config_unsub is None:
@@ -212,6 +244,12 @@ class Operator:
         if self._log_config_unsub is not None:
             self._log_config_unsub()
             self._log_config_unsub = None
+        if self.elector is not None:
+            self.elector.stop()
+            self.elector = None
+        if self.http is not None:
+            self.http.stop()
+            self.http = None
         self.informers.stop()
         self._started = False
         self._batching = False

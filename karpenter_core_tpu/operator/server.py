@@ -1,0 +1,169 @@
+"""Served operational surface (ref pkg/operator/operator.go:126-177):
+a metrics server (`/metrics`, plus `/debug/pprof/*` when profiling is
+enabled) and a probe server (`/healthz`, `/readyz`).
+
+The reference gets these from controller-runtime's manager; here they
+are two stdlib ThreadingHTTPServers. The pprof equivalents are
+TPU-build-native: a live all-thread stack dump, and a sampling
+profiler over ``sys._current_frames`` that emits collapsed stacks
+(flamegraph input) — the closest Python analogue of
+``/debug/pprof/profile``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# route → (status, content_type, body) producer
+Route = Callable[[Dict[str, list]], Tuple[int, str, str]]
+
+
+def _stack_dump(_query) -> Tuple[int, str, str]:
+    lines = []
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"goroutine-equivalent thread {ident} [{names.get(ident, '?')}]:")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+        lines.append("")
+    return 200, "text/plain; charset=utf-8", "\n".join(lines)
+
+
+def _collapsed_profile(query) -> Tuple[int, str, str]:
+    """Sample every thread's stack for ?seconds=N (default 2, max 30) at
+    ~100 Hz; emit one collapsed stack per line with its sample count."""
+    try:
+        seconds = min(float(query.get("seconds", ["2"])[0]), 30.0)
+    except ValueError:
+        return 400, "text/plain", "bad seconds parameter\n"
+    me = threading.get_ident()
+    samples: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = []
+            while frame is not None:
+                code = frame.f_code
+                stack.append(f"{code.co_name} ({code.co_filename}:{frame.f_lineno})")
+                frame = frame.f_back
+            if stack:
+                samples[";".join(reversed(stack))] += 1
+        time.sleep(0.01)
+    body = "".join(f"{stack} {count}\n" for stack, count in samples.most_common())
+    return 200, "text/plain; charset=utf-8", body or "no samples\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # routes injected per-server via the server instance
+    def do_GET(self):  # noqa: N802 — http.server API
+        parsed = urlparse(self.path)
+        route = self.server.routes.get(parsed.path)  # type: ignore[attr-defined]
+        if route is None:
+            self.send_error(404)
+            return
+        status, content_type, body = route(parse_qs(parsed.query))
+        payload = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt, *args):  # quiet: probes poll every few seconds
+        pass
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, port: int, routes: Dict[str, Route]):
+        super().__init__(("0.0.0.0", port), _Handler)
+        self.routes = routes
+
+
+class OperationalServer:
+    """Binds the metrics and probe ports and serves them from daemon
+    threads. ``port`` 0 binds an ephemeral port (tests); the bound ports
+    are exposed as ``metrics_port`` / ``probe_port`` after start()."""
+
+    def __init__(
+        self,
+        registry,
+        ready_check: Callable[[], bool],
+        metrics_port: int = 8000,
+        probe_port: int = 8081,
+        enable_profiling: bool = False,
+        logger=None,
+    ):
+        self.registry = registry
+        self.ready_check = ready_check
+        self._metrics_port = metrics_port
+        self._probe_port = probe_port
+        self.enable_profiling = enable_profiling
+        self.logger = logger
+        self._metrics_server: Optional[_Server] = None
+        self._probe_server: Optional[_Server] = None
+
+    # -- route payloads -----------------------------------------------------
+
+    def _metrics(self, _query) -> Tuple[int, str, str]:
+        return 200, PROMETHEUS_CONTENT_TYPE, self.registry.expose()
+
+    def _healthz(self, _query) -> Tuple[int, str, str]:
+        return 200, "text/plain", "ok\n"
+
+    def _readyz(self, _query) -> Tuple[int, str, str]:
+        # operator.go:171-175: readiness is cache sync
+        if self.ready_check():
+            return 200, "text/plain", "ok\n"
+        return 503, "text/plain", "caches not synced\n"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        return self._metrics_server.server_address[1] if self._metrics_server else None
+
+    @property
+    def probe_port(self) -> Optional[int]:
+        return self._probe_server.server_address[1] if self._probe_server else None
+
+    def _bind(self, port: int, routes: Dict[str, Route]) -> Optional[_Server]:
+        try:
+            server = _Server(port, routes)
+        except OSError as err:
+            # a busy port must not take the operator down; the rest of
+            # the surface (and the controllers) keep running
+            if self.logger is not None:
+                self.logger.error("failed to bind port %s: %s", port, err)
+            return None
+        threading.Thread(target=server.serve_forever, name=f"http-{port}", daemon=True).start()
+        return server
+
+    def start(self) -> None:
+        metrics_routes: Dict[str, Route] = {"/metrics": self._metrics}
+        if self.enable_profiling:
+            metrics_routes["/debug/pprof/"] = _stack_dump
+            metrics_routes["/debug/pprof/profile"] = _collapsed_profile
+        probe_routes: Dict[str, Route] = {"/healthz": self._healthz, "/readyz": self._readyz}
+        self._metrics_server = self._bind(self._metrics_port, metrics_routes)
+        self._probe_server = self._bind(self._probe_port, probe_routes)
+
+    def stop(self) -> None:
+        for server in (self._metrics_server, self._probe_server):
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+        self._metrics_server = None
+        self._probe_server = None
