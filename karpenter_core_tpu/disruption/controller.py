@@ -11,6 +11,7 @@ from ..apis import labels as wk
 from ..kube.objects import EFFECT_NO_SCHEDULE, Taint
 from ..provisioning.provisioner import LaunchOptions
 from ..utils import pod as podutils
+from .budgets import build_disruption_budgets
 from .helpers import get_candidates
 from .methods import (
     Drift,
@@ -39,6 +40,9 @@ class DisruptionContext:
     # test hook: replaces the 15 s validation wait (consolidation.go:42);
     # None skips waiting entirely
     validation_sleep: Optional[Callable[[float], None]] = None
+    # remaining voluntary disruptions per nodepool, rebuilt each pass
+    # (disruption-controls.md); None = budgets not enforced (legacy tests)
+    budgets: Optional[dict] = None
 
 
 class DisruptionController:
@@ -87,6 +91,11 @@ class DisruptionController:
         if not self.cluster.synced():
             return None
         self._cleanup_stale_taints()
+        # per-pass remaining disruption allowance per nodepool; methods
+        # cap candidate selection against a snapshot of this map
+        self.ctx.budgets = build_disruption_budgets(
+            self.cluster, self.kube_client, self.clock, self.queue
+        )
         for method in self.methods:
             candidates = get_candidates(
                 self.cluster,

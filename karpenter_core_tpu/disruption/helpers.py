@@ -76,6 +76,34 @@ def filter_candidates(kube_client, recorder, candidates: List[Candidate]) -> Lis
     return out
 
 
+def cap_by_budgets(
+    candidates: List[Candidate], budgets, recorder=None
+) -> List[Candidate]:
+    """Enforce per-NodePool disruption budgets on an ordered candidate
+    list: keep candidates (highest priority first) while their pool has
+    remaining budget. ``budgets`` is the pass's remaining-allowance map
+    (budgets.build_disruption_budgets); None disables capping. Dropped
+    candidates get a Blocked event naming the budget."""
+    if budgets is None:
+        return candidates
+    remaining = dict(budgets)  # local: only the executed command consumes
+    kept: List[Candidate] = []
+    for cn in candidates:
+        pool = cn.nodepool.name
+        left = remaining.get(pool)
+        if left is None:  # pool unknown to the pass snapshot: no cap
+            kept.append(cn)
+            continue
+        if left > 0:
+            remaining[pool] = left - 1
+            kept.append(cn)
+        else:
+            _blocked(
+                recorder, cn, f'Disruption budget for nodepool "{pool}" is exhausted'
+            )
+    return kept
+
+
 def _blocked(recorder, candidate: Candidate, message: str) -> None:
     if recorder is not None:
         from ..events import events as ev

@@ -16,6 +16,7 @@ from ..kube.objects import OP_IN
 from .helpers import (
     CandidateDeletingError,
     _blocked,
+    cap_by_budgets,
     filter_by_price,
     filter_candidates,
     get_candidate_prices,
@@ -76,6 +77,9 @@ class ConditionMethod(Method):
         # earliest condition transition disrupts first — "most expired" /
         # "earliest drifted" (drift.go:62-71, expiration.go:66-75)
         candidates.sort(key=self._condition_time)
+        candidates = cap_by_budgets(candidates, self.ctx.budgets, self.ctx.recorder)
+        if not candidates:
+            return Command()
         if not self.needs_replacement:
             return Command(candidates=candidates)
         # all EMPTY candidates disrupt in one command — they need no
@@ -148,11 +152,17 @@ class ConsolidationBase(Method):
     def __init__(self, ctx):
         self.ctx = ctx
         self.last_consolidation_state = -1.0
+        self._budget_dropped = 0
 
     def is_consolidated(self) -> bool:
         return self.last_consolidation_state == self.ctx.cluster.consolidation_state()
 
     def mark_consolidated(self) -> None:
+        # budgets are time-varying: candidates dropped by an exhausted
+        # budget are pending work the cluster state won't re-signal, so
+        # the nothing-to-do dedup must not latch while any were dropped
+        if self._budget_dropped:
+            return
         self.last_consolidation_state = self.ctx.cluster.consolidation_state()
 
     def should_disrupt(self, candidate: Candidate) -> bool:
@@ -164,7 +174,11 @@ class ConsolidationBase(Method):
 
     def sort_and_filter(self, candidates: List[Candidate]) -> List[Candidate]:
         candidates = filter_candidates(self.ctx.kube_client, self.ctx.recorder, candidates)
-        return sorted(candidates, key=lambda c: c.disruption_cost)
+        candidates = sorted(candidates, key=lambda c: c.disruption_cost)
+        # cheapest-to-disrupt keep their place under the per-pool budget cap
+        capped = cap_by_budgets(candidates, self.ctx.budgets, self.ctx.recorder)
+        self._budget_dropped = len(candidates) - len(capped)
+        return capped
 
     # -- the decision core (consolidation.go:113 computeConsolidation) -----
 

@@ -5,7 +5,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from karpenter_core_tpu.apis import labels as wk
-from karpenter_core_tpu.apis.nodepool import NodePool
+from karpenter_core_tpu.apis.nodepool import Budget, NodePool
 from karpenter_core_tpu.kube.objects import (
     Affinity,
     Container,
@@ -118,6 +118,10 @@ def make_nodepool(
 ) -> NodePool:
     np = NodePool()
     np.metadata.name = name
+    # specs ported from the reference predate its budget enforcement —
+    # an unrestricted budget preserves their semantics; budget tests set
+    # restrictive budgets explicitly (upstream test fixtures do the same)
+    np.spec.disruption.budgets = [Budget(nodes="100%")]
     np.spec.template.requirements = list(requirements or [])
     np.spec.template.metadata.labels = dict(labels or {})
     np.spec.template.taints = list(taints or [])

@@ -1,0 +1,195 @@
+"""Disruption-budget enforcement specs (designs/disruption-controls.md;
+API at reference apis/v1beta1/nodepool.go:84-118 — enforcement is this
+build's implementation of the accepted design)."""
+
+from __future__ import annotations
+
+import calendar
+import time
+
+import pytest
+
+from helpers import Env, running_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.apis.nodepool import Budget
+from karpenter_core_tpu.disruption.budgets import (
+    allowed_disruptions,
+    build_disruption_budgets,
+    resolve_nodes_value,
+)
+from karpenter_core_tpu.utils.cron import CronError, Schedule, budget_is_active
+
+
+def ts(spec: str) -> float:
+    """'2024-03-04 09:30' → epoch (UTC; Mar 4 2024 is a Monday)."""
+    return calendar.timegm(time.strptime(spec, "%Y-%m-%d %H:%M"))
+
+
+class TestCron:
+    def test_exact_match(self):
+        s = Schedule("30 9 * * *")
+        assert s.matches(ts("2024-03-04 09:30"))
+        assert not s.matches(ts("2024-03-04 09:31"))
+
+    def test_ranges_steps_lists(self):
+        s = Schedule("*/15 9-17 * * 1,3,5")
+        assert s.matches(ts("2024-03-04 09:45"))  # Monday
+        assert not s.matches(ts("2024-03-05 09:45"))  # Tuesday
+        assert not s.matches(ts("2024-03-04 08:45"))
+        assert not s.matches(ts("2024-03-04 09:44"))
+
+    def test_names(self):
+        s = Schedule("0 9 * mar mon-fri")
+        assert s.matches(ts("2024-03-04 09:00"))
+        assert not s.matches(ts("2024-04-01 09:00"))  # April
+        assert not s.matches(ts("2024-03-03 09:00"))  # Sunday
+
+    def test_macros(self):
+        assert Schedule("@hourly").matches(ts("2024-03-04 13:00"))
+        assert not Schedule("@hourly").matches(ts("2024-03-04 13:01"))
+        assert Schedule("@daily").matches(ts("2024-03-04 00:00"))
+
+    def test_dow_seven_is_sunday(self):
+        assert Schedule("0 0 * * 7").matches(ts("2024-03-03 00:00"))
+
+    def test_value_with_step_runs_to_max(self):
+        # robfig/cron: "5/15" = minutes 5,20,35,50
+        s = Schedule("5/15 * * * *")
+        for minute in (5, 20, 35, 50):
+            assert s.matches(ts(f"2024-03-04 13:{minute:02d}"))
+        assert not s.matches(ts("2024-03-04 13:06"))
+
+    def test_dom_dow_either_matches_when_both_restricted(self):
+        # vixie-cron quirk: restricted DoM OR restricted DoW suffices
+        s = Schedule("0 0 15 * mon")
+        assert s.matches(ts("2024-03-15 00:00"))  # Friday the 15th: DoM hit
+        assert s.matches(ts("2024-03-04 00:00"))  # Monday the 4th: DoW hit
+        assert not s.matches(ts("2024-03-05 00:00"))  # Tuesday the 5th
+
+    def test_invalid_expressions_raise(self):
+        for expr in ("", "* * * *", "61 * * * *", "* * * * mon-sun-fri", "a * * * *"):
+            with pytest.raises(CronError):
+                Schedule(expr)
+
+    def test_active_within_window(self):
+        # business-hours budget: hit at 09:00, active for 8h
+        begins = "0 9 * * mon-fri"
+        assert budget_is_active(begins, 8 * 3600, ts("2024-03-04 09:00"))
+        assert budget_is_active(begins, 8 * 3600, ts("2024-03-04 16:59"))
+        assert not budget_is_active(begins, 8 * 3600, ts("2024-03-04 17:00"))
+        assert not budget_is_active(begins, 8 * 3600, ts("2024-03-04 08:59"))
+        assert not budget_is_active(begins, 8 * 3600, ts("2024-03-03 12:00"))  # Sunday
+
+    def test_always_active_without_schedule(self):
+        assert budget_is_active(None, None, ts("2024-03-04 12:00"))
+
+    def test_half_set_budget_inactive(self):
+        # validation rejects schedule-xor-duration; runtime backstop: inactive
+        assert not budget_is_active("0 9 * * *", None, ts("2024-03-04 09:00"))
+        assert not budget_is_active(None, 3600.0, ts("2024-03-04 09:00"))
+
+
+class TestBudgetResolution:
+    def test_absolute_and_percent(self):
+        assert resolve_nodes_value("10", 100) == 10
+        assert resolve_nodes_value("0", 100) == 0
+        assert resolve_nodes_value("10%", 100) == 10
+        assert resolve_nodes_value("10%", 5) == 1  # ceil: small pools still move
+        assert resolve_nodes_value("10%", 0) == 0
+
+    def test_most_restrictive_active_budget_wins(self, env):
+        env.nodepool.spec.disruption.budgets = [
+            Budget(nodes="10"),
+            Budget(nodes="3"),
+            Budget(nodes="0", schedule="0 0 1 1 *", duration=60.0),  # not active now
+        ]
+        assert allowed_disruptions(env.nodepool, 100, env.now) == 3
+
+    def test_no_active_budget_means_no_cap(self, env):
+        env.nodepool.spec.disruption.budgets = [
+            Budget(nodes="0", schedule="0 0 1 1 *", duration=60.0)
+        ]
+        assert allowed_disruptions(env.nodepool, 100, env.now) == 100
+
+    def test_default_budget_is_ten_percent(self, env):
+        env.nodepool.spec.disruption.budgets = []
+        assert allowed_disruptions(env.nodepool, 100, env.now) == 10
+
+
+class TestBudgetEnforcement:
+    def _empties(self, env, n):
+        for _ in range(n):
+            env.make_initialized_node()
+
+    def test_empty_batch_capped(self, env):
+        env.nodepool.spec.disruption.budgets = [Budget(nodes="2")]
+        env.kube.apply(env.nodepool)
+        self._empties(env, 5)
+        executed = env.controller.reconcile()
+        assert executed is not None
+        marked = [n for n in env.cluster.deep_copy_nodes() if n.marked_for_deletion]
+        assert len(marked) == 2  # budget, not batch size, set the count
+
+    def test_zero_budget_blocks_all(self, env):
+        env.nodepool.spec.disruption.budgets = [Budget(nodes="0")]
+        env.kube.apply(env.nodepool)
+        self._empties(env, 3)
+        executed = env.controller.reconcile()
+        assert executed is None
+        assert not any(n.marked_for_deletion for n in env.cluster.deep_copy_nodes())
+
+    def test_disrupting_nodes_consume_budget(self, env):
+        env.nodepool.spec.disruption.budgets = [Budget(nodes="2")]
+        env.kube.apply(env.nodepool)
+        self._empties(env, 4)
+        # one node already marked for deletion eats half the budget
+        victim = env.cluster.deep_copy_nodes()[0]
+        env.cluster.mark_for_deletion(victim.provider_id())
+        budgets = build_disruption_budgets(env.cluster, env.kube, env.clock, env.controller.queue)
+        assert budgets[env.nodepool.name] == 1
+
+    def test_externally_deleting_node_consumes_budget(self, env):
+        env.nodepool.spec.disruption.budgets = [Budget(nodes="2")]
+        env.kube.apply(env.nodepool)
+        self._empties(env, 4)
+        # kubectl-delete style drain: deletionTimestamp, no taint/mark
+        node = env.kube.list("Node")[0]
+        node.metadata.finalizers.append("keep")  # so delete only stamps
+        env.kube.apply(node)
+        env.kube.delete(node)
+        budgets = build_disruption_budgets(env.cluster, env.kube, env.clock, env.controller.queue)
+        assert budgets[env.nodepool.name] == 1
+
+    def test_crontab_window_activates_budget(self, env):
+        # freeze disruption during "business hours" starting at the top
+        # of the current hour; allow it after the window ends
+        env.now = float(ts("2024-03-04 10:30"))
+        env.nodepool.spec.disruption.budgets = [
+            Budget(nodes="0", schedule="0 10 * * mon", duration=3600.0)
+        ]
+        env.kube.apply(env.nodepool)
+        self._empties(env, 2)
+        assert env.controller.reconcile() is None  # inside the freeze window
+        env.now = float(ts("2024-03-04 11:30"))
+        assert env.controller.reconcile() is not None  # window over: no cap
+
+    def test_budget_spans_nodepools_independently(self, env):
+        from helpers import make_nodepool
+
+        env.nodepool.spec.disruption.budgets = [Budget(nodes="0")]
+        env.kube.apply(env.nodepool)
+        other = make_nodepool(name="free")
+        other.spec.disruption.consolidate_after = 0.0
+        env.kube.create(other)
+        self._empties(env, 2)
+        budgets = build_disruption_budgets(env.cluster, env.kube, env.clock, env.controller.queue)
+        assert budgets[env.nodepool.name] == 0
+        assert budgets["free"] == 0  # no nodes → nothing to disrupt either
+
+    def test_blocked_event_published(self, env):
+        env.nodepool.spec.disruption.budgets = [Budget(nodes="1")]
+        env.kube.apply(env.nodepool)
+        self._empties(env, 3)
+        env.controller.reconcile()
+        blocked = [e for e in env.recorder.events if "budget" in e.message.lower()]
+        assert blocked, "expected Blocked events for budget-capped candidates"
