@@ -192,6 +192,18 @@ def _scale(n: int) -> int:
     return max(1, int(n * float(os.environ.get("BENCH_SCALE", "1"))))
 
 
+def _split(solver) -> dict:
+    """Device-vs-host wall split of the solver's most recent solve
+    (solver.last_timings; VERDICT r4: make "TPU-native" measurable)."""
+    t = getattr(solver, "last_timings", None)
+    if not t:
+        return {}
+    return {
+        "device_ms": round(t["device_ms"], 2),
+        "host_ms": round(t["host_ms"], 2),
+    }
+
+
 def headline(out: dict) -> None:
     """North star: 50k pods x 2k types, reference pod mix; cold + warm."""
     from karpenter_core_tpu.apis import labels as wk
@@ -253,6 +265,7 @@ def headline(out: dict) -> None:
             "warm_ms": round(warm * 1000.0, 1),
             "pods_scheduled": result.pods_scheduled,
             **{f"packing_{k}": v for k, v in packing_stats(result).items()},
+            **_split(solver),
         }
     )
 
@@ -337,6 +350,7 @@ def config2() -> dict:
         "config": "2: 10k mixed cpu/mem/gpu pods x 500 types (TPU)",
         "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
         **packing_stats(res),
+        **_split(solver),
     }
 
 
@@ -416,6 +430,7 @@ def config3() -> dict:
         "config": "3: 50k constrained pods x 2k types (TPU)",
         "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
         "packing_parity_vs_oracle": round(parity, 4),
+        **_split(solver),
         "oracle_nodes_on_subsample": o_nodes,
         "tpu_nodes_on_subsample": tpu_sub.node_count,
         **packing_stats(res),
@@ -538,6 +553,7 @@ def config5() -> dict:
         "total_price_per_hr": round(res.total_price, 2),
         "spot_node_fraction": round(spot_nodes / max(res.node_count, 1), 3),
         **packing_stats(res),
+        **_split(solver),
     }
 
 
@@ -625,6 +641,7 @@ def config6() -> dict:
         "pods_scheduled": res.pods_scheduled,
         "pod_errors": len(res.pod_errors),
         **packing_stats(res),
+        **_split(solver),
     }
 
 
@@ -724,7 +741,8 @@ def engine_shootout(backend: str) -> dict:
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    out: dict = {}
+    out: dict = {"schema": 2}  # 2: backend_init_ms split out of cold_ms (r4),
+    # device/host split + calibration blocks added (r5)
     backend = resolve_backend(out)
     out["backend"] = backend
     from karpenter_core_tpu.solver import backend as backend_mod
@@ -766,6 +784,16 @@ def main() -> None:
         out["engines"] = engine_shootout(backend)
     except Exception:
         out["engines"] = {"error": traceback.format_exc()[-800:]}
+
+    # on-device engine-policy calibration: the compat routing threshold
+    # as measured on THIS chip (r4's constant baked in the tunneled
+    # chip's ~65 ms floor; see solver/calibrate.py)
+    try:
+        from karpenter_core_tpu.solver.calibrate import calibration
+
+        out["calibration"] = calibration()
+    except Exception:
+        out["calibration"] = {"error": traceback.format_exc()[-400:]}
 
     print(json.dumps(out), flush=True)
 

@@ -214,6 +214,10 @@ class Metrics:
             "TPU solve phase wall time (existing_pack/encode/pack)",
             labels=["phase"],
         )
+        self.solver_device_duration = r.histogram(
+            f"{ns}_tpu_solver_device_duration_seconds",
+            "Device-attributable time per solve (dispatch + transfer + blocked-on-device)",
+        )
         # node/nodepool/pod scrapers (metrics/{node,nodepool,pod})
         self.node_allocatable = r.gauge(f"{ns}_nodes_allocatable", "Node allocatable", ["node", "resource"])
         self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])

@@ -22,6 +22,8 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from . import devicetime
 import numpy as np
 
 INT_INF = np.int32(2**31 - 1)
@@ -292,11 +294,12 @@ def batch_pack(jobs: list, engine: str = "auto", mesh=None) -> list:
             requests[slot, : reqs.shape[0]] = reqs
             frontiers[slot, : len(frontier)] = frontier
             caps[slot] = cap
-        node_ids, counts = ffd_pack_batched(
-            jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
-        )
-        node_ids = np.asarray(node_ids)
-        counts = np.asarray(counts)
+        with devicetime.track():
+            node_ids, counts = ffd_pack_batched(
+                jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
+            )
+            node_ids = np.asarray(node_ids)
+            counts = np.asarray(counts)
         for slot, g in enumerate(members):
             results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
     return results
@@ -329,11 +332,12 @@ def _batch_pack_sharded(mesh, jobs: list) -> list:
             requests[slot, : reqs.shape[0]] = reqs
             frontiers[slot, : len(frontier)] = frontier
             caps[slot] = cap
-        node_ids, counts, _fleet = sharded_batch_pack(
-            mesh, jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
-        )
-        node_ids = np.asarray(node_ids)
-        counts = np.asarray(counts)
+        with devicetime.track():
+            node_ids, counts, _fleet = sharded_batch_pack(
+                mesh, jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
+            )
+            node_ids = np.asarray(node_ids)
+            counts = np.asarray(counts)
         for slot, g in enumerate(members):
             results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
     return results

@@ -50,6 +50,7 @@ from .encode import (
     unique_requests,
 )
 from .kernels import allowed_host, allowed_kernel, build_compat_inputs, zone_ct_masks
+from . import devicetime
 from .pack import (
     assign_cheapest_types,
     batch_pack,
@@ -201,6 +202,16 @@ COMPAT_MIN_DEVICE_WORK = int(
 )
 
 
+def _compat_threshold() -> int:
+    """Live compat routing threshold (calibrate.compat_min_device_work:
+    env override > on-chip calibration > fallback). The fallback reads
+    the module attribute at call time so tests can monkeypatch
+    COMPAT_MIN_DEVICE_WORK."""
+    from .calibrate import compat_min_device_work
+
+    return compat_min_device_work(fallback=COMPAT_MIN_DEVICE_WORK)
+
+
 def _entry_device_packed(entry: _CatalogEntry):
     """Packed, device-resident type-side mask tensors for `entry`,
     re-uploaded only when the vocab grew (pinned-buffer design from
@@ -218,15 +229,16 @@ def _entry_device_packed(entry: _CatalogEntry):
         return entry.device_packed[1]
     keys = tuple(sorted(enc.key_masks.keys()))
     tp, th, tn, offsets, widths = pack_masks(enc.key_masks, enc.key_has, enc.key_neg, keys)
-    data = (
-        keys,
-        jax.device_put(jnp.asarray(tp)),
-        jax.device_put(jnp.asarray(th)),
-        jax.device_put(jnp.asarray(tn)),
-        offsets,
-        widths,
-        jax.device_put(jnp.asarray(enc.offering_avail)),
-    )
+    with devicetime.track():  # catalog upload is device-attributable
+        data = (
+            keys,
+            jax.device_put(jnp.asarray(tp)),
+            jax.device_put(jnp.asarray(th)),
+            jax.device_put(jnp.asarray(tn)),
+            offsets,
+            widths,
+            jax.device_put(jnp.asarray(enc.offering_avail)),
+        )
     entry.device_packed = (snapshot, data)
     return data
 
@@ -389,6 +401,8 @@ class TPUScheduler:
         self.cluster = cluster
         self.recorder = recorder
         self.metrics = metrics
+        # device/host wall-time split of the most recent solve
+        self.last_timings: Optional[Dict[str, float]] = None
 
     def _phase(self, name: str):
         """Timer context for one solve phase → histogram metric (the
@@ -415,6 +429,7 @@ class TPUScheduler:
 
         profile_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
         t0 = _time.perf_counter()
+        devicetime.reset()
         try:
             if profile_dir:
                 import jax
@@ -423,8 +438,18 @@ class TPUScheduler:
                     return self._solve(pods, state_nodes, daemonset_pods)
             return self._solve(pods, state_nodes, daemonset_pods)
         finally:
+            total = _time.perf_counter() - t0
+            device = devicetime.seconds()
+            # the device-vs-host split per solve (VERDICT r4: "TPU-native"
+            # must be measurable) — also exposed in bench engines blocks
+            self.last_timings = {
+                "total_ms": total * 1000.0,
+                "device_ms": device * 1000.0,
+                "host_ms": (total - device) * 1000.0,
+            }
             if self.metrics is not None:
-                self.metrics.solver_duration.observe(_time.perf_counter() - t0)
+                self.metrics.solver_duration.observe(total)
+                self.metrics.solver_device_duration.observe(device)
 
     def _solve(
         self,
@@ -1001,6 +1026,9 @@ class TPUScheduler:
         from .backend import default_backend
 
         backend = default_backend()
+        # calibration (first call measures the chip's dispatch floor) must
+        # also run before the catalog lock — it blocks on device roundtrips
+        compat_threshold = _compat_threshold() if backend == "tpu" else 0
         # multi-chip: shard the compat type-axis and the pack group-axis
         # over the mesh (SURVEY §5); None on single-device — behavior
         # there is untouched
@@ -1039,12 +1067,13 @@ class TPUScheduler:
                     # result
                     from .sharding import allowed_sharded
 
-                    fut = allowed_sharded(
-                        _entry_sharded(e, mesh), sig_arrays, zone_ok, ct_ok, keys
-                    )
+                    with devicetime.track():
+                        fut = allowed_sharded(
+                            _entry_sharded(e, mesh), sig_arrays, zone_ok, ct_ok, keys
+                        )
                 elif (
                     backend == "tpu"
-                    and S_ * T_ < COMPAT_MIN_DEVICE_WORK
+                    and S_ * T_ < compat_threshold
                     and S_ < _PALLAS_MIN_S
                 ):
                     # small-S regime: the tunneled chip's dispatch floor
@@ -1085,32 +1114,34 @@ class TPUScheduler:
                         "sig/type chunk layouts diverged — vocab grew between "
                         "snapshot and pack"
                     )
-                    fut = allowed_pallas(
-                        sp,
-                        sh,
-                        sn,
-                        sig_arrays["valid"],
-                        tp,
-                        th,
-                        tn,
-                        zone_ok,
-                        ct_ok,
-                        avail_dev,
-                        offsets,
-                        widths,
-                        interpret=backend != "tpu",
-                    )
+                    with devicetime.track():
+                        fut = allowed_pallas(
+                            sp,
+                            sh,
+                            sn,
+                            sig_arrays["valid"],
+                            tp,
+                            th,
+                            tn,
+                            zone_ok,
+                            ct_ok,
+                            avail_dev,
+                            offsets,
+                            widths,
+                            interpret=backend != "tpu",
+                        )
                 else:
-                    fut = allowed_kernel(
-                        {k: np.asarray(v) for k, v in sig_arrays.items()},
-                        enc.key_masks,
-                        enc.key_has,
-                        enc.key_neg,
-                        zone_ok,
-                        ct_ok,
-                        enc.offering_avail,
-                        keys,
-                    )
+                    with devicetime.track():
+                        fut = allowed_kernel(
+                            {k: np.asarray(v) for k, v in sig_arrays.items()},
+                            enc.key_masks,
+                            enc.key_has,
+                            enc.key_neg,
+                            zone_ok,
+                            ct_ok,
+                            enc.offering_avail,
+                            keys,
+                        )
                 pending.append((fut, zone_ok, ct_ok))
 
         # --- per-pod encoding (overlapped with the device dispatch) -----
@@ -1144,14 +1175,13 @@ class TPUScheduler:
                 resources.requests_for_pods(*daemons) if daemons else {}, axis_ext
             )
 
-        allowed_per_pool = [
-            (
-                fut() if isinstance(fut, _DeferredHostCompat) else np.asarray(fut),
-                zone_ok,
-                ct_ok,
-            )
-            for fut, zone_ok, ct_ok in pending
-        ]
+        allowed_per_pool = []
+        for fut, zone_ok, ct_ok in pending:
+            if isinstance(fut, _DeferredHostCompat):
+                allowed_per_pool.append((fut(), zone_ok, ct_ok))
+            else:
+                with devicetime.track():  # blocks on the device result
+                    allowed_per_pool.append((np.asarray(fut), zone_ok, ct_ok))
 
         if self.metrics is not None:
             self.metrics.solver_phase_duration.observe(

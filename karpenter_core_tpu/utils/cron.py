@@ -11,6 +11,7 @@ duration window, since cron's resolution is one minute.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import List, Optional, Tuple
 
@@ -106,32 +107,58 @@ class Schedule:
         self.dom_restricted = self.dom != frozenset(range(1, 32))
         self.dow_restricted = self.dow != frozenset(range(0, 7))
 
-    def matches(self, ts: float) -> bool:
-        t = time.gmtime(ts)
-        if t.tm_min not in self.minute or t.tm_hour not in self.hour or t.tm_mon not in self.month:
+    def _day_matches(self, t) -> bool:
+        if t.tm_mon not in self.month:
             return False
         cron_dow = (t.tm_wday + 1) % 7  # tm_wday: Mon=0 → cron: Sun=0
         dom_ok = t.tm_mday in self.dom
         dow_ok = cron_dow in self.dow
         if self.dom_restricted and self.dow_restricted:
+            # cron quirk: when BOTH fields are restricted, either suffices
             return dom_ok or dow_ok
         return dom_ok and dow_ok
+
+    def matches(self, ts: float) -> bool:
+        t = time.gmtime(ts)
+        if t.tm_min not in self.minute or t.tm_hour not in self.hour:
+            return False
+        return self._day_matches(t)
+
+    def last_hit(self, now: float, earliest: float) -> Optional[float]:
+        """Most recent hit h with earliest < h <= now, or None. Scans
+        whole days backwards — non-matching days cost O(1), so a long
+        inactive window is days, not minutes, of work per check."""
+        hours_desc = sorted(self.hour, reverse=True)
+        mins_desc = sorted(self.minute, reverse=True)
+        day_start = int(now) // 86400 * 86400
+        while day_start + 86400 > earliest:
+            t = time.gmtime(day_start)
+            if self._day_matches(t):
+                cap = now if day_start + 86400 > now else day_start + 86399
+                for h in hours_desc:
+                    if day_start + h * 3600 > cap:
+                        continue
+                    for m in mins_desc:
+                        ts = day_start + h * 3600 + m * 60
+                        if ts <= cap:
+                            return ts if ts > earliest else None
+            day_start -= 86400
+        return None
 
     def active_within(self, now: float, duration: float) -> bool:
         """True iff a hit h exists with h <= now < h + duration."""
         if duration <= 0:
             return False
-        # iterate the minute-aligned instants in (now - duration, now],
-        # newest first — the common "currently active" case exits on the
-        # first probe instead of scanning a week-long window
-        first = int(now - duration) // 60 * 60 + 60  # first whole minute after now-duration
-        for minute_ts in range(int(now) // 60 * 60, first - 1, -60):
-            if self.matches(minute_ts):
-                return True
-        return False
+        return self.last_hit(now, now - duration) is not None
 
 
 def parse(expr: str) -> Schedule:
+    return Schedule(expr)
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_schedule(expr: str) -> Schedule:
+    """Budgets re-check their schedules every reconcile pass — parse once."""
     return Schedule(expr)
 
 
@@ -147,6 +174,6 @@ def budget_is_active(schedule: Optional[str], duration: Optional[float], now: fl
         # active only when neither restricts (handled above), else inactive
         return False
     try:
-        return Schedule(schedule).active_within(now, duration)
+        return _cached_schedule(schedule).active_within(now, duration)
     except CronError:
         return False

@@ -1,0 +1,86 @@
+"""Engine-policy calibration + device/host time split (VERDICT r5 #1)."""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_core_tpu.solver import calibrate, devicetime
+
+
+class TestCalibration:
+    def setup_method(self):
+        calibrate.reset_for_tests()
+
+    def teardown_method(self):
+        calibrate.reset_for_tests()
+
+    def test_cpu_backend_measures_host_rate_only(self):
+        cal = calibrate.calibration(force=True)
+        assert cal["backend"] == "cpu"  # conftest pins JAX_PLATFORMS=cpu
+        assert cal["host_ns_per_unit"] > 0
+        assert "dispatch_floor_ms" not in cal
+
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_COMPAT_MIN_WORK", "12345")
+        assert calibrate.compat_min_device_work() == 12345
+
+    def test_static_fallback_without_chip(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_COMPAT_MIN_WORK", raising=False)
+        # CPU backend: no measured threshold -> static default
+        assert calibrate.compat_min_device_work() == calibrate._STATIC_DEFAULT
+
+    def test_threshold_derivation_clamped(self, monkeypatch):
+        # a fake measured floor derives floor/host_rate, clamped to range
+        calibrate._CAL = {
+            "backend": "tpu",
+            "host_ns_per_unit": 10.0,
+            "dispatch_floor_ms": 65.0,
+            "compat_min_device_work": max(
+                calibrate._MIN_THRESHOLD,
+                min(calibrate._MAX_THRESHOLD, int(0.065 / (10.0e-9))),
+            ),
+        }
+        monkeypatch.delenv("KARPENTER_TPU_COMPAT_MIN_WORK", raising=False)
+        got = calibrate.compat_min_device_work()
+        assert calibrate._MIN_THRESHOLD <= got <= calibrate._MAX_THRESHOLD
+        # 65 ms floor / 10 ns-per-unit = 6.5M units, inside the clamp
+        assert got == int(0.065 / 10.0e-9)
+
+
+class TestDeviceTime:
+    def test_accumulates_and_resets(self):
+        devicetime.reset()
+        with devicetime.track():
+            pass
+        with devicetime.track():
+            pass
+        assert devicetime.seconds() > 0
+        devicetime.reset()
+        assert devicetime.seconds() == 0.0
+
+    def test_solver_records_split(self):
+        from helpers import make_nodepool, make_pod
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        provider = FakeCloudProvider()
+        solver = TPUScheduler([make_nodepool()], provider)
+        pods = [make_pod(name=f"p-{i}", requests={"cpu": "100m"}) for i in range(20)]
+        solver.solve(pods)
+        t = solver.last_timings
+        assert t is not None
+        assert t["total_ms"] > 0
+        assert t["device_ms"] >= 0
+        assert t["host_ms"] == pytest.approx(t["total_ms"] - t["device_ms"])
+
+    def test_device_metric_observed(self):
+        from helpers import make_nodepool, make_pod
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.metrics import Metrics
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        m = Metrics()
+        provider = FakeCloudProvider()
+        solver = TPUScheduler([make_nodepool()], provider, metrics=m)
+        solver.solve([make_pod(name="p", requests={"cpu": "100m"})])
+        assert sum(m.solver_device_duration.totals.values()) >= 1
