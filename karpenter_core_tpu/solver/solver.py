@@ -403,6 +403,10 @@ class TPUScheduler:
         self.metrics = metrics
         # device/host wall-time split of the most recent solve
         self.last_timings: Optional[Dict[str, float]] = None
+        # prep-time topology ledger state (rebuilt per tensor pass;
+        # empty defaults keep direct sub-method calls in tests working)
+        self._prep_zone_ledger: List[Tuple[int, str]] = []
+        self._ledger_selectors: List[tuple] = []
 
     def _phase(self, name: str):
         """Timer context for one solve phase → histogram metric (the
@@ -471,6 +475,11 @@ class TPUScheduler:
         # (topology.go:71-75) and is cached per constraint per solve
         self._batch_uids = {p.uid for p in pods}
         self._seed_cache: Dict[tuple, Dict[str, int]] = {}
+        # prep-time (pod index, zone) ledger of zone-pinned assignments:
+        # later counting groups fold these so mutually-counting groups
+        # see a serially-consistent order (each group counts everything
+        # assigned before it, exactly like the oracle's Record stream)
+        self._prep_zone_ledger: List[Tuple[int, str]] = []
         groups = group_pods(pods, memos=memos)
         def exclude(pool: List[SignatureGroup], subset: List[SignatureGroup]):
             """pool minus subset, by identity (dataclass __eq__ is deep)."""
@@ -511,17 +520,15 @@ class TPUScheduler:
         # with existing capacity also go oracle: their per-node counts
         # interleave with the existing-node pack in a way the batched
         # pack doesn't model.
+        # cross-selector SPREAD tensorizes (r5): a non-self-selecting
+        # group's counts are static (all pods take the min-count domain,
+        # topologygroup.go:166-175), and self-selecting groups that also
+        # count other groups see them through the prep-time zone ledger
+        # (_fold_ledger) in a serially-consistent order. Only AFFINITY
+        # selectors matching other groups still need the oracle's world.
         cross = []
         for g in tensor_groups:
-            sels = [
-                c.label_selector
-                for c in g.exemplar.spec.topology_spread_constraints
-                if c.label_selector is not None
-            ]
-            # self-affinity/anti-affinity selectors too: "self" means the
-            # selector matches the group's own labels, but a broader
-            # selector that ALSO matches another group needs the
-            # oracle's global counting
+            sels = []
             a = g.exemplar.spec.affinity
             if a is not None and (
                 g.self_pod_affinity() or g.zone_anti_isolated or g.hostname_isolated
@@ -973,6 +980,21 @@ class TPUScheduler:
         result: SolverResult,
         state_nodes: Optional[list] = None,
     ) -> None:
+        # the prep-time ledger is PER PASS: once this pass's pack commits,
+        # placements live in result.node_plans and _fold_committed counts
+        # them — a retry pass folding stale ledger entries would count the
+        # same pods twice (and count pods whose pack failed)
+        self._prep_zone_ledger = []
+        # ledger only pods some in-batch counting selector can see — the
+        # fold is a Python scan, so plain ride-alongs nobody counts must
+        # not inflate it at headline scale
+        self._ledger_selectors = []
+        for g in groups:
+            zc = g.zone_spread()
+            if zc is not None:
+                self._ledger_selectors.append(
+                    (zc.label_selector, g.exemplar.namespace)
+                )
         # --- existing capacity first (scheduler.go:241-246) -------------
         # per-group indices still needing placement after the existing-
         # node pack; starts as every pod in the group
@@ -1420,9 +1442,21 @@ class TPUScheduler:
 
         # per-pod max-pods-per-node from hostname spread / self anti-affinity
         max_per_node = np.int32(2**31 - 1)
+        solo_cross_hostname = False
         hs = group.hostname_spread()
         if hs is not None:
-            max_per_node = np.int32(hs.max_skew)
+            sel = hs.label_selector
+            if sel is None or sel.matches(group.exemplar.metadata.labels):
+                max_per_node = np.int32(hs.max_skew)
+            else:
+                # non-self-selecting hostname spread: the reference adds
+                # no +1 for non-matching pods (topologygroup.go:166-175)
+                # and hostname min is always 0, so fresh nodes are always
+                # admissible and the group's own pods stack freely — but
+                # the group must not share nodes with pods its selector
+                # counts, so it packs solo on new nodes only (a strict
+                # subset of the oracle's admissible placements)
+                solo_cross_hostname = True
         if group.hostname_isolated:
             max_per_node = np.int32(1)
 
@@ -1435,6 +1469,7 @@ class TPUScheduler:
             zone_ok=allowed_per_pool[chosen][1][gi],  # (Z,)
             ct_ok=allowed_per_pool[chosen][2][gi],  # (C,)
             max_per_node=max_per_node,
+            solo_cross_hostname=solo_cross_hostname,
             merged=sig_compats[chosen][gi].merged,  # template ∩ pod reqs
         )
 
@@ -1462,6 +1497,7 @@ class TPUScheduler:
             g_ = info["group"]
             if (
                 int(info["max_per_node"]) < 2**31 - 1
+                or info.get("solo_cross_hostname")
                 or g_.self_pod_affinity() is not None
                 or g_.zone_anti_isolated
             ):
@@ -1578,6 +1614,7 @@ class TPUScheduler:
                     part = p_idx[zi::Z]
                     if part.size:
                         buckets[z].append(part)
+                        self._ledger_add(pods, part, z)
             elif plain:
                 idx, reqs = sorted_idx([i for m in plain for i in m["indices"]])
                 self._prepare_job(
@@ -1656,6 +1693,40 @@ class TPUScheduler:
                     seeds[z] = seeds.get(z, 0) + n
         return seeds
 
+    def _ledger_add(self, pods: List[Pod], part, zone: str) -> None:
+        if not self._ledger_selectors:
+            return
+        for i in part.tolist():
+            p = pods[int(i)]
+            labels = p.metadata.labels
+            for sel, ns in self._ledger_selectors:
+                if ns == p.namespace and (sel is None or sel.matches(labels)):
+                    self._prep_zone_ledger.append((int(i), zone))
+                    break
+
+    def _fold_ledger(
+        self,
+        seeds: Dict[str, int],
+        selector,
+        namespace: str,
+        pods: List[Pod],
+    ) -> Dict[str, int]:
+        """Fold this solve's prep-time zone-pinned assignments into the
+        seeds — the in-batch analogue of the oracle recording each
+        placement before counting the next (topology.go:125). Unpinned
+        jobs (no zone until post-pack) are deliberately absent: they
+        correspond to pods placed after every counting group."""
+        if not self._prep_zone_ledger:
+            return seeds
+        seeds = dict(seeds)
+        for i, z in self._prep_zone_ledger:
+            p = pods[i]
+            if p.namespace == namespace and (
+                selector is None or selector.matches(p.metadata.labels)
+            ):
+                seeds[z] = seeds.get(z, 0) + 1
+        return seeds
+
     @staticmethod
     def _existing_compat_row(group: SignatureGroup, ctx: dict) -> np.ndarray:
         row = ctx["compat_rows"].get(id(group))
@@ -1685,12 +1756,17 @@ class TPUScheduler:
         P = len(g_idx)
         if P == 0:
             return
-        seeds = self._fold_committed(
-            self._spread_seeds(group, c),
+        seeds = self._fold_ledger(
+            self._fold_committed(
+                self._spread_seeds(group, c),
+                c.label_selector,
+                group.exemplar.namespace,
+                pods,
+                result,
+            ),
             c.label_selector,
             group.exemplar.namespace,
             pods,
-            result,
         )
         ctx = self._existing_ctx
         merged = m["merged"]
@@ -1708,7 +1784,11 @@ class TPUScheduler:
         # per-node matching-count quota), so for them existing-only
         # zones are NOT placement domains — adding them would assign
         # quotas that respill and break the zone skew.
-        can_use_existing = ctx is not None and int(m["max_per_node"]) >= 2**31 - 1
+        can_use_existing = (
+            ctx is not None
+            and int(m["max_per_node"]) >= 2**31 - 1
+            and not m.get("solo_cross_hostname")
+        )
         place = list(zones)
         existing_zones: set = set()
         if can_use_existing:
@@ -1729,9 +1809,35 @@ class TPUScheduler:
             c.min_domains if c.when_unsatisfiable != SCHEDULE_ANYWAY else None
         )
         counts = np.array([seeds.get(z, 0) for z in place], dtype=np.int64)
-        quotas, unplaced = spread_quotas(
-            counts, ext_min, c.max_skew, min_domains, len(supported), P
-        )
+        sel = c.label_selector
+        self_selecting = sel is None or sel.matches(group.exemplar.metadata.labels)
+        if self_selecting:
+            quotas, unplaced = spread_quotas(
+                counts, ext_min, c.max_skew, min_domains, len(supported), P
+            )
+        else:
+            # cross-selector spread: the group's own placements never move
+            # the counts (topologygroup.go:166-175 adds the +1 only when
+            # the pod matches its own selector), so the min-count domain
+            # is static and EVERY pod takes it — no water-fill
+            if min_domains is not None and len(supported) < min_domains:
+                global_min = 0  # topologygroup.go:205-210
+            else:
+                global_min = min(
+                    (seeds.get(d, 0) for d in supported), default=0
+                )
+            admissible = [
+                zi
+                for zi in range(len(place))
+                if counts[zi] - global_min <= c.max_skew
+            ]
+            quotas = np.zeros(len(place), dtype=np.int64)
+            if admissible:
+                target = min(admissible, key=lambda zi: counts[zi])
+                quotas[target] = P
+                unplaced = 0
+            else:
+                unplaced = P
         parts = interleave_by_quota(g_idx, quotas)
         if unplaced:
             # DoNotSchedule overflow fails like the oracle's DoesNotExist
@@ -1746,11 +1852,14 @@ class TPUScheduler:
         for zi, z in enumerate(place):
             part = parts[zi]
             if part.size and can_use_existing and z in existing_zones:
+                # pods landing on existing nodes become existing_plans at
+                # prep — _fold_committed counts those; no ledger entry
                 part = self._pack_spread_existing(part, z, group, ctx, result)
             if part.size == 0:
                 continue
             if z in buckets:  # new-node-eligible zone
                 buckets[z].append(part)
+                self._ledger_add(pods, part, z)
             else:
                 respill.append(part)
         if respill:
@@ -1764,6 +1873,7 @@ class TPUScheduler:
                 + sum(int(p.size) for p in buckets[z]),
             )
             buckets[tgt].append(spill)
+            self._ledger_add(pods, spill, tgt)
 
     def _affinity_assign(
         self,

@@ -1,0 +1,187 @@
+"""Cross-selector topology on the tensor path (VERDICT r5 #2).
+
+Reference semantics (topologygroup.go:163-189): a spread constraint
+whose selector does NOT match the pod itself contributes no +1 at
+placement, so the group's own placements never move its counts — every
+pod takes the static min-count domain. Self-selecting groups whose
+selector ALSO matches other in-batch groups see those groups'
+zone-pinned placements through the prep-time ledger, in a serially
+consistent order (some valid pod ordering of the reference's greedy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod, spread
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+from karpenter_core_tpu.solver import TPUScheduler
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def _provider(n=10):
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(n)
+    return provider
+
+
+def _solve(pods, kube=None, provider=None):
+    return TPUScheduler(
+        [make_nodepool()], provider or _provider(), kube_client=kube or KubeClient()
+    ).solve(pods)
+
+
+def _oracle(pods, kube=None, provider=None):
+    return build_scheduler(
+        kube or KubeClient(), None, [make_nodepool()], provider or _provider(), pods
+    ).solve(pods)
+
+
+def _zone_counts(result, pods, selector_labels):
+    counts = {}
+    for plan in result.node_plans:
+        for i in plan.pod_indices:
+            if all(pods[i].metadata.labels.get(k) == v for k, v in selector_labels.items()):
+                counts[plan.zone] = counts.get(plan.zone, 0) + 1
+    return counts
+
+
+class TestCrossSelectorSpread:
+    def test_pure_cross_spread_stays_tensor_and_schedules(self):
+        # spread pods select OTHER pods' labels: tensor path, no oracle
+        pods = [
+            make_pod(
+                name=f"s-{i}",
+                labels={"app": "spreader"},
+                requests={"cpu": "500m"},
+                topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": "other"})],
+            )
+            for i in range(6)
+        ] + [
+            make_pod(name=f"g-{i}", labels={"app": "other"}, requests={"cpu": "500m"})
+            for i in range(6)
+        ]
+        t = _solve(pods)
+        assert t.oracle_results is None  # nothing routed to the oracle
+        assert t.pods_scheduled == 12 and not t.pod_errors
+        # all cross-spread pods land in ONE zone (static min-count domain)
+        zones = {
+            plan.zone
+            for plan in t.node_plans
+            for i in plan.pod_indices
+            if pods[i].metadata.labels["app"] == "spreader"
+        }
+        assert len(zones) == 1
+
+    def test_cross_spread_respects_seeded_skew(self):
+        # existing matching pods make one zone inadmissible
+        kube = KubeClient()
+        provider = _provider()
+        seed_nodes = []
+        for zi, count in ((0, 3), (1, 0), (2, 0)):
+            node = make_node(
+                labels={
+                    wk.LABEL_TOPOLOGY_ZONE: ZONES[zi],
+                    wk.NODEPOOL_LABEL_KEY: "default",
+                    wk.LABEL_INSTANCE_TYPE: "fake-it-4",
+                    wk.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                },
+                capacity={"cpu": "16", "memory": "32Gi", "pods": "110"},
+            )
+            kube.create(node)
+            for j in range(count):
+                p = make_pod(
+                    name=f"seed-{zi}-{j}",
+                    labels={"app": "counted"},
+                    requests={"cpu": "100m"},
+                    node_name=node.name,
+                    pending_unschedulable=False,
+                )
+                p.status.phase = "Running"
+                kube.create(p)
+        pods = [
+            make_pod(
+                name=f"s-{i}",
+                labels={"app": "spreader"},
+                requests={"cpu": "500m"},
+                topology_spread=[
+                    spread(wk.LABEL_TOPOLOGY_ZONE, max_skew=1, labels={"app": "counted"})
+                ],
+            )
+            for i in range(4)
+        ]
+        t = _solve(pods, kube=kube)
+        assert t.pods_scheduled == 4 and not t.pod_errors
+        landed = {plan.zone for plan in t.node_plans}
+        # zone-1 has count 3 vs min 0 > max_skew 1: inadmissible
+        assert ZONES[0] not in landed and len(landed) == 1
+
+    def test_mutually_counting_spread_groups_serially_consistent(self):
+        # group A self-selects AND counts group B's labels; B places
+        # first in prep order or not — either way the ledger makes the
+        # later group see the earlier one's zones
+        sel = {"tier": "web"}
+        pods = [
+            make_pod(
+                name=f"a-{i}",
+                labels={"tier": "web", "grp": "a"},
+                requests={"cpu": "500m"},
+                topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, max_skew=1, labels=sel)],
+            )
+            for i in range(6)
+        ] + [
+            make_pod(
+                name=f"b-{i}",
+                labels={"tier": "web", "grp": "b"},
+                requests={"cpu": "250m"},
+                topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, max_skew=1, labels=sel)],
+            )
+            for i in range(6)
+        ]
+        t = _solve(pods)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 12 and not t.pod_errors
+        # COMBINED counts of selector-matching pods stay within skew 1 —
+        # only possible if the second group counted the first
+        counts = _zone_counts(t, pods, sel)
+        assert counts and max(counts.values()) - min(counts.values()) <= 1
+        # and every known zone got its share (3 zones x 12 pods -> 4 each)
+        assert sorted(counts.values()) == [4, 4, 4]
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_randomized_cross_spread_oracle_parity(self, seed):
+        rng = np.random.RandomState(seed)
+        vals = ["a", "b", "c"]
+        pods = []
+        for i in range(rng.randint(8, 20)):
+            labels = {"my-label": vals[rng.randint(3)]}
+            constraint = None
+            if rng.rand() < 0.5:
+                constraint = [
+                    spread(
+                        wk.LABEL_TOPOLOGY_ZONE,
+                        max_skew=int(rng.randint(1, 3)),
+                        labels={"my-label": vals[rng.randint(3)]},
+                    )
+                ]
+            pods.append(
+                make_pod(
+                    name=f"p-{i}",
+                    labels=labels,
+                    requests={"cpu": ["250m", "500m", "1"][rng.randint(3)]},
+                    topology_spread=constraint,
+                )
+            )
+        t = _solve(pods)
+        o = _oracle(pods)
+        o_scheduled = sum(len(c.pods) for c in o.new_node_claims) + sum(
+            len(e.pods) for e in o.existing_nodes
+        )
+        assert t.oracle_results is None  # the whole draw stays tensor
+        assert t.pods_scheduled == o_scheduled
+        assert set(t.pod_errors) == set(o.pod_errors)
