@@ -446,10 +446,13 @@ class SignatureGroup:
         ns = list(term.namespaces)
         return not ns or ns == [self.exemplar.namespace]
 
-    def self_pod_affinity(self) -> Optional[str]:
-        """Topology key of a single self-selecting REQUIRED pod-affinity
-        term on zone/hostname (co-locate a deployment with itself), when
-        that is the group's only affinity shape — else None."""
+    def tensor_pod_affinity(self) -> Optional[str]:
+        """Topology key of a single REQUIRED pod-affinity term on
+        zone/hostname with no other affinity shape, whether or not the
+        selector matches the group itself — the shape the tensor path's
+        post-pack affinity resolution handles (cross-selector anchors
+        resolve against the batch's committed placements). Terms scoped
+        beyond the pod's own namespace stay on the oracle."""
         a = self.exemplar.spec.affinity
         if a is None or a.pod_affinity is None:
             return None
@@ -462,9 +465,38 @@ class SignatureGroup:
         term = a.pod_affinity.required[0]
         if term.topology_key not in (wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME):
             return None
-        if not self._is_self_term(term):
+        if term.label_selector is None:
+            # nil selector semantics differ between worlds (the reference
+            # treats it as match-nothing) — keep on the oracle
+            return None
+        if term.namespace_selector is not None:
+            return None
+        ns = list(term.namespaces)
+        if ns and ns != [self.exemplar.namespace]:
             return None
         return term.topology_key
+
+    def affinity_term(self):
+        """The single required pod-affinity term behind
+        tensor_pod_affinity (call only when it returned a key)."""
+        return self.exemplar.spec.affinity.pod_affinity.required[0]
+
+    def affinity_self_selecting(self) -> bool:
+        """Whether the group's pods match their own affinity selector —
+        gates the bootstrap-one-domain rule (topologygroup.go:226-232:
+        only self-selecting pods may seed an empty domain)."""
+        term = self.affinity_term()
+        sel = term.label_selector
+        return sel is None or sel.matches(self.exemplar.metadata.labels)
+
+    def self_pod_affinity(self) -> Optional[str]:
+        """Topology key of a single self-selecting REQUIRED pod-affinity
+        term on zone/hostname (co-locate a deployment with itself), when
+        that is the group's only affinity shape — else None."""
+        key = self.tensor_pod_affinity()
+        if key is None or not self._is_self_term(self.affinity_term()):
+            return None
+        return key
 
     @property
     def zone_anti_isolated(self) -> bool:
@@ -489,7 +521,7 @@ class SignatureGroup:
         if a is None:
             return False
         if a.pod_affinity is not None and (a.pod_affinity.required or a.pod_affinity.preferred):
-            if self.self_pod_affinity() is None:
+            if self.tensor_pod_affinity() is None:
                 return True
         if a.pod_anti_affinity is not None:
             req = a.pod_anti_affinity.required

@@ -8,9 +8,22 @@ Pipeline per solve:
   TPU:  ffd_pack scan per (group, zone)
   host: cheapest-type/offering per packed node → NodePlans
 
-Relational pods (pod affinity / non-self anti-affinity) and batches with
-existing capacity route to the greedy oracle
-(``karpenter_core_tpu.scheduler``) — same split SURVEY §7 prescribes.
+Remaining ORACLE-ONLY terms (everything else — including cross-selector
+topology spread and cross-selector single-term required pod affinity on
+zone/hostname, r5 — runs on the tensor path):
+  - pod ANTI-affinity whose selector matches pods outside the group
+    (inverse-anti semantics, topology.go:190-219: later placements of
+    the counted group could violate an earlier group's term — needs the
+    oracle's per-pod interleaving)
+  - anti-affinity with preferred terms, or on keys other than
+    zone/hostname
+  - affinity+anti-affinity or affinity+spread combinations on one pod
+  - multi-term or preferred pod affinity
+  - affinity terms with namespace selectors / cross-namespace lists
+  - groups whose counting selectors interact with oracle-routed groups
+    (either direction — the two worlds can't see each other's
+    placements mid-solve)
+  - stateful node constraints (host ports, PVC volumes)
 The oracle also serves as the parity reference: ``SolverResult``
 exposes node count and total price for comparison.
 """
@@ -475,6 +488,18 @@ class TPUScheduler:
         # (topology.go:71-75) and is cached per constraint per solve
         self._batch_uids = {p.uid for p in pods}
         self._seed_cache: Dict[tuple, Dict[str, int]] = {}
+        # selector-content fingerprint caches: many groups carry distinct
+        # selector OBJECTS with identical content (one per signature), so
+        # match results key on content, not identity
+        self._sel_fp_cache: Dict[int, tuple] = {}
+        self._match_cache: Dict[Tuple[tuple, int], bool] = {}
+        # (sel_fp, id(plan)) -> (members_len, matched) — anchor rescans
+        # only when a plan grew
+        self._plan_match_cache: Dict[Tuple[tuple, int], Tuple[int, bool]] = {}
+        # per-selector incremental committed-placement counters (cursors
+        # over the append/grow-only plan lists); cleared if limit
+        # enforcement ever strips plans
+        self._fold_cache: Dict[tuple, dict] = {}
         # prep-time (pod index, zone) ledger of zone-pinned assignments:
         # later counting groups fold these so mutually-counting groups
         # see a serially-consistent order (each group counts everything
@@ -530,16 +555,13 @@ class TPUScheduler:
         for g in tensor_groups:
             sels = []
             a = g.exemplar.spec.affinity
-            if a is not None and (
-                g.self_pod_affinity() or g.zone_anti_isolated or g.hostname_isolated
-            ):
-                for pa in (a.pod_affinity, a.pod_anti_affinity):
-                    if pa is not None:
-                        sels.extend(
-                            t.label_selector
-                            for t in pa.required
-                            if t.label_selector is not None
-                        )
+            if a is not None and (g.zone_anti_isolated or g.hostname_isolated):
+                if a.pod_anti_affinity is not None:
+                    sels.extend(
+                        t.label_selector
+                        for t in a.pod_anti_affinity.required
+                        if t.label_selector is not None
+                    )
             if sels and any(
                 sel.matches(h.exemplar.metadata.labels)
                 for h in groups
@@ -549,6 +571,13 @@ class TPUScheduler:
                 cross.append(g)
         tensor_groups = exclude(tensor_groups, cross)
         oracle_groups = oracle_groups + cross
+        # pod-affinity groups of the tensorizable shape (single required
+        # zone/hostname term) resolve POST-PACK, sequentially, against the
+        # batch's committed placements — park them (r5; topologygroup.go:
+        # 215-247 semantics under the ordering that places counted groups
+        # first). Self-selecting single-term groups take the same path.
+        parked = [g for g in tensor_groups if g.tensor_pod_affinity() is not None]
+        tensor_groups = exclude(tensor_groups, parked)
         # hostname topologies stay tensor even with existing capacity:
         # hostname domains always see a global min of 0
         # (topologygroup.go:193-196), so the semantics reduce to a
@@ -583,27 +612,52 @@ class TPUScheduler:
             return sels
 
         frontier = list(oracle_groups)
-        while frontier and tensor_groups:
+        while frontier and (tensor_groups or parked):
             frontier_sels = [s for g in frontier for s in counting_selectors(g)]
-            if not frontier_sels:
+            frontier_labels = [g.exemplar.metadata.labels for g in frontier]
+            moved = []
+            if frontier_sels:
+                # groups the oracle world counts must live in it
+                moved += [
+                    g
+                    for g in tensor_groups + parked
+                    if any(
+                        s.matches(g.exemplar.metadata.labels)
+                        for s in frontier_sels
+                    )
+                ]
+            # parked groups ANCHORING on oracle pods must live there too:
+            # their admissible domains depend on placements the oracle
+            # makes after the tensor pass
+            moved_ids = {id(m) for m in moved}
+            for g in parked:
+                if id(g) in moved_ids:
+                    continue
+                sel = g.affinity_term().label_selector
+                if sel is not None and any(
+                    sel.matches(labels) for labels in frontier_labels
+                ):
+                    moved.append(g)
+            if not moved:
                 break
-            pulled_more = [
-                g
-                for g in tensor_groups
-                if any(s.matches(g.exemplar.metadata.labels) for s in frontier_sels)
-            ]
-            tensor_groups = exclude(tensor_groups, pulled_more)
-            oracle_groups = oracle_groups + pulled_more
-            frontier = pulled_more
+            tensor_groups = exclude(tensor_groups, moved)
+            parked = exclude(parked, moved)
+            oracle_groups = oracle_groups + moved
+            frontier = moved
         oracle_pods: List[Pod] = [
             pods[i] for g in oracle_groups for i in g.pod_indices
         ]
 
         self._committed_plans: set = set()
-        if tensor_groups:
+        if tensor_groups or parked:
             sns = list(state_nodes or ())
-            self._solve_tensor(pods, tensor_groups, daemonset_pods or [], result, state_nodes=sns)
-            self._relax_and_retry(pods, tensor_groups, daemonset_pods or [], result, sns)
+            self._solve_tensor(
+                pods, tensor_groups, daemonset_pods or [], result,
+                state_nodes=sns, parked_groups=parked,
+            )
+            self._relax_and_retry(
+                pods, tensor_groups + parked, daemonset_pods or [], result, sns
+            )
         if oracle_pods:
             # the oracle must see capacity net of tensor-path placements:
             # commit them onto the (already deep-copied) state nodes
@@ -687,7 +741,12 @@ class TPUScheduler:
             retry = self._backfill_node_plans(pods, retry, daemonset_pods, result)
             if not retry:
                 return
-            self._solve_tensor(pods, retry, daemonset_pods, result, state_nodes=state_nodes)
+            parked_retry = [g for g in retry if g.tensor_pod_affinity() is not None]
+            regular_retry = [g for g in retry if g.tensor_pod_affinity() is None]
+            self._solve_tensor(
+                pods, regular_retry, daemonset_pods, result,
+                state_nodes=state_nodes, parked_groups=parked_retry,
+            )
             groups = retry
 
     _BACKFILL_SCAN_CAP = 256  # plans examined per retry group
@@ -867,7 +926,7 @@ class TPUScheduler:
 
         nodes = sorted(state_nodes, key=lambda n: (not n.initialized(), n.name()))
         M = len(nodes)
-        if M == 0 or not groups:
+        if M == 0:
             return
         # axis spans ALL batch requests (spread pods quantize against the
         # same axis later, zone-pinned)
@@ -921,6 +980,8 @@ class TPUScheduler:
             compat_rows={},
         )
 
+        if not groups:
+            return  # parked-only batch: ctx stashed for the post-pass
         # topology-constrained groups (zone spread, self-affinity, zone
         # anti-affinity) are domain-assigned before touching existing
         # capacity — exclude them from this selector-blind pack
@@ -928,7 +989,7 @@ class TPUScheduler:
             (gi, g)
             for gi, g in enumerate(groups)
             if g.zone_spread() is None
-            and g.self_pod_affinity() is None
+            and g.tensor_pod_affinity() is None
             and not g.zone_anti_isolated
             and g.hostname_spread() is None
             and not g.hostname_isolated
@@ -979,6 +1040,7 @@ class TPUScheduler:
         daemonset_pods: List[Pod],
         result: SolverResult,
         state_nodes: Optional[list] = None,
+        parked_groups: tuple = (),
     ) -> None:
         # the prep-time ledger is PER PASS: once this pass's pack commits,
         # placements live in result.node_plans and _fold_committed counts
@@ -995,19 +1057,23 @@ class TPUScheduler:
                 self._ledger_selectors.append(
                     (zc.label_selector, g.exemplar.namespace)
                 )
+        # parked (pod-affinity) groups join the catalog/compat encode but
+        # skip the round pipeline — they resolve post-pack, sequentially
+        parked_from = len(groups)
+        groups = list(groups) + list(parked_groups)
         # --- existing capacity first (scheduler.go:241-246) -------------
         # per-group indices still needing placement after the existing-
         # node pack; starts as every pod in the group
         self._existing_ctx: Optional[dict] = None
         leftover: Dict[int, List[int]] = {
-            gi: list(g.pod_indices) for gi, g in enumerate(groups)
+            gi: list(groups[gi].pod_indices) for gi in range(parked_from)
         }
         if state_nodes:
             with self._phase("existing_pack"):
                 self._pack_existing(
-                    pods, groups, daemonset_pods, state_nodes, leftover, result
+                    pods, groups[:parked_from], daemonset_pods, state_nodes, leftover, result
                 )
-            if not any(leftover.values()):
+            if not any(leftover.values()) and not parked_groups:
                 return
 
         # --- encode catalog per pool -----------------------------------
@@ -1033,8 +1099,11 @@ class TPUScheduler:
             )
             pool_catalogs.append(its)
         if not pools:
-            for gi in range(len(groups)):
+            for gi in range(parked_from):
                 for i in leftover[gi]:
+                    result.pod_errors[pods[i].uid] = "no nodepool found"
+            for g in groups[parked_from:]:
+                for i in g.pod_indices:
                     result.pod_errors[pods[i].uid] = "no nodepool found"
             return
 
@@ -1292,6 +1361,23 @@ class TPUScheduler:
                     pods[i].uid,
                     f'all available instance types exceed limits for nodepool: "{pool_name}"',
                 )
+        if parked_from < len(groups):
+            with self._phase("affinity_postpass"):
+                self._affinity_postpass(
+                    pods,
+                    groups,
+                    list(range(parked_from, len(groups))),
+                    pools,
+                    encoded,
+                    sig_compats,
+                    allowed_per_pool,
+                    matrices,
+                    pool_entries,
+                    daemon_requests,
+                    result,
+                    remaining,
+                    mesh,
+                )
         if self.metrics is not None:
             self.metrics.solver_phase_duration.observe(
                 _time.perf_counter() - _pack_t0, phase="pack"
@@ -1383,6 +1469,11 @@ class TPUScheduler:
                 continue
             remaining[plan.nodepool_name] = resources.subtract(rem, cap)
             kept.append(plan)
+        if len(kept) != len(result.node_plans) - plans_start:
+            # plans were stripped: the incremental fold counters assumed
+            # an append/grow-only plan list — rebuild from scratch
+            self._fold_cache = {}
+            self._plan_match_cache = {}
         result.node_plans[plans_start:] = kept
         return spilled
 
@@ -1498,7 +1589,6 @@ class TPUScheduler:
             if (
                 int(info["max_per_node"]) < 2**31 - 1
                 or info.get("solo_cross_hostname")
-                or g_.self_pod_affinity() is not None
                 or g_.zone_anti_isolated
             ):
                 key = ("solo", id(info["group"]))
@@ -1533,9 +1623,7 @@ class TPUScheduler:
                 return idx[order], reqs[order]
 
             g0 = members[0]["group"]
-            if len(members) == 1 and (
-                g0.self_pod_affinity() is not None or g0.zone_anti_isolated
-            ):
+            if len(members) == 1 and g0.zone_anti_isolated:
                 idx0, reqs0 = sorted_idx(members[0]["indices"])
                 self._affinity_assign(
                     members[0], idx0, reqs0, enc, pool, daemon, pods, result,
@@ -1658,6 +1746,48 @@ class TPUScheduler:
             self._seed_cache[key] = seeds
         return seeds
 
+    def _sel_fp(self, sel) -> tuple:
+        fp = self._sel_fp_cache.get(id(sel))
+        if fp is None:
+            fp = (
+                tuple(sorted(sel.match_labels.items())),
+                tuple(
+                    (e.key, e.operator, tuple(e.values))
+                    for e in sel.match_expressions
+                ),
+            )
+            self._sel_fp_cache[id(sel)] = fp
+        return fp
+
+    def _sel_matches(self, sel, i: int, pods: List[Pod]) -> bool:
+        if sel is None:
+            return True
+        key = (self._sel_fp(sel), i)
+        hit = self._match_cache.get(key)
+        if hit is None:
+            hit = sel.matches(pods[i].metadata.labels)
+            self._match_cache[key] = hit
+        return hit
+
+    def _plan_has_match(self, plan, sel, ns: str, pods: List[Pod]) -> bool:
+        """Does any plan member match (sel, ns)? Cached per selector
+        content and plan; rescans only members added since the last
+        check (plans only ever grow within a solve)."""
+        members = plan.pod_indices
+        if sel is None:
+            return any(pods[i].namespace == ns for i in members)
+        key = (self._sel_fp(sel), id(plan))
+        seen, matched = self._plan_match_cache.get(key, (0, False))
+        if matched:
+            return True
+        if seen < len(members):
+            for i in members[seen:]:
+                if pods[i].namespace == ns and self._sel_matches(sel, i, pods):
+                    matched = True
+                    break
+            self._plan_match_cache[key] = (len(members), matched)
+        return matched
+
     def _fold_committed(
         self,
         seeds: Dict[str, int],
@@ -1673,24 +1803,50 @@ class TPUScheduler:
         exist yet (the common single-pass solve)."""
         if not (result.node_plans or result.existing_plans):
             return seeds
-        seeds = dict(seeds)
+        # incremental: per selector-content, a cursor state counts each
+        # plan member exactly once — the affinity post-pass queries this
+        # hundreds of times against an ever-growing plan list
+        key = (
+            self._sel_fp(selector) if selector is not None else None,
+            namespace,
+        )
+        st = self._fold_cache.get(key)
+        if st is None:
+            st = {"sizes": {}, "ec": 0, "counts": {}}
+            self._fold_cache[key] = st
+        counts = st["counts"]
 
-        def _matches(i: int) -> bool:
-            p = pods[i]
-            return p.namespace == namespace and (
-                selector is None or selector.matches(p.metadata.labels)
-            )
+        def _count(members, start, zone):
+            n = 0
+            for i in members[start:]:
+                if pods[i].namespace == namespace and self._sel_matches(
+                    selector, i, pods
+                ):
+                    n += 1
+            if n and zone:
+                counts[zone] = counts.get(zone, 0) + n
 
+        sizes = st["sizes"]
         for plan in result.node_plans:
-            n = sum(1 for i in plan.pod_indices if _matches(i))
-            if n:
-                seeds[plan.zone] = seeds.get(plan.zone, 0) + n
-        for eplan in result.existing_plans:
-            z = eplan.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE)
-            if z:
-                n = sum(1 for i in eplan.pod_indices if _matches(i))
-                if n:
-                    seeds[z] = seeds.get(z, 0) + n
+            pid = id(plan)
+            seen = sizes.get(pid, 0)
+            members = plan.pod_indices
+            if len(members) > seen:  # new plan, or grown by a join
+                _count(members, seen, plan.zone)
+                sizes[pid] = len(members)
+        eplans = result.existing_plans
+        for eplan in eplans[st["ec"] :]:
+            _count(
+                eplan.pod_indices,
+                0,
+                eplan.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE),
+            )
+        st["ec"] = len(eplans)
+        if not counts:
+            return seeds
+        seeds = dict(seeds)
+        for z, n in counts.items():
+            seeds[z] = seeds.get(z, 0) + n
         return seeds
 
     def _ledger_add(self, pods: List[Pod], part, zone: str) -> None:
@@ -1698,9 +1854,8 @@ class TPUScheduler:
             return
         for i in part.tolist():
             p = pods[int(i)]
-            labels = p.metadata.labels
             for sel, ns in self._ledger_selectors:
-                if ns == p.namespace and (sel is None or sel.matches(labels)):
+                if ns == p.namespace and self._sel_matches(sel, int(i), pods):
                     self._prep_zone_ledger.append((int(i), zone))
                     break
 
@@ -1720,9 +1875,8 @@ class TPUScheduler:
             return seeds
         seeds = dict(seeds)
         for i, z in self._prep_zone_ledger:
-            p = pods[i]
-            if p.namespace == namespace and (
-                selector is None or selector.matches(p.metadata.labels)
+            if pods[i].namespace == namespace and self._sel_matches(
+                selector, i, pods
             ):
                 seeds[z] = seeds.get(z, 0) + 1
         return seeds
@@ -1888,24 +2042,11 @@ class TPUScheduler:
         jobs: List[tuple],
         metas: List[dict],
     ) -> None:
-        """Tensor-path self pod-affinity / zone anti-affinity (the
-        per-deployment co-location/isolation shapes; cross-selecting
-        terms route to the oracle in _solve). Mirrors the oracle's
-        nextDomainAffinity / nextDomainAntiAffinity
-        (topologygroup.go:215-257):
-
-        - affinity on zone: pods may go to any domain that already holds
-          a matching pod (anchors = seeded counts + this solve's
-          placements); with no anchors, bootstrap exactly ONE zone.
-        - affinity on hostname: pods join the anchor nodes' free space;
-          with no anchors, they co-locate onto ONE new node (the
-          largest size-descending prefix some viable type holds —
-          exactly where the oracle stops placing, since a second claim
-          would be a zero-count domain) and the rest fail.
-        - anti-affinity on zone: at most one pod per zone; zones with a
-          matching pod are full, extras fail.
-        """
-        from ..kube.objects import PodAffinityTerm
+        """Tensor-path self ZONE ANTI-affinity: at most one pod per zone;
+        zones with a matching pod are full, extras fail (mirrors
+        nextDomainAntiAffinity, topologygroup.go:249-257). Pod AFFINITY
+        groups no longer pass through here — they resolve post-pack in
+        _affinity_postpass."""
         from .topology_tensor import seed_counts_for_selector, water_fill
 
         group: SignatureGroup = m["group"]
@@ -1914,125 +2055,7 @@ class TPUScheduler:
         P = len(idx)
         ctx = self._existing_ctx
         zones, zone_types = _viable_zones(enc, viable, zone_ok, ct_ok)
-
-        akey = group.self_pod_affinity()
         a = group.exemplar.spec.affinity
-        if akey is not None:
-            term: PodAffinityTerm = a.pod_affinity.required[0]
-            seeds = seed_counts_for_selector(
-                self.kube_client,
-                group.exemplar,
-                akey,
-                term.label_selector,
-                self._batch_uids,
-            )
-            if akey == wk.LABEL_TOPOLOGY_ZONE:
-                # retries/limit rounds see this solve's landings too
-                seeds = self._fold_committed(
-                    seeds, term.label_selector, group.exemplar.namespace,
-                    pods, result,
-                )
-                have_anchors = any(v > 0 for v in seeds.values())
-                anchors = [z for z in zones if seeds.get(z, 0) > 0]
-                if have_anchors and not anchors:
-                    # matching pods exist, but only in zones this pool
-                    # can't serve — bootstrapping a fresh zone would
-                    # strand the pods (their affinity pins them to the
-                    # anchor zones); fail like the oracle's
-                    # nextDomainAffinity restriction
-                    for i in idx:
-                        result.pod_errors[pods[i].uid] = (
-                            "pod affinity anchors are outside viable zones"
-                        )
-                    return
-                if anchors:
-                    # any anchor zone is admissible: fill anchor-zone
-                    # existing capacity first, then a job with the zone
-                    # mask narrowed to the anchors
-                    part = idx
-                    if ctx is not None:
-                        for z in anchors:
-                            if not part.size:
-                                break
-                            part = self._pack_spread_existing(
-                                part, z, group, ctx, result
-                            )
-                    if part.size:
-                        sub = np.isin(idx, part)
-                        zmask = zone_ok & np.array(
-                            [z in anchors for z in enc.zones], dtype=bool
-                        )
-                        v = viable & enc.offering_avail[:, zmask, :][:, :, ct_ok].any(
-                            axis=(1, 2)
-                        )
-                        self._prepare_job(
-                            idx[sub], reqs[sub], enc, v, zmask, ct_ok, daemon,
-                            m["max_per_node"], pool, pods, result, jobs, metas,
-                            merged=m["merged"],
-                        )
-                elif zones:
-                    # no matching pod anywhere: bootstrap exactly one
-                    # zone — the one whose cheapest viable offering is
-                    # lowest (the oracle picks an arbitrary viable
-                    # domain; cheapest is a strict refinement)
-                    def zone_price(z: str) -> float:
-                        zi = enc.zones.index(z)
-                        p = enc.offering_price[zone_types[z], zi, :][:, ct_ok]
-                        p = np.where(np.isfinite(p), p, np.inf)
-                        return float(p.min()) if p.size else np.inf
-
-                    z_star = min(zones, key=zone_price)
-                    part = idx
-                    if ctx is not None:
-                        part = self._pack_spread_existing(
-                            part, z_star, group, ctx, result
-                        )
-                    if part.size:
-                        sub = np.isin(idx, part)
-                        self._prepare_job(
-                            idx[sub], reqs[sub], enc, zone_types[z_star],
-                            zone_ok, ct_ok, daemon, m["max_per_node"], pool,
-                            pods, result, jobs, metas, zone=z_star,
-                            merged=m["merged"],
-                        )
-                else:
-                    for i in idx:
-                        result.pod_errors[pods[i].uid] = (
-                            "no zone with viable offering for pod affinity"
-                        )
-                return
-            # hostname affinity: anchors are specific nodes. A committed
-            # co-located plan from an earlier pass also anchors the
-            # domain — a retry must not bootstrap a second node.
-            ns = group.exemplar.namespace
-            committed_anchor = any(
-                any(
-                    pods[i].namespace == ns
-                    and (
-                        term.label_selector is None
-                        or term.label_selector.matches(pods[i].metadata.labels)
-                    )
-                    for i in plan.pod_indices
-                )
-                for plan in result.node_plans
-            )
-            if seeds or committed_anchor:
-                anchor_left = idx
-                if ctx is not None and seeds:
-                    anchor_left = self._pack_affinity_hostname_existing(
-                        idx, group, seeds, ctx, result
-                    )
-                # remaining pods cannot join: a fresh claim is a
-                # zero-count domain
-                for i in anchor_left:
-                    result.pod_errors[pods[i].uid] = (
-                        "pod affinity on hostname: anchor nodes are full"
-                    )
-                return
-            self._pack_affinity_hostname_new(
-                idx, reqs, enc, pool, daemon, m, pods, result
-            )
-            return
 
         # zone anti-affinity: one pod per zone with no matching pod yet
         term = next(
@@ -2074,6 +2097,443 @@ class TPUScheduler:
             result.pod_errors[pods[i].uid] = (
                 "pod anti-affinity on zone: no zone without a matching pod"
             )
+
+    # ------------------------------------------------------------------
+    # post-pack pod-affinity resolution (r5: cross-selector terms
+    # tensorized; VERDICT r4 next #2)
+
+    def _topo_order_parked(
+        self, groups: List[SignatureGroup], parked_idx: List[int]
+    ) -> List[int]:
+        """Anchor-dependency order: if A's affinity selector matches B's
+        labels, B resolves first (its placements are A's admissible
+        domains). Kahn's algorithm; cycles fall back to input order —
+        whichever cycle member goes first legitimately sees no in-batch
+        anchors (the reference fails the same way under that pod order)."""
+        sel_of = {gi: groups[gi].affinity_term().label_selector for gi in parked_idx}
+        deps: Dict[int, set] = {gi: set() for gi in parked_idx}
+        for gi in parked_idx:
+            sel = sel_of[gi]
+            if sel is None:
+                continue
+            for gj in parked_idx:
+                if gj != gi and sel.matches(groups[gj].exemplar.metadata.labels):
+                    deps[gi].add(gj)
+        order: List[int] = []
+        placed: set = set()
+        pending = list(parked_idx)
+        while pending:
+            ready = [gi for gi in pending if deps[gi] <= placed]
+            if not ready:
+                ready = [pending[0]]  # cycle: break in input order
+            for gi in ready:
+                order.append(gi)
+                placed.add(gi)
+            pending = [gi for gi in pending if gi not in placed]
+        return order
+
+    def _affinity_postpass(
+        self,
+        pods: List[Pod],
+        groups: List[SignatureGroup],
+        parked_idx: List[int],
+        pools: List[PoolEncoding],
+        encoded: List[EncodedInstanceTypes],
+        sig_compats,
+        allowed_per_pool,
+        matrices: Dict[int, tuple],
+        pool_entries: List["_CatalogEntry"],
+        daemon_requests,
+        result: SolverResult,
+        remaining: Dict[str, dict],
+        mesh,
+    ) -> None:
+        """Resolve single-term required pod-affinity groups AFTER the
+        main pack, one group at a time in anchor-dependency order. At
+        this point every committed placement has a final zone (and node),
+        so each group's admissible domains are exactly the reference's
+        Get-over-recorded-counts (topologygroup.go:215-247) under the
+        valid pod ordering that schedules counted groups first."""
+        order = self._topo_order_parked(groups, parked_idx)
+        gi_of = (
+            {
+                i: gi
+                for gi in parked_idx
+                for i in groups[gi].pod_indices
+            }
+            if remaining
+            else {}
+        )
+        # fixpoint over the parked groups — the tensor analogue of the
+        # oracle's progress-detecting retry queue (scheduler/queue.py:25):
+        # a group failing for lack of anchors re-tries after later groups
+        # commit placements its selector matches; rounds stop when one
+        # makes no progress (a genuinely dead anchor cycle fails in both
+        # worlds)
+        pending: Dict[int, List[int]] = {
+            gi: list(groups[gi].pod_indices) for gi in order
+        }
+        for _ in range(len(order) + 1):
+            progress = False
+            for gi in order:
+                idxs = pending.get(gi)
+                if not idxs:
+                    continue
+                group = groups[gi]
+                # prior round's failures were provisional — clear before retry
+                for i in idxs:
+                    result.pod_errors.pop(pods[i].uid, None)
+                # limits move as plans emit — recompute the masks per attempt
+                limit_masks = self._limit_masks(pools, encoded, remaining)
+                info = self._choose_pool(
+                    gi, group, pods, pools, encoded, sig_compats,
+                    allowed_per_pool, result, idxs, limit_masks,
+                )
+                if info is None:
+                    # incompatibility is terminal, not an anchor problem
+                    pending[gi] = []
+                    continue
+                chosen = info["chosen"]
+                pool, enc = pools[chosen], encoded[chosen]
+                entry = pool_entries[chosen]
+                requests_matrix = matrices[id(entry)][1]
+                idx = np.asarray(info["indices"], dtype=np.int64)
+                reqs = requests_matrix[idx]
+                sort = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+                idx, reqs = idx[sort], reqs[sort]
+                daemon = daemon_requests[pool.nodepool.name]
+                jobs: List[tuple] = []
+                metas: List[dict] = []
+                plans_start = len(result.node_plans)
+                if group.tensor_pod_affinity() == wk.LABEL_TOPOLOGY_ZONE:
+                    self._postpass_zone_affinity(
+                        info, group, idx, reqs, enc, pool, daemon, pods, result,
+                        jobs, metas,
+                    )
+                else:
+                    self._postpass_hostname_affinity(
+                        info, group, idx, reqs, enc, pool, daemon, pods, result,
+                        requests_matrix, remaining,
+                    )
+                if jobs:
+                    packed = batch_pack(jobs, mesh=mesh)
+                    records: List[dict] = []
+                    for meta, (node_ids, node_count) in zip(metas, packed):
+                        self._finalize_job(
+                            meta, node_ids, node_count, pods, result, records, False
+                        )
+                    self._merge_and_emit(records, pods, result)
+                if remaining:
+                    # limited pools: strip plans that bust the remaining
+                    # budget; their pods fail terminally (the pool is
+                    # starved — retrying cannot help, scheduler.go:347-383)
+                    spilled = self._enforce_limits(
+                        result, plans_start, remaining, gi_of
+                    )
+                    pool_name = pools[info["chosen"]].nodepool.name
+                    for sgi, sidx in spilled.items():
+                        for i in sidx:
+                            result.pod_errors[pods[i].uid] = (
+                                "all available instance types exceed limits "
+                                f'for nodepool: "{pool_name}"'
+                            )
+                failed = [i for i in idxs if pods[i].uid in result.pod_errors]
+                if len(failed) < len(idxs):
+                    progress = True
+                pending[gi] = failed
+            if not progress:
+                break
+
+    def _postpass_zone_affinity(
+        self,
+        info: dict,
+        group: SignatureGroup,
+        idx: np.ndarray,
+        reqs: np.ndarray,
+        enc: EncodedInstanceTypes,
+        pool: PoolEncoding,
+        daemon: np.ndarray,
+        pods: List[Pod],
+        result: SolverResult,
+        jobs: List[tuple],
+        metas: List[dict],
+    ) -> None:
+        """Zone pod-affinity against committed placements: pods may go to
+        any viable zone already holding a matching pod; with none, only a
+        self-selecting group may bootstrap one zone
+        (topologygroup.go:215-232)."""
+        from .topology_tensor import seed_counts_for_selector
+
+        term = group.affinity_term()
+        zone_ok, ct_ok, viable = info["zone_ok"], info["ct_ok"], info["viable"]
+        ctx = self._existing_ctx
+        zones, zone_types = _viable_zones(enc, viable, zone_ok, ct_ok)
+        seeds = self._fold_committed(
+            seed_counts_for_selector(
+                self.kube_client,
+                group.exemplar,
+                wk.LABEL_TOPOLOGY_ZONE,
+                term.label_selector,
+                self._batch_uids,
+            ),
+            term.label_selector,
+            group.exemplar.namespace,
+            pods,
+            result,
+        )
+        have_anchors = any(v > 0 for v in seeds.values())
+        anchors = [z for z in zones if seeds.get(z, 0) > 0]
+        if have_anchors and not anchors:
+            # matching pods exist, but only in zones this pool can't
+            # serve — the affinity pins the pods to those zones
+            for i in idx:
+                result.pod_errors[pods[i].uid] = (
+                    "pod affinity anchors are outside viable zones"
+                )
+            return
+        if anchors:
+            part = idx
+            if ctx is not None:
+                for z in anchors:
+                    if not part.size:
+                        break
+                    part = self._pack_spread_existing(part, z, group, ctx, result)
+            if part.size:
+                sub = np.isin(idx, part)
+                zmask = zone_ok & np.array(
+                    [z in anchors for z in enc.zones], dtype=bool
+                )
+                v = viable & enc.offering_avail[:, zmask, :][:, :, ct_ok].any(
+                    axis=(1, 2)
+                )
+                self._prepare_job(
+                    idx[sub], reqs[sub], enc, v, zmask, ct_ok, daemon,
+                    info["max_per_node"], pool, pods, result, jobs, metas,
+                    merged=info["merged"],
+                )
+            return
+        if not group.affinity_self_selecting():
+            # no matching pod anywhere and the group cannot seed its own
+            # domain (nextDomainAffinity bootstraps only when the pod
+            # matches its own selector)
+            for i in idx:
+                result.pod_errors[pods[i].uid] = (
+                    "pod affinity: no pod matches the affinity selector"
+                )
+            return
+        if zones:
+            # bootstrap exactly one zone — cheapest viable offering (the
+            # oracle picks an arbitrary viable domain; a refinement)
+            def zone_price(z: str) -> float:
+                zi = enc.zones.index(z)
+                p = enc.offering_price[zone_types[z], zi, :][:, ct_ok]
+                p = np.where(np.isfinite(p), p, np.inf)
+                return float(p.min()) if p.size else np.inf
+
+            z_star = min(zones, key=zone_price)
+            part = idx
+            if ctx is not None:
+                part = self._pack_spread_existing(part, z_star, group, ctx, result)
+            if part.size:
+                sub = np.isin(idx, part)
+                self._prepare_job(
+                    idx[sub], reqs[sub], enc, zone_types[z_star],
+                    zone_ok, ct_ok, daemon, info["max_per_node"], pool,
+                    pods, result, jobs, metas, zone=z_star,
+                    merged=info["merged"],
+                )
+        else:
+            for i in idx:
+                result.pod_errors[pods[i].uid] = (
+                    "no zone with viable offering for pod affinity"
+                )
+
+    def _postpass_hostname_affinity(
+        self,
+        info: dict,
+        group: SignatureGroup,
+        idx: np.ndarray,
+        reqs: np.ndarray,
+        enc: EncodedInstanceTypes,
+        pool: PoolEncoding,
+        daemon: np.ndarray,
+        pods: List[Pod],
+        result: SolverResult,
+        requests_matrix: np.ndarray,
+        remaining: Optional[Dict[str, dict]] = None,
+    ) -> None:
+        """Hostname pod-affinity against committed placements: anchors
+        are existing nodes holding matching pods AND this solve's planned
+        nodes holding matching members (joinable with instance-type
+        growth, as the oracle's in-flight claims re-size). With no
+        anchors, a self-selecting group bootstraps one co-located node;
+        anyone else fails (topologygroup.go:215-232)."""
+        from .topology_tensor import seed_counts_for_selector
+
+        term = group.affinity_term()
+        ns = group.exemplar.namespace
+        sel = term.label_selector
+        ctx = self._existing_ctx
+        seeds = seed_counts_for_selector(
+            self.kube_client,
+            group.exemplar,
+            wk.LABEL_HOSTNAME,
+            sel,
+            self._batch_uids,
+        )
+        # existing nodes that GAINED matching members this solve anchor too
+        for eplan in result.existing_plans:
+            if any(
+                pods[i].namespace == ns and self._sel_matches(sel, i, pods)
+                for i in eplan.pod_indices
+            ):
+                name = eplan.state_node.hostname() or eplan.state_node.name()
+                seeds[name] = seeds.get(name, 0) + 1
+
+        planned_anchors = [
+            p for p in result.node_plans if self._plan_has_match(p, sel, ns, pods)
+        ]
+        left = idx
+        if seeds and ctx is not None and left.size:
+            left = self._pack_affinity_hostname_existing(
+                left, group, seeds, ctx, result
+            )
+        if planned_anchors and left.size:
+            left = self._join_planned_nodes(
+                left, planned_anchors, info, enc, pool, daemon, pods, result,
+                requests_matrix, remaining,
+            )
+        if not left.size:
+            return
+        if not seeds and not planned_anchors:
+            if group.affinity_self_selecting():
+                sub = np.isin(idx, left)
+                self._pack_affinity_hostname_new(
+                    idx[sub], reqs[sub], enc, pool, daemon, info, pods, result
+                )
+                return
+            for i in left:
+                result.pod_errors[pods[i].uid] = (
+                    "pod affinity: no pod matches the affinity selector"
+                )
+            return
+        # anchors exist but are full: a fresh claim is a zero-count domain
+        for i in left:
+            result.pod_errors[pods[i].uid] = (
+                "pod affinity on hostname: anchor nodes are full"
+            )
+
+    def _join_planned_nodes(
+        self,
+        left: np.ndarray,
+        plans: List["NodePlan"],
+        info: dict,
+        enc: EncodedInstanceTypes,
+        pool: PoolEncoding,
+        daemon: np.ndarray,
+        pods: List[Pod],
+        result: SolverResult,
+        requests_matrix: np.ndarray,
+        remaining: Optional[Dict[str, dict]] = None,
+    ) -> np.ndarray:
+        """First-fit ``left`` (descending by size) onto this solve's
+        planned anchor nodes, growing each node's instance type within
+        the commonly-viable set — the tensor analogue of pods joining an
+        in-flight NodeClaim whose instance options re-narrow
+        (scheduler.go:241-246 + nodeclaim.go add semantics). Returns the
+        indices that found no anchor capacity."""
+        from ..kube.objects import OP_IN
+        from ..scheduling import Requirement
+        from ..scheduling.requirements import ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+
+        merged = info["merged"]
+        viable = info["viable"]
+        alloc = self._alloc_full(enc, daemon)
+        for plan in plans:
+            if not left.size:
+                break
+            if plan.max_pods_per_node < 2**31 - 1:
+                continue  # capped (spread/anti) nodes never absorb joiners
+            if plan.nodepool_name != pool.nodepool.name:
+                continue
+            if plan.requirements is None or merged is None:
+                continue
+            if plan.requirements.intersects(merged) is not None:
+                continue
+            if plan.zone not in enc.zones or plan.capacity_type not in enc.capacity_types:
+                continue
+            zi = enc.zones.index(plan.zone)
+            ci = enc.capacity_types.index(plan.capacity_type)
+            # the joiner's own zone/capacity-type admissibility must hold
+            # at the plan's pinned offering (a zone-restricted pod can't
+            # join a node in a forbidden zone)
+            if not (info["zone_ok"][zi] and info["ct_ok"][ci]):
+                continue
+            combined = Requirements(*plan.requirements.values_list())
+            combined.add(*merged.values_list())
+            combined.add(
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [plan.zone]),
+                Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [plan.capacity_type]),
+            )
+            if merged.compatible(
+                combined, ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False
+            ) is not None:
+                continue
+            tmask = viable & enc.offering_avail[:, zi, ci]
+            t_idx = np.flatnonzero(tmask)
+            if t_idx.size == 0:
+                continue
+            t_idx = np.array(
+                [
+                    t
+                    for t in t_idx
+                    if combined.compatible(
+                        enc.instance_types[t].requirements,
+                        ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+                        hint=False,
+                    )
+                    is None
+                ],
+                dtype=np.int64,
+            )
+            if t_idx.size == 0:
+                continue
+            usage = requests_matrix[plan.pod_indices].astype(np.int64).sum(axis=0)
+            jreqs = requests_matrix[left].astype(np.int64)
+            cum = usage[None, :] + np.cumsum(jreqs, axis=0)
+            fits_any = (cum[:, None, :] <= alloc[t_idx][None, :, :]).all(-1).any(1)
+            n_fit = int(fits_any.sum()) if fits_any.all() else int(np.argmin(fits_any))
+            if n_fit == 0:
+                continue
+            load = cum[n_fit - 1]
+            fits = (load[None, :] <= alloc[t_idx]).all(axis=1)
+            prices = enc.offering_price[t_idx, zi, ci]
+            prices = np.where(fits & np.isfinite(prices), prices, np.inf)
+            t_local = int(np.argmin(prices))
+            if not np.isfinite(prices[t_local]):
+                continue
+            t = int(t_idx[t_local])
+            it_new = enc.instance_types[t]
+            rem = remaining.get(plan.nodepool_name) if remaining else None
+            if rem is not None and it_new is not plan.instance_type:
+                # growing the node consumes limit headroom: the delta
+                # between the new and old type's capacity must fit
+                delta = resources.subtract(
+                    it_new.capacity, plan.instance_type.capacity
+                )
+                if any(v > rem.get(name, 0) for name, v in delta.items() if v > 0):
+                    continue
+                remaining[plan.nodepool_name] = resources.subtract(rem, delta)
+            members = left[:n_fit].tolist()
+            plan.pod_indices.extend(int(i) for i in members)
+            plan.instance_type = it_new
+            plan.price = float(enc.offering_price[t, zi, ci])
+            plan.requirements = combined
+            if plan._pod_requests is not None:
+                plan._pod_requests.extend(self._all_requests[int(i)] for i in members)
+            plan._requests = None
+            left = left[n_fit:]
+        return left
 
     def _pack_affinity_hostname_existing(
         self,

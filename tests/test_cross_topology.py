@@ -18,6 +18,7 @@ from helpers import make_node, make_nodepool, make_pod, spread
 from karpenter_core_tpu.apis import labels as wk
 from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
 from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import LabelSelector
 from karpenter_core_tpu.scheduler.builder import build_scheduler
 from karpenter_core_tpu.solver import TPUScheduler
 
@@ -152,6 +153,135 @@ class TestCrossSelectorSpread:
         assert counts and max(counts.values()) - min(counts.values()) <= 1
         # and every known zone got its share (3 zones x 12 pods -> 4 each)
         assert sorted(counts.values()) == [4, 4, 4]
+
+class TestCrossSelectorAffinity:
+    def _aff(self, name, labels, sel, key=wk.LABEL_TOPOLOGY_ZONE, cpu="500m"):
+        from karpenter_core_tpu.kube.objects import PodAffinityTerm
+
+        return make_pod(
+            name=name,
+            labels=labels,
+            requests={"cpu": cpu},
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=key, label_selector=LabelSelector(match_labels=sel)
+                )
+            ],
+        )
+
+    def test_zone_affinity_chain_resolves_in_dependency_order(self):
+        # c anchors on b, b anchors on a, a self-anchors (bootstraps):
+        # the post-pass fixpoint lands all three chains in one zone
+        pods = (
+            [self._aff(f"a-{i}", {"t": "a"}, {"t": "a"}) for i in range(3)]
+            + [self._aff(f"b-{i}", {"t": "b"}, {"t": "a"}) for i in range(3)]
+            + [self._aff(f"c-{i}", {"t": "c"}, {"t": "b"}) for i in range(3)]
+        )
+        t = _solve(pods)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 9 and not t.pod_errors
+        zones_by_label = {}
+        for plan in t.node_plans:
+            for i in plan.pod_indices:
+                zones_by_label.setdefault(pods[i].metadata.labels["t"], set()).add(plan.zone)
+        # b pods share a's zone; c pods share b's zone
+        assert zones_by_label["b"] <= zones_by_label["a"]
+        assert zones_by_label["c"] <= zones_by_label["b"]
+
+    def test_dead_affinity_cycle_fails_both_worlds(self):
+        # a selects b, b selects a, neither self-matches, no seeds:
+        # every order fails all pods — oracle agrees
+        pods = [self._aff("a-0", {"t": "a"}, {"t": "b"}), self._aff("b-0", {"t": "b"}, {"t": "a"})]
+        t = _solve(pods)
+        o = _oracle(pods)
+        assert t.pods_scheduled == 0 and len(t.pod_errors) == 2
+        assert sum(len(c.pods) for c in o.new_node_claims) == 0
+
+    def test_hostname_affinity_joins_planned_anchor_node(self):
+        from karpenter_core_tpu.kube.objects import PodAffinityTerm
+
+        anchors = [make_pod(name=f"w-{i}", labels={"t": "w"}, requests={"cpu": "500m"}) for i in range(3)]
+        joiners = [
+            self._aff(f"j-{i}", {"t": "j"}, {"t": "w"}, key=wk.LABEL_HOSTNAME)
+            for i in range(3)
+        ]
+        t = _solve(anchors + joiners)
+        assert t.oracle_results is None
+        assert t.pods_scheduled == 6 and not t.pod_errors
+        # every joiner shares a plan with at least one anchor pod
+        pods = anchors + joiners
+        for plan in t.node_plans:
+            labels = {pods[i].metadata.labels["t"] for i in plan.pod_indices}
+            assert labels != {"j"}, "joiner-only node violates hostname affinity"
+
+    def test_parked_groups_respect_nodepool_limits(self):
+        # the post-pass enforces spec.limits like the round loop does:
+        # plans busting the remaining budget are stripped and their pods
+        # fail with the limit error
+        from helpers import make_nodepool
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        nodepool = make_nodepool(limits={"cpu": "4"})
+        pods = [self._aff(f"a-{i}", {"t": "a"}, {"t": "a"}, cpu="3") for i in range(4)]
+        t = TPUScheduler([nodepool], _provider(), kube_client=KubeClient()).solve(pods)
+        planned_cpu = sum(
+            plan.instance_type.capacity.get("cpu", 0) for plan in t.node_plans
+        )
+        assert planned_cpu <= 4_000_000_000  # 4 cores in nanos
+        assert t.pods_scheduled < 4
+        assert any("limits" in e for e in t.pod_errors.values())
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_randomized_cross_affinity_vs_oracle(self, seed):
+        """Tensor is a valid anchor-first ordering of the reference's
+        greedy: it schedules AT LEAST the oracle's pods (the oracle's
+        size-ordered queue can process an affinity pod before its
+        anchors land), and every affinity pod it places shares its
+        domain with a matching pod."""
+        rng = np.random.RandomState(1000 + seed)
+        vals = ["a", "b", "c"]
+        pods = []
+        for i in range(rng.randint(6, 16)):
+            v = vals[rng.randint(3)]
+            if rng.rand() < 0.5:
+                pods.append(
+                    make_pod(name=f"g-{i}", labels={"t": v}, requests={"cpu": "250m"})
+                )
+            else:
+                key = (
+                    wk.LABEL_TOPOLOGY_ZONE
+                    if rng.rand() < 0.5
+                    else wk.LABEL_HOSTNAME
+                )
+                pods.append(
+                    self._aff(f"a-{i}", {"t": v}, {"t": vals[rng.randint(3)]}, key=key)
+                )
+        t = _solve(pods)
+        o = _oracle(pods)
+        o_sched = sum(len(c.pods) for c in o.new_node_claims) + sum(
+            len(e.pods) for e in o.existing_nodes
+        )
+        assert t.oracle_results is None
+        assert t.pods_scheduled >= o_sched
+        # zone-affinity validity: each placed affinity pod's zone holds a
+        # matching pod
+        zone_members: dict = {}
+        for plan in t.node_plans:
+            zone_members.setdefault(plan.zone, []).extend(plan.pod_indices)
+        for plan in t.node_plans:
+            for i in plan.pod_indices:
+                p = pods[i]
+                a = p.spec.affinity
+                if a is None or a.pod_affinity is None:
+                    continue
+                term = a.pod_affinity.required[0]
+                if term.topology_key != wk.LABEL_TOPOLOGY_ZONE:
+                    continue
+                self_anchor = term.label_selector.matches(p.metadata.labels)
+                assert self_anchor or any(
+                    j != i and term.label_selector.matches(pods[j].metadata.labels)
+                    for j in zone_members[plan.zone]
+                ), f"seed {seed}: pod {p.metadata.name} has no zone anchor"
 
     @pytest.mark.parametrize("seed", range(20))
     def test_randomized_cross_spread_oracle_parity(self, seed):

@@ -89,17 +89,35 @@ class TestSelfZoneAffinity:
         }
         assert placed_zones == {"test-zone-2"}
 
-    def test_cross_selecting_affinity_routes_to_oracle(self):
+    def test_cross_selecting_affinity_resolves_post_pack(self):
+        # r5: cross-selecting zone affinity stays tensor — the affinity
+        # group resolves after the batch pack, anchoring on the matched
+        # group's committed (zone-final) placements
         pods = [_aff_pod(app="a", sel={"app": "b"})] + [
-            make_pod(labels={"app": "b"}) for _ in range(2)
+            make_pod(labels={"app": "b"}, requests={"cpu": "500m"}) for _ in range(2)
         ]
         t = _solve(pods)
         o = _oracle(pods)
-        assert t.oracle_results is not None  # global counting needed
-        # identical outcome to the pure oracle (including its ordering
-        # behavior for anchors that land later in the same batch)
-        assert t.pods_scheduled == sum(len(c.pods) for c in o.new_node_claims)
-        assert set(t.pod_errors) == set(o.pod_errors)
+        assert t.oracle_results is None  # tensor path handled it
+        assert t.pods_scheduled == 3 and not t.pod_errors
+        # the affinity pod shares a zone with a matching anchor pod
+        anchor_zones = {
+            plan.zone
+            for plan in t.node_plans
+            for i in plan.pod_indices
+            if pods[i].metadata.labels["app"] == "b"
+        }
+        aff_zones = {
+            plan.zone
+            for plan in t.node_plans
+            for i in plan.pod_indices
+            if pods[i].metadata.labels["app"] == "a"
+        }
+        assert aff_zones and aff_zones <= anchor_zones
+        # deliberate divergence, strictly better: the oracle's queue
+        # order processes the affinity pod before its anchors land, so
+        # it fails that pod; the post-pass IS the anchor-first ordering
+        assert sum(len(c.pods) for c in o.new_node_claims) <= t.pods_scheduled
 
 
 class TestSelfHostnameAffinity:
