@@ -82,6 +82,17 @@ class NodeClaimTemplate:
         return nc
 
 
+def _max_allocatable(instance_types: List[InstanceType]) -> ResourceList:
+    """Elementwise max allocatable over the surviving options — the
+    add() fast screen's upper bound."""
+    out: ResourceList = {}
+    for it in instance_types:
+        for name, value in it.allocatable().items():
+            if value > out.get(name, 0):
+                out[name] = value
+    return out
+
+
 class SchedulingNodeClaim:
     """A node we're planning to create: constraints + compatible pods +
     surviving instance types (nodeclaim.go:35)."""
@@ -100,15 +111,29 @@ class SchedulingNodeClaim:
         self.requirements = Requirements(*template.requirements.values_list())
         self.requirements.add(Requirement(wk.LABEL_HOSTNAME, OP_IN, [hostname]))
         self.instance_type_options = list(instance_types)
+        self._max_alloc = _max_allocatable(self.instance_type_options)
         self.requests: ResourceList = dict(daemon_resources)
         self.daemon_resources = daemon_resources
         self.topology = topology
         self.host_port_usage = HostPortUsage()
         self.pods: List[Pod] = []
 
-    def add(self, pod: Pod) -> Optional[str]:
+    def add(self, pod: Pod, pod_requests: Optional[ResourceList] = None) -> Optional[str]:
         """Try to place the pod; returns error string on failure without
-        mutating state (nodeclaim.go:65 Add)."""
+        mutating state (nodeclaim.go:65 Add). ``pod_requests`` lets the
+        scheduler's claim loop compute the pod's requests once across
+        the many claims it probes."""
+        if pod_requests is None:
+            pod_requests = resources.requests_for_pods(pod)
+        # fast resource screen: if some resource overflows the MAXIMUM
+        # remaining allocatable across all surviving options, no option
+        # fits — skip the per-attempt requirement algebra entirely (the
+        # dominant cost when a pod probes hundreds of full claims)
+        max_alloc = self._max_alloc
+        requests = self.requests
+        for name, value in pod_requests.items():
+            if requests.get(name, 0) + value > max_alloc.get(name, 0):
+                return "no instance type has sufficient remaining capacity"
         # taints
         err = Taints(self.template.spec.taints).tolerates(pod)
         if err:
@@ -149,12 +174,12 @@ class SchedulingNodeClaim:
         claim_requirements.add(*topology_requirements.values_list())
 
         # instance types
-        requests = resources.merge(self.requests, resources.requests_for_pods(pod))
+        requests = resources.merge(self.requests, pod_requests)
         filtered = filter_instance_types_by_requirements(
             self.instance_type_options, claim_requirements, requests
         )
         if not filtered.remaining:
-            cumulative = resources.merge(self.daemon_resources, resources.requests_for_pods(pod))
+            cumulative = resources.merge(self.daemon_resources, pod_requests)
             return (
                 f"no instance type satisfied resources {resources.to_string(cumulative)} "
                 f"and requirements {claim_requirements!r} ({filtered.failure_reason()})"
@@ -163,6 +188,7 @@ class SchedulingNodeClaim:
         # commit
         self.pods.append(pod)
         self.instance_type_options = filtered.remaining
+        self._max_alloc = _max_allocatable(filtered.remaining)
         self.requests = requests
         self.requirements = claim_requirements
         self.topology.record(pod, claim_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)

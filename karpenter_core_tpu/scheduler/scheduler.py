@@ -200,10 +200,11 @@ class Scheduler:
 
         # 2. already-planned claims, fewest pods first (scheduler.go:247)
         self.new_node_claims.sort(key=lambda c: len(c.pods))
+        pod_requests = resources.requests_for_pods(pod)
         for claim in self.new_node_claims:
             if not claim_viable(claim.requirements):
                 continue
-            if claim.add(pod) is None:
+            if claim.add(pod, pod_requests=pod_requests) is None:
                 return None
 
         # 3. a new claim per template, in weight order
@@ -221,7 +222,7 @@ class Scheduler:
             claim = SchedulingNodeClaim(
                 template, self.topology, self.daemon_overhead[template.nodepool_name], instance_types
             )
-            err = claim.add(pod)
+            err = claim.add(pod, pod_requests=pod_requests)
             if err is not None:
                 errs.append(
                     f'incompatible with nodepool "{template.nodepool_name}", '

@@ -122,6 +122,24 @@ class _DeferredHostCompat:
         return allowed_host(*self.args)
 
 
+def _group_node_limits(group: SignatureGroup) -> list:
+    """Hostname-level per-node constraints a node holding this group's
+    pods must keep satisfying if other pods merge onto it:
+    (selector, namespace, max matching pods per node) triples from
+    hostname topology spread and self hostname anti-affinity."""
+    limits = []
+    ns = group.exemplar.namespace
+    hs = group.hostname_spread()
+    if hs is not None:
+        limits.append((hs.label_selector, ns, int(hs.max_skew)))
+    if group.hostname_isolated:
+        a = group.exemplar.spec.affinity
+        for term in a.pod_anti_affinity.required:
+            if term.topology_key == wk.LABEL_HOSTNAME:
+                limits.append((term.label_selector, ns, 1))
+    return limits
+
+
 def _viable_zones(
     enc: EncodedInstanceTypes,
     viable: np.ndarray,
@@ -345,6 +363,9 @@ class NodePlan:
     # per-node pod cap carried from the packed group (hostname spread /
     # self-anti-affinity); backfill must not append to capped plans
     max_pods_per_node: int = 2**31 - 1
+    # hostname-level (selector, namespace, cap) constraints active on
+    # this node — joins/backfills must keep them satisfied
+    node_limits: list = field(default_factory=list)
     # this plan's pods' exact request dicts (nanos) — merged lazily off
     # the solve's critical path (only read at NodeClaim-creation time)
     _pod_requests: Optional[list] = field(default=None, repr=False)
@@ -420,6 +441,8 @@ class TPUScheduler:
         # empty defaults keep direct sub-method calls in tests working)
         self._prep_zone_ledger: List[Tuple[int, str]] = []
         self._ledger_selectors: List[tuple] = []
+        self._postpass_matrix = None
+        self._postpass_remaining: Optional[Dict[str, dict]] = None
 
     def _phase(self, name: str):
         """Timer context for one solve phase → histogram metric (the
@@ -500,6 +523,12 @@ class TPUScheduler:
         # over the append/grow-only plan lists); cleared if limit
         # enforcement ever strips plans
         self._fold_cache: Dict[tuple, dict] = {}
+        # (plan-reqs fp, joiner fp, zone, ct) -> admissible type indices
+        # for post-pass joins (plans share requirement sets heavily)
+        self._join_types_cache: Dict[tuple, tuple] = {}
+        # merge-pass pairwise Requirements.intersects memo (fingerprint
+        # keyed; the same requirement-set pairs recur across records)
+        self._intersects_cache: Dict[tuple, bool] = {}
         # prep-time (pod index, zone) ledger of zone-pinned assignments:
         # later counting groups fold these so mutually-counting groups
         # see a serially-consistent order (each group counts everything
@@ -783,7 +812,7 @@ class TPUScheduler:
                 g.zone_spread() is not None
                 or g.hostname_spread() is not None
                 or g.hostname_isolated
-                or g.self_pod_affinity() is not None
+                or g.tensor_pod_affinity() is not None
                 or g.zone_anti_isolated
             ):
                 # topology/affinity-constrained pods must go through
@@ -799,10 +828,10 @@ class TPUScheduler:
                     np_ = pools_by_name.get(plan.nodepool_name)
                     if np_ is None or plan.requirements is None:
                         continue
-                    if plan.max_pods_per_node < 2**31 - 1:
-                        # capped plans (hostname spread / anti-affinity
-                        # groups) never take foreign pods: the cap models
-                        # a constraint the backfilled pod may violate
+                    if plan.max_pods_per_node < 2**31 - 1 or plan.node_limits:
+                        # capped/limited plans (hostname spread / anti-
+                        # affinity groups) never take foreign pods: the
+                        # constraint the cap models may be violated
                         continue
                     if Taints(np_.spec.template.taints).tolerates(g.exemplar):
                         continue
@@ -1459,7 +1488,7 @@ class TPUScheduler:
         spilled: Dict[int, List[int]] = {}
         for plan in result.node_plans[plans_start:]:
             rem = remaining.get(plan.nodepool_name)
-            if rem is None:
+            if rem is None or getattr(plan, "_limits_accounted", False):
                 kept.append(plan)
                 continue
             cap = plan.instance_type.capacity
@@ -1609,6 +1638,10 @@ class TPUScheduler:
             zone_ok, ct_ok = members[0]["zone_ok"], members[0]["ct_ok"]
             max_per_node = members[0]["max_per_node"]
             merged = members[0]["merged"]
+            # hostname-level per-node constraints of this class's group
+            # (solo classes only — shared classes carry no hostname caps):
+            # the merge pass enforces them on any combined membership
+            node_limits = _group_node_limits(members[0]["group"])
             daemon = daemon_requests[pool.nodepool.name]
             requests_matrix = matrices[id(pool_entries[chosen])][1]
 
@@ -1656,6 +1689,7 @@ class TPUScheduler:
                 self._prepare_job(
                     idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                     pool, pods, result, jobs, metas, merged=merged,
+                    per_node_limits=node_limits,
                 )
                 continue
 
@@ -1675,6 +1709,7 @@ class TPUScheduler:
                     self._prepare_job(
                         idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                         pool, pods, result, jobs, metas, merged=merged,
+                        per_node_limits=node_limits,
                     )
                 continue
 
@@ -1708,6 +1743,7 @@ class TPUScheduler:
                 self._prepare_job(
                     idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                     pool, pods, result, jobs, metas, merged=merged,
+                    per_node_limits=node_limits,
                 )
             for z in zones:
                 if buckets[z]:
@@ -1715,7 +1751,7 @@ class TPUScheduler:
                     self._prepare_job(
                         idx, reqs, enc, zone_types[z], zone_ok, ct_ok, daemon,
                         max_per_node, pool, pods, result, jobs, metas, zone=z,
-                        merged=merged,
+                        merged=merged, per_node_limits=node_limits,
                     )
 
     # ------------------------------------------------------------------
@@ -2091,7 +2127,7 @@ class TPUScheduler:
                 self._prepare_job(
                     part, r, enc, zone_types[z], zone_ok, ct_ok, daemon,
                     np.int32(1), pool, pods, result, jobs, metas, zone=z,
-                    merged=m["merged"],
+                    merged=m["merged"], no_merge=True,
                 )
         for i in idx[pos:]:
             result.pod_errors[pods[i].uid] = (
@@ -2202,6 +2238,8 @@ class TPUScheduler:
                 sort = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
                 idx, reqs = idx[sort], reqs[sort]
                 daemon = daemon_requests[pool.nodepool.name]
+                self._postpass_matrix = requests_matrix
+                self._postpass_remaining = remaining
                 jobs: List[tuple] = []
                 metas: List[dict] = []
                 plans_start = len(result.node_plans)
@@ -2299,6 +2337,19 @@ class TPUScheduler:
                         break
                     part = self._pack_spread_existing(part, z, group, ctx, result)
             if part.size:
+                # this solve's planned nodes in anchor zones admit the
+                # pods too (the oracle back-fills in-flight claims
+                # before opening nodes, scheduler.go:241-246)
+                anchor_plans = [
+                    p for p in result.node_plans if p.zone in anchors
+                ]
+                if anchor_plans:
+                    entry_matrix = self._postpass_matrix
+                    part = self._join_planned_nodes(
+                        part, anchor_plans, info, enc, pool, daemon, pods,
+                        result, entry_matrix, self._postpass_remaining,
+                    )
+            if part.size:
                 sub = np.isin(idx, part)
                 zmask = zone_ok & np.array(
                     [z in anchors for z in enc.zones], dtype=bool
@@ -2334,6 +2385,13 @@ class TPUScheduler:
             part = idx
             if ctx is not None:
                 part = self._pack_spread_existing(part, z_star, group, ctx, result)
+            if part.size:
+                star_plans = [p for p in result.node_plans if p.zone == z_star]
+                if star_plans:
+                    part = self._join_planned_nodes(
+                        part, star_plans, info, enc, pool, daemon, pods,
+                        result, self._postpass_matrix, self._postpass_remaining,
+                    )
             if part.size:
                 sub = np.isin(idx, part)
                 self._prepare_job(
@@ -2403,6 +2461,17 @@ class TPUScheduler:
                 left, planned_anchors, info, enc, pool, daemon, pods, result,
                 requests_matrix, remaining,
             )
+        if left.size and planned_anchors:
+            # anchors at max capacity: the oracle never reaches this state
+            # because its anchors absorb joiners while growing across MANY
+            # claims — reproduce the outcome by re-seeding: move one
+            # matching pod from an over-full anchor plan onto a fresh
+            # node (same zone, so its own zone-level constraints and all
+            # committed counts stay intact) and co-locate joiners there
+            left = self._reseed_anchor_nodes(
+                left, planned_anchors, info, enc, pool, daemon, pods, result,
+                requests_matrix, sel, ns,
+            )
         if not left.size:
             return
         if not seeds and not planned_anchors:
@@ -2422,6 +2491,162 @@ class TPUScheduler:
             result.pod_errors[pods[i].uid] = (
                 "pod affinity on hostname: anchor nodes are full"
             )
+
+    def _reseed_anchor_nodes(
+        self,
+        left: np.ndarray,
+        plans: List["NodePlan"],
+        info: dict,
+        enc: EncodedInstanceTypes,
+        pool: PoolEncoding,
+        daemon: np.ndarray,
+        pods: List[Pod],
+        result: SolverResult,
+        requests_matrix: np.ndarray,
+        sel,
+        ns: str,
+    ) -> np.ndarray:
+        """Seed fresh anchor nodes for hostname-affinity leftovers: take
+        one selector-matching pod from a full anchor plan that holds more
+        than one, open a new node in the SAME zone with it, and first-fit
+        leftovers there. Zone-invariant by construction, so every
+        committed zone count and zone-level constraint is untouched."""
+        from ..scheduling.requirements import ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+
+        merged = info["merged"]
+        viable = info["viable"]
+        alloc = self._alloc_full(enc, daemon)
+        # worklist: a freshly seeded node whose joiners also match the
+        # selector (self-selecting groups) becomes a donor itself
+        worklist = sorted(plans, key=lambda p: -len(p.pod_indices))
+        wi = 0
+        while wi < len(worklist):
+            donor_plan = worklist[wi]
+            wi += 1
+            if not left.size:
+                break
+            if donor_plan.max_pods_per_node < 2**31 - 1 or donor_plan.node_limits:
+                continue
+            if donor_plan.nodepool_name != pool.nodepool.name:
+                continue
+            if donor_plan.requirements is None or merged is None:
+                continue
+            if donor_plan.requirements.intersects(merged) is not None:
+                continue
+            if donor_plan.zone not in enc.zones:
+                continue
+            zi = enc.zones.index(donor_plan.zone)
+            if donor_plan.capacity_type not in enc.capacity_types:
+                continue
+            ci = enc.capacity_types.index(donor_plan.capacity_type)
+            if not (info["zone_ok"][zi] and info["ct_ok"][ci]):
+                continue
+            matching = [
+                i
+                for i in donor_plan.pod_indices
+                if pods[i].namespace == ns and self._sel_matches(sel, i, pods)
+            ]
+            if len(matching) < 2:
+                continue  # the donor plan must keep an anchor of its own
+            while left.size and len(matching) > 1:
+                donor = matching.pop()
+                # the new node carries the donor too: admissible types
+                # must satisfy BOTH sides' requirement sets (the same
+                # combined filter — and cache — the join path uses)
+                cache_key = (
+                    donor_plan.requirements.fingerprint(),
+                    merged.fingerprint(),
+                    zi,
+                    ci,
+                    viable.tobytes(),
+                )
+                cached = self._join_types_cache.get(cache_key)
+                if cached is None:
+                    combined = Requirements(*donor_plan.requirements.values_list())
+                    combined.add(*merged.values_list())
+                    tmask = viable & enc.offering_avail[:, zi, ci]
+                    cached = tuple(
+                        int(t)
+                        for t in np.flatnonzero(tmask)
+                        if combined.compatible(
+                            enc.instance_types[t].requirements,
+                            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+                            hint=False,
+                        )
+                        is None
+                    )
+                    self._join_types_cache[cache_key] = cached
+                t_idx = np.array(cached, dtype=np.int64)
+                if t_idx.size == 0:
+                    return left
+                usage = requests_matrix[[donor]].astype(np.int64).sum(axis=0)
+                jreqs = requests_matrix[left].astype(np.int64)
+                cum = usage[None, :] + np.cumsum(jreqs, axis=0)
+                fits_any = (cum[:, None, :] <= alloc[t_idx][None, :, :]).all(-1).any(1)
+                n_fit = (
+                    int(fits_any.sum()) if fits_any.all() else int(np.argmin(fits_any))
+                )
+                if n_fit == 0:
+                    matching.append(donor)
+                    break
+                load = cum[n_fit - 1]
+                fits = (load[None, :] <= alloc[t_idx]).all(axis=1)
+                prices = enc.offering_price[t_idx, zi, ci]
+                prices = np.where(fits & np.isfinite(prices), prices, np.inf)
+                t_local = int(np.argmin(prices))
+                if not np.isfinite(prices[t_local]):
+                    matching.append(donor)
+                    break
+                t = int(t_idx[t_local])
+                rem = (
+                    self._postpass_remaining.get(pool.nodepool.name)
+                    if self._postpass_remaining
+                    else None
+                )
+                if rem is not None:
+                    cap = enc.instance_types[t].capacity
+                    if any(v > rem.get(name, 0) for name, v in cap.items() if v > 0):
+                        matching.append(donor)
+                        break  # no limit headroom for another node
+                    self._postpass_remaining[pool.nodepool.name] = resources.subtract(
+                        rem, cap
+                    )
+                # detach the donor from its plan (zone unchanged, so the
+                # incremental committed counts stay correct — but the
+                # plan-level anchor cache must forget the shrunk plan)
+                pos = donor_plan.pod_indices.index(donor)
+                donor_plan.pod_indices.pop(pos)
+                if donor_plan._pod_requests is not None:
+                    donor_plan._pod_requests.pop(pos)
+                donor_plan._requests = None
+                pid = id(donor_plan)
+                self._plan_match_cache = {
+                    k: v for k, v in self._plan_match_cache.items() if k[1] != pid
+                }
+                members = [int(donor)] + [int(i) for i in left[:n_fit]]
+                combined = Requirements(*donor_plan.requirements.values_list())
+                combined.add(*merged.values_list())
+                new_plan = NodePlan(
+                    nodepool_name=pool.nodepool.name,
+                    instance_type=enc.instance_types[t],
+                    zone=donor_plan.zone,
+                    capacity_type=donor_plan.capacity_type,
+                    price=float(enc.offering_price[t, zi, ci]),
+                    pod_indices=members,
+                    requirements=combined,
+                    _pod_requests=[self._all_requests[i] for i in members],
+                )
+                # limits were consumed above; the post-pass enforcement
+                # must not subtract (or strip) this plan a second time
+                new_plan._limits_accounted = True
+                result.node_plans.append(new_plan)
+                worklist.append(new_plan)
+                # the donor was already counted in this zone; only the
+                # joiners are new to the committed counters
+                for st in self._fold_cache.values():
+                    st["sizes"][id(new_plan)] = 1
+                left = left[n_fit:]
+        return left
 
     def _join_planned_nodes(
         self,
@@ -2452,8 +2677,8 @@ class TPUScheduler:
         for plan in plans:
             if not left.size:
                 break
-            if plan.max_pods_per_node < 2**31 - 1:
-                continue  # capped (spread/anti) nodes never absorb joiners
+            if plan.max_pods_per_node < 2**31 - 1 or plan.node_limits:
+                continue  # capped/limited (spread/anti) nodes never absorb joiners
             if plan.nodepool_name != pool.nodepool.name:
                 continue
             if plan.requirements is None or merged is None:
@@ -2469,35 +2694,41 @@ class TPUScheduler:
             # join a node in a forbidden zone)
             if not (info["zone_ok"][zi] and info["ct_ok"][ci]):
                 continue
-            combined = Requirements(*plan.requirements.values_list())
-            combined.add(*merged.values_list())
-            combined.add(
-                Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [plan.zone]),
-                Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [plan.capacity_type]),
+            cache_key = (
+                plan.requirements.fingerprint(),
+                merged.fingerprint(),
+                zi,
+                ci,
+                viable.tobytes(),
             )
-            if merged.compatible(
-                combined, ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False
-            ) is not None:
-                continue
-            tmask = viable & enc.offering_avail[:, zi, ci]
-            t_idx = np.flatnonzero(tmask)
-            if t_idx.size == 0:
-                continue
-            t_idx = np.array(
-                [
-                    t
-                    for t in t_idx
-                    if combined.compatible(
-                        enc.instance_types[t].requirements,
-                        ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
-                        hint=False,
+            cached = self._join_types_cache.get(cache_key)
+            if cached is None:
+                combined = Requirements(*plan.requirements.values_list())
+                combined.add(*merged.values_list())
+                combined.add(
+                    Requirement(wk.LABEL_TOPOLOGY_ZONE, OP_IN, [plan.zone]),
+                    Requirement(wk.CAPACITY_TYPE_LABEL_KEY, OP_IN, [plan.capacity_type]),
+                )
+                if merged.compatible(
+                    combined, ALLOW_UNDEFINED_WELL_KNOWN_LABELS, hint=False
+                ) is not None:
+                    cached = ()
+                else:
+                    tmask = viable & enc.offering_avail[:, zi, ci]
+                    cached = tuple(
+                        int(t)
+                        for t in np.flatnonzero(tmask)
+                        if combined.compatible(
+                            enc.instance_types[t].requirements,
+                            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+                            hint=False,
+                        )
+                        is None
                     )
-                    is None
-                ],
-                dtype=np.int64,
-            )
-            if t_idx.size == 0:
+                self._join_types_cache[cache_key] = cached
+            if not cached:
                 continue
+            t_idx = np.array(cached, dtype=np.int64)
             usage = requests_matrix[plan.pod_indices].astype(np.int64).sum(axis=0)
             jreqs = requests_matrix[left].astype(np.int64)
             cum = usage[None, :] + np.cumsum(jreqs, axis=0)
@@ -2528,6 +2759,10 @@ class TPUScheduler:
             plan.pod_indices.extend(int(i) for i in members)
             plan.instance_type = it_new
             plan.price = float(enc.offering_price[t, zi, ci])
+            # rebuild the merged requirement set only on an actual join
+            # (the admissible-type cache skips it on the probe path)
+            combined = Requirements(*plan.requirements.values_list())
+            combined.add(*merged.values_list())
             plan.requirements = combined
             if plan._pod_requests is not None:
                 plan._pod_requests.extend(self._all_requests[int(i)] for i in members)
@@ -2821,6 +3056,8 @@ class TPUScheduler:
         metas: List[dict],
         zone: Optional[str] = None,
         merged=None,
+        per_node_limits: Optional[list] = None,
+        no_merge: bool = False,
     ) -> None:
         viable_idx = np.flatnonzero(viable)
         if len(viable_idx) == 0:
@@ -2852,6 +3089,8 @@ class TPUScheduler:
                 daemon=daemon,
                 max_per_node=int(max_per_node),
                 merged=merged,
+                per_node_limits=per_node_limits or [],
+                no_merge=no_merge,
             )
         )
 
@@ -2911,10 +3150,12 @@ class TPUScheduler:
                 for i in members:
                     result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
                 continue
-            # hostname-spread / anti-affinity capped groups never merge:
-            # collapsing their nodes would re-concentrate the very pods
-            # the constraint spreads (max 1-per-node etc.)
-            mergeable = meta["max_per_node"] >= 2**31 - 1
+            # capped / limited groups merge too (r5): the oracle shares
+            # nodes across independent hostname-spread groups freely —
+            # the merge check enforces each side's per-node limits on
+            # the combined membership. Only no_merge jobs (zone
+            # anti-affinity) stay out.
+            mergeable = not meta["no_merge"]
             if mergeable and (
                 merge_all or np.all(usage[n].astype(np.int64) * 2 <= alloc_cap)
             ):
@@ -2931,6 +3172,8 @@ class TPUScheduler:
                         daemon=meta["daemon"],
                         alloc_cap=alloc_cap,
                         merged=meta["merged"],
+                        max_per_node=meta["max_per_node"],
+                        limits=list(meta["per_node_limits"]),
                     )
                 )
                 continue
@@ -2949,6 +3192,7 @@ class TPUScheduler:
                     pod_indices=members,
                     requirements=meta["merged"],
                     max_pods_per_node=int(meta["max_per_node"]),
+                    node_limits=list(meta["per_node_limits"]),
                     _pod_requests=[self._all_requests[i] for i in members],
                 )
             )
@@ -3006,11 +3250,14 @@ class TPUScheduler:
                 # the full requirement sets must intersect per key — the
                 # mask projections miss custom node-label keys (team=a
                 # vs team=b pods can never share a node)
-                if (
-                    m["merged"] is None
-                    or r["merged"] is None
-                    or m["merged"].intersects(r["merged"]) is not None
-                ):
+                if m["merged"] is None or r["merged"] is None:
+                    continue
+                ikey = (m["merged"].fingerprint(), r["merged"].fingerprint())
+                compat_ok = self._intersects_cache.get(ikey)
+                if compat_ok is None:
+                    compat_ok = m["merged"].intersects(r["merged"]) is None
+                    self._intersects_cache[ikey] = compat_ok
+                if not compat_ok:
                     continue
                 usage = m["usage"] + r["usage"]
                 # cheap reject: combined load exceeds even the elementwise
@@ -3028,6 +3275,22 @@ class TPUScheduler:
                 off_ok = enc.offering_avail[:, zmask][:, :, ct_ok].any(axis=(1, 2))
                 if not (fits & off_ok).any():
                     continue
+                limits = m.get("limits", []) + r.get("limits", [])
+                if limits:
+                    # every hostname-level constraint of either side must
+                    # hold on the merged membership (the oracle's per-node
+                    # count check at placement time); per-side counts are
+                    # cached so mega-memberships aren't rescanned per pair
+                    ok = True
+                    for sel, ns, cap in limits:
+                        count = self._record_limit_count(
+                            m, sel, ns, pods
+                        ) + self._record_limit_count(r, sel, ns, pods)
+                        if count > cap:
+                            ok = False
+                            break
+                    if not ok:
+                        continue
                 combined = Requirements(*m["merged"].values_list())
                 combined.add(*r["merged"].values_list())
                 m.update(
@@ -3037,14 +3300,33 @@ class TPUScheduler:
                     ct_ok=ct_ok,
                     viable=viable,
                     merged=combined,
+                    limits=limits,
+                    max_per_node=min(
+                        m.get("max_per_node", 2**31 - 1),
+                        r.get("max_per_node", 2**31 - 1),
+                    ),
                 )
                 m["members"].extend(r["members"])
+                m.pop("_limit_counts", None)  # membership grew: recount lazily
                 placed = True
                 break
             if not placed:
                 merged.append(dict(r, members=list(r["members"])))
         for m in merged:
             self._emit_record(m, pods, result)
+
+    def _record_limit_count(self, record: dict, sel, ns: str, pods: List[Pod]) -> int:
+        cache = record.setdefault("_limit_counts", {})
+        key = (self._sel_fp(sel) if sel is not None else None, ns)
+        count = cache.get(key)
+        if count is None:
+            count = sum(
+                1
+                for i in record["members"]
+                if pods[i].namespace == ns and self._sel_matches(sel, i, pods)
+            )
+            cache[key] = count
+        return count
 
     def _emit_record(self, m: dict, pods: List[Pod], result: SolverResult) -> None:
         enc, zone_ok, ct_ok, zone = m["enc"], m["zone_ok"], m["ct_ok"], m["zone"]
@@ -3079,6 +3361,8 @@ class TPUScheduler:
                 price=offering_price,
                 pod_indices=m["members"],
                 requirements=m["merged"],
+                max_pods_per_node=int(m.get("max_per_node", 2**31 - 1)),
+                node_limits=list(m.get("limits", [])),
                 _pod_requests=[self._all_requests[i] for i in m["members"]],
             )
         )

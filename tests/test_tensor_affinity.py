@@ -129,18 +129,29 @@ class TestSelfHostnameAffinity:
         assert t.node_count == len(o.new_node_claims) == 1
         assert t.pods_scheduled == sum(len(c.pods) for c in o.new_node_claims) == 6
 
-    def test_overflow_fails_like_oracle(self):
+    def test_overflow_reseeds_beyond_oracle(self):
         # 6 pods x 4cpu cannot share any node in a 10-type catalog
-        # (largest ~10 cpu): both paths co-locate a prefix and fail the rest
+        # (largest ~10 cpu). The oracle co-locates a prefix onto ONE
+        # bootstrap node and fails the rest (its greedy never revisits a
+        # full anchor). The post-pass re-seeds: it moves one matching pod
+        # from the full anchor node onto a fresh node and co-locates
+        # leftovers there — every node still holds a matching pod, so the
+        # placement is constraint-valid and strictly better (deliberate,
+        # documented divergence)
         pods = [_aff_pod(key=wk.LABEL_HOSTNAME, cpu="4") for _ in range(6)]
         t = _solve(pods)
         o = _oracle(pods)
         o_sched = sum(len(c.pods) for c in o.new_node_claims)
         assert t.oracle_results is None
-        assert len(o.new_node_claims) == 1
-        assert t.node_count == 1
-        assert t.pods_scheduled == o_sched
-        assert len(t.pod_errors) == 6 - o_sched > 0
+        assert len(o.new_node_claims) == 1 and o_sched == 2  # oracle strands 4
+        assert t.pods_scheduled == 6 and not t.pod_errors
+        # validity: every node holds at least one selector-matching pod
+        # (here every pod self-matches, so non-empty nodes suffice)
+        assert all(p.pod_indices for p in t.node_plans)
+        # donor-chain greedy: more nodes than a perfect 2-per-node pack,
+        # but every pod lands (capacity bounds each node at 2 pods)
+        assert 3 <= t.node_count <= 5
+        assert all(len(p.pod_indices) <= 2 for p in t.node_plans)
 
 
 class TestSelfZoneAntiAffinity:
