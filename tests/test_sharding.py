@@ -153,6 +153,43 @@ class TestIntegratedShardedSolve:
         )
         assert sharded.total_price == pytest.approx(base.total_price)
 
+    def test_bench_shaped_sharded_solve_plan_parity(self, monkeypatch):
+        """CI-scale version of the driver's dryrun_multichip integrated
+        check (VERDICT r4 #8 at 10k pods): a mixed 1k-pod batch with a
+        zone-spread slice solves over the 8-device mesh and reproduces
+        the single-device plan exactly."""
+        from helpers import make_pod, spread
+        from karpenter_core_tpu.apis import labels as wk
+
+        pods = []
+        for i in range(1000):
+            constraint = (
+                [spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": f"svc-{i % 11}"})]
+                if i % 7 == 6
+                else None
+            )
+            pods.append(
+                make_pod(
+                    requests={
+                        "cpu": ["100m", "250m", "500m", "1", "2"][i % 5],
+                        "memory": ["128Mi", "512Mi", "1Gi", "2Gi"][i % 4],
+                    },
+                    labels={"app": f"svc-{i % 11}"},
+                    topology_spread=constraint,
+                )
+            )
+        import karpenter_core_tpu.native as native_mod
+
+        base = self._solve(pods)
+        monkeypatch.setenv("KARPENTER_TPU_SHARDED", "on")
+        # native.load() caches on first use — disable via the module
+        # seam (the env var would be a no-op after the base solve)
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        sharded = self._solve(pods)
+        assert sharded.pods_scheduled == base.pods_scheduled == 1000
+        assert sharded.node_count == base.node_count
+        assert sharded.total_price == pytest.approx(base.total_price)
+
     def test_full_solve_pack_shards_without_native(self, monkeypatch):
         """With no native packer, the group-axis pack itself runs over
         the mesh (auto mode keeps native when available: the sequential
