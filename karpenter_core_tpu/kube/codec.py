@@ -117,11 +117,13 @@ def _rfc3339(ts: Optional[float]) -> Optional[str]:
 
 
 def _rfc3339_micro(ts: Optional[float]) -> Optional[str]:
-    """metav1.MicroTime: the apiserver REQUIRES a six-digit fraction."""
+    """metav1.MicroTime: the apiserver REQUIRES a six-digit fraction.
+    Rounded in integer microseconds so a fraction near 1.0 carries into
+    the seconds instead of emitting an invalid 7-digit fraction."""
     if ts is None:
         return None
-    micros = int(round((ts % 1) * 1e6))
-    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts)) + f".{micros:06d}Z"
+    secs, micros = divmod(int(round(ts * 1e6)), 10**6)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(secs)) + f".{micros:06d}Z"
 
 
 def _resources(d: Optional[dict]) -> dict:
@@ -418,7 +420,32 @@ def _decode_node(d: dict) -> Node:
     status = d.get("status") or {}
     node.status.capacity = _resources(status.get("capacity"))
     node.status.allocatable = _resources(status.get("allocatable"))
+    node.status.phase = status.get("phase", "")
+    node.status.conditions = [
+        Condition(
+            type=c.get("type", ""),
+            status=c.get("status", ""),
+            reason=c.get("reason", ""),
+            message=c.get("message", ""),
+            last_transition_time=_ts(c.get("lastTransitionTime")) or 0.0,
+        )
+        for c in status.get("conditions") or []
+    ]
     return node
+
+
+def _zones_from_node_affinity(d: Optional[dict]) -> list:
+    """Zone values from a PV's spec.nodeAffinity required terms."""
+    zones = []
+    req = (d or {}).get("required") or {}
+    for term in req.get("nodeSelectorTerms") or []:
+        for e in term.get("matchExpressions") or []:
+            if e.get("key") in (
+                "topology.kubernetes.io/zone",
+                "failure-domain.beta.kubernetes.io/zone",
+            ):
+                zones.extend(e.get("values") or [])
+    return zones
 
 
 def _decode_nodepool(d: dict) -> NodePool:
@@ -652,17 +679,24 @@ def _encode_pod_spec(spec) -> dict:
             for c in spec.topology_spread_constraints
         ]
     if spec.volumes:
-        out["volumes"] = [
-            {
-                "name": v.name,
-                **(
-                    {"persistentVolumeClaim": {"claimName": v.persistent_volume_claim}}
-                    if v.persistent_volume_claim
-                    else {}
-                ),
-            }
-            for v in spec.volumes
-        ]
+        vols = []
+        for v in spec.volumes:
+            if v.persistent_volume_claim:
+                vols.append(
+                    {
+                        "name": v.name,
+                        "persistentVolumeClaim": {"claimName": v.persistent_volume_claim},
+                    }
+                )
+            elif v.ephemeral:
+                # minimal generic-ephemeral marker so the flag round-trips
+                vols.append(
+                    {"name": v.name, "ephemeral": {"volumeClaimTemplate": {"spec": {}}}}
+                )
+            else:
+                # a source-less volume is invalid on the wire
+                vols.append({"name": v.name, "emptyDir": {}})
+        out["volumes"] = vols
     if spec.overhead:
         out["overhead"] = _resources_out(spec.overhead)
     if spec.priority is not None:
@@ -703,9 +737,17 @@ def from_k8s(kind: str, d: dict) -> KubeObject:
         spec = d.get("spec") or {}
         obj = PersistentVolume()
         obj.driver = ((spec.get("csi") or {}).get("driver")) or ""
+        obj.zones = _zones_from_node_affinity(spec.get("nodeAffinity"))
     elif kind == "StorageClass":
         obj = StorageClass()
         obj.provisioner = d.get("provisioner", "")
+        for topo in d.get("allowedTopologies") or []:
+            for e in topo.get("matchLabelExpressions") or []:
+                if e.get("key") in (
+                    "topology.kubernetes.io/zone",
+                    "failure-domain.beta.kubernetes.io/zone",
+                ):
+                    obj.zones.extend(e.get("values") or [])
     elif kind == "CSINode":
         obj = CSINode(
             drivers=[
@@ -777,6 +819,20 @@ def to_k8s(obj: KubeObject) -> dict:
                 "name": obj.spec.node_class_ref.name,
                 "kind": obj.spec.node_class_ref.kind,
                 "apiVersion": obj.spec.node_class_ref.api_version,
+            }
+        if obj.spec.kubelet is not None:
+            k = obj.spec.kubelet
+            out["spec"]["kubelet"] = {
+                key: value
+                for key, value in (
+                    ("maxPods", k.max_pods),
+                    ("podsPerCore", k.pods_per_core),
+                    ("systemReserved", _resources_out(k.system_reserved) or None),
+                    ("kubeReserved", _resources_out(k.kube_reserved) or None),
+                    ("evictionHard", dict(k.eviction_hard) or None),
+                    ("evictionSoft", dict(k.eviction_soft) or None),
+                )
+                if value is not None
             }
         out["status"] = {
             "nodeName": obj.status.node_name,

@@ -162,15 +162,14 @@ class RestKubeClient:
         path = self._path(obj.kind, obj.namespace, obj.name)
         data = self._request("PATCH", path, body)
         if status:
+            # the main patch bumped resourceVersion, so the status write
+            # must be unconditional (carrying the stale rv would 409)
+            status_patch = {"status": status}
             try:
-                data = self._request(
-                    "PATCH",
-                    path + "/status",
-                    {"apiVersion": body["apiVersion"], "kind": obj.kind, "status": status},
-                )
+                data = self._request("PATCH", path + "/status", status_patch)
             except (NotFound, ApiError):
-                # no status subresource: status rides the main patch
-                data = self._request("PATCH", path, {**body, "status": status})
+                # no status subresource: status rides a main-resource patch
+                data = self._request("PATCH", path, status_patch)
         decoded = from_k8s(obj.kind, data)
         obj.metadata.resource_version = decoded.metadata.resource_version
         return decoded
@@ -260,6 +259,7 @@ class RestKubeClient:
 
         rv = relist(first=True)
         unsubscribed = threading.Event()
+        live = {"resp": None}  # the stream unsubscribe must unblock
 
         def stream():
             last_rv = rv
@@ -271,6 +271,7 @@ class RestKubeClient:
                         + f"?watch=1&resourceVersion={last_rv}&allowWatchBookmarks=true",
                         stream=True,
                     )
+                    live["resp"] = resp
                     self._streams.append(resp)
                     try:
                         for line in resp:
@@ -319,7 +320,17 @@ class RestKubeClient:
         thread = threading.Thread(target=stream, name=f"watch-{kind}", daemon=True)
         thread.start()
         self._watch_threads.append(thread)
-        return unsubscribed.set
+
+        def unsubscribe():
+            unsubscribed.set()
+            resp = live.get("resp")
+            if resp is not None:
+                try:
+                    resp.close()  # unblock a quiet stream read immediately
+                except OSError:
+                    pass
+
+        return unsubscribe
 
     def close(self) -> None:
         self._stopping.set()

@@ -1,0 +1,560 @@
+"""Port of the remaining scheduler suite specs (reference
+pkg/controllers/provisioning/scheduling/suite_test.go) not yet covered
+by test_scheduler.py / test_scheduler_behavior.py — custom-constraint
+operator edges, preferential fallback, binpacking, in-flight node
+semantics, and volume-driven scheduling. See tests/PORTED_SPECS.md for
+the per-suite manifest."""
+
+from __future__ import annotations
+
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod, spread
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+    new_instance_type,
+)
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.kube.objects import (
+    Container,
+    LabelSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    StorageClass,
+    Taint,
+    Toleration,
+    Volume,
+)
+from karpenter_core_tpu.kube.quantity import parse_quantity
+from karpenter_core_tpu.scheduler.builder import build_scheduler
+from karpenter_core_tpu.scheduler.scheduler import SchedulerOptions
+from karpenter_core_tpu.state.statenode import StateNode
+
+
+def schedule(pods, nodepools=None, provider=None, state_nodes=None, daemonsets=None, kube=None):
+    provider = provider or FakeCloudProvider()
+    nodepools = nodepools or [make_nodepool()]
+    kube = kube or KubeClient()
+    s = build_scheduler(
+        kube, None, nodepools, provider, pods,
+        state_nodes=state_nodes, daemonset_pods=daemonsets,
+        opts=SchedulerOptions(simulation_mode=False),
+    )
+    return s.solve(pods)
+
+
+def state_node(cpu="4", pods="10", labels=None, taints=None, initialized=True):
+    node = make_node(
+        labels={
+            wk.NODEPOOL_LABEL_KEY: "default",
+            wk.NODE_REGISTERED_LABEL_KEY: "true",
+            **({wk.NODE_INITIALIZED_LABEL_KEY: "true"} if initialized else {}),
+            **(labels or {}),
+        },
+        capacity={"cpu": cpu, "memory": "16Gi", "pods": pods},
+        taints=taints,
+    )
+    return StateNode(node=node)
+
+
+class TestCustomConstraintOperators:
+    """suite_test.go "Custom Constraints" operator edge matrix."""
+
+    def test_restricted_label_selector_rejected(self):
+        # "should not schedule pods that have node selectors with
+        # restricted labels" — hostname is restricted
+        res = schedule([make_pod(node_selector={wk.LABEL_HOSTNAME: "n1"})])
+        assert res.pod_errors and not res.new_node_claims
+
+    def test_restricted_domain_selector_rejected(self):
+        # "... with restricted domains" (kubernetes.io/... custom key)
+        res = schedule([make_pod(node_selector={"kubernetes.io/custom": "x"})])
+        assert res.pod_errors and not res.new_node_claims
+
+    def test_domain_exception_list_allowed(self):
+        # "...label in restricted domains exceptions list" — kops.k8s.io
+        # is exempt; the NodePool defines the label so it is known
+        np_ = make_nodepool(labels={"kops.k8s.io/instancegroup": "g"})
+        res = schedule(
+            [make_pod(node_selector={"kops.k8s.io/instancegroup": "g"})],
+            nodepools=[np_],
+        )
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+
+    def test_subdomain_of_exception_allowed(self):
+        # "...label in subdomain from restricted domains exceptions list"
+        np_ = make_nodepool(labels={"subdomain.kops.k8s.io/ig": "g"})
+        res = schedule(
+            [make_pod(node_selector={"subdomain.kops.k8s.io/ig": "g"})],
+            nodepools=[np_],
+        )
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+
+    def test_well_known_label_selector_allowed(self):
+        # "...label in wellknown label list"
+        res = schedule([make_pod(node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"})])
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+
+    @pytest.mark.parametrize(
+        "operator,values,schedules",
+        [
+            ("In", ["v"], False),  # In + undefined key: no
+            ("NotIn", ["v"], True),  # NotIn + undefined key: yes
+            ("Exists", [], False),  # Exists + undefined key: no
+            ("DoesNotExist", [], True),  # DoesNotExist + undefined: yes
+        ],
+    )
+    def test_undefined_key_operator_matrix(self, operator, values, schedules):
+        res = schedule(
+            [
+                make_pod(
+                    required_node_affinity=[
+                        NodeSelectorRequirement(key="undefined-key", operator=operator, values=values)
+                    ]
+                )
+            ]
+        )
+        assert bool(res.new_node_claims) == schedules
+        assert bool(res.pod_errors) != schedules
+
+    @pytest.mark.parametrize(
+        "operator,values,schedules",
+        [
+            ("In", ["ig-1"], True),  # matching value + In
+            ("NotIn", ["ig-1"], False),  # matching value + NotIn
+            ("Exists", [], True),  # defined key + Exists
+            ("DoesNotExist", [], False),  # defined key + DoesNotExist
+            ("In", ["other"], False),  # different value + In
+            ("NotIn", ["other"], True),  # different value + NotIn
+        ],
+    )
+    def test_defined_key_operator_matrix(self, operator, values, schedules):
+        np_ = make_nodepool(labels={"custom/ig": "ig-1"})
+        res = schedule(
+            [
+                make_pod(
+                    required_node_affinity=[
+                        NodeSelectorRequirement(key="custom/ig", operator=operator, values=values)
+                    ]
+                )
+            ],
+            nodepools=[np_],
+        )
+        assert bool(res.new_node_claims) == schedules
+
+    def test_compatible_pods_share_node(self):
+        # "should schedule compatible pods to the same node"
+        np_ = make_nodepool(labels={"custom/ig": "ig-1"})
+        pods = [
+            make_pod(
+                requests={"cpu": "100m"},
+                required_node_affinity=[
+                    NodeSelectorRequirement(key="custom/ig", operator="In", values=["ig-1", "ig-2"])
+                ],
+            ),
+            make_pod(requests={"cpu": "100m"}, node_selector={"custom/ig": "ig-1"}),
+        ]
+        res = schedule(pods, nodepools=[np_])
+        assert len(res.new_node_claims) == 1 and not res.pod_errors
+
+    def test_incompatible_pods_get_different_nodes(self):
+        # "should schedule incompatible pods to the different node" —
+        # both values exist in the pool's requirement domain
+        np_ = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    key="custom/ig", operator="In", values=["ig-1", "ig-2"]
+                )
+            ]
+        )
+        pods = [
+            make_pod(requests={"cpu": "100m"}, node_selector={"custom/ig": "ig-1"}),
+            make_pod(requests={"cpu": "100m"}, node_selector={"custom/ig": "ig-2"}),
+        ]
+        res = schedule(pods, nodepools=[np_])
+        assert len(res.new_node_claims) == 2 and not res.pod_errors
+
+    def test_exists_does_not_overwrite_value(self):
+        # "Exists operator should not overwrite the existing value"
+        np_ = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    key="custom/ig", operator="In", values=["ig-1", "ig-2"]
+                )
+            ]
+        )
+        pods = [
+            make_pod(
+                requests={"cpu": "100m"},
+                required_node_affinity=[
+                    NodeSelectorRequirement(key="custom/ig", operator="Exists")
+                ],
+                node_selector={"custom/ig": "ig-2"},
+            ),
+        ]
+        res = schedule(pods, nodepools=[np_])
+        assert len(res.new_node_claims) == 1
+        req = res.new_node_claims[0].requirements.get_req("custom/ig")
+        assert req.values == {"ig-2"}
+
+
+class TestPreferentialFallback:
+    """suite_test.go "Preferential Fallback" — the relaxation ladder."""
+
+    def _pref(self, key, operator, values, weight=1):
+        return PreferredSchedulingTerm(
+            weight=weight,
+            preference=NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key=key, operator=operator, values=values)
+                ]
+            ),
+        )
+
+    def test_relax_multiple_terms_until_schedulable(self):
+        # "should relax multiple terms": every preference is impossible,
+        # the pod still lands after the ladder strips them
+        pod = make_pod(
+            preferred_node_affinity=[
+                self._pref("undefined-a", "In", ["x"]),
+                self._pref("undefined-b", "In", ["y"]),
+            ]
+        )
+        res = schedule([pod])
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+
+    def test_relax_to_lighter_weights(self):
+        # "should relax to use lighter weights": the heavy impossible
+        # preference goes first; the light feasible one survives
+        pod = make_pod(
+            preferred_node_affinity=[
+                self._pref("undefined-key", "In", ["x"], weight=100),
+                self._pref(wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2"], weight=1),
+            ]
+        )
+        res = schedule([pod])
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+        req = res.new_node_claims[0].requirements.get_req(wk.LABEL_TOPOLOGY_ZONE)
+        assert req.has("test-zone-2")
+
+    def test_preference_conflicting_with_requirement_schedules(self):
+        # "should schedule even if preference is conflicting with
+        # requirement" — required wins, preference relaxes away
+        pod = make_pod(
+            preferred_node_affinity=[self._pref(wk.LABEL_TOPOLOGY_ZONE, "In", ["no-such-zone"])],
+            required_node_affinity=[
+                NodeSelectorRequirement(
+                    key=wk.LABEL_TOPOLOGY_ZONE, operator="In", values=["test-zone-1"]
+                )
+            ],
+        )
+        res = schedule([pod])
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+        assert res.new_node_claims[0].requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).has(
+            "test-zone-1"
+        )
+
+
+class TestBinpacking:
+    """suite_test.go "Binpacking"."""
+
+    def _sized_provider(self):
+        provider = FakeCloudProvider()
+        provider.instance_types = [
+            new_instance_type(f"c-{c}", {"cpu": str(c), "memory": f"{2*c}Gi", "pods": "110"})
+            for c in (1, 2, 4, 8, 16, 32)
+        ]
+        return provider
+
+    def test_small_pod_on_smallest_instance(self):
+        res = schedule([make_pod(requests={"cpu": "500m"})], provider=self._sized_provider())
+        assert len(res.new_node_claims) == 1
+        # the claim's surviving cheapest option is the 1-cpu type
+        names = [it.name for it in res.new_node_claims[0].instance_type_options]
+        assert "c-1" in names
+
+    def test_multiple_small_pods_smallest_possible_type(self):
+        pods = [make_pod(requests={"cpu": "10m"}) for _ in range(50)]
+        res = schedule(pods, provider=self._sized_provider())
+        assert len(res.new_node_claims) == 1
+        assert "c-1" in [it.name for it in res.new_node_claims[0].instance_type_options]
+
+    def test_new_node_when_at_capacity(self):
+        # "should create new nodes when a node is at capacity"
+        provider = FakeCloudProvider()
+        provider.instance_types = [new_instance_type("m", {"cpu": "2", "pods": "110"})]
+        pods = [make_pod(requests={"cpu": "1800m"}) for _ in range(3)]
+        res = schedule(pods, provider=provider)
+        assert len(res.new_node_claims) == 3 and not res.pod_errors
+
+    def test_pack_small_and_large_pods_together(self):
+        provider = self._sized_provider()
+        pods = [make_pod(requests={"cpu": "4"})] + [
+            make_pod(requests={"cpu": "100m"}) for _ in range(10)
+        ]
+        res = schedule(pods, provider=provider)
+        assert len(res.new_node_claims) == 1 and not res.pod_errors
+
+    def test_zero_quantity_requests(self):
+        res = schedule([make_pod(requests={"cpu": "0"})])
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+
+    def test_pod_exceeding_every_type_fails(self):
+        res = schedule(
+            [make_pod(requests={"cpu": "10000"})], provider=self._sized_provider()
+        )
+        assert res.pod_errors and not res.new_node_claims
+
+    def test_pod_limit_per_node_capacity(self):
+        # "should create new nodes when a node is at capacity due to pod
+        # limits per node"
+        provider = FakeCloudProvider()
+        provider.instance_types = [new_instance_type("m", {"cpu": "64", "pods": "3"})]
+        pods = [make_pod(requests={"cpu": "10m"}) for _ in range(7)]
+        res = schedule(pods, provider=provider)
+        assert len(res.new_node_claims) == 3 and not res.pod_errors
+
+    def test_init_container_requests_counted(self):
+        # "should take into account initContainer resource requests"
+        provider = self._sized_provider()
+        pod = make_pod(requests={"cpu": "1"})
+        pod.spec.init_containers = [
+            Container(
+                name="init",
+                resources=ResourceRequirements(requests={"cpu": parse_quantity("14")}),
+            )
+        ]
+        res = schedule([pod], provider=provider)
+        assert not res.pod_errors
+        names = [it.name for it in res.new_node_claims[0].instance_type_options]
+        assert "c-16" in names and "c-8" not in names
+
+    def test_init_container_exceeding_all_types_fails(self):
+        pod = make_pod(requests={"cpu": "1"})
+        pod.spec.init_containers = [
+            Container(
+                name="init",
+                resources=ResourceRequirements(requests={"cpu": parse_quantity("10000")}),
+            )
+        ]
+        res = schedule([pod], provider=self._sized_provider())
+        assert res.pod_errors and not res.new_node_claims
+
+    def test_valid_types_regardless_of_price(self):
+        # "should select for valid instance types, regardless of price":
+        # every type that fits survives on the claim
+        provider = self._sized_provider()
+        res = schedule([make_pod(requests={"cpu": "3"})], provider=provider)
+        names = {it.name for it in res.new_node_claims[0].instance_type_options}
+        assert names == {"c-4", "c-8", "c-16", "c-32"}
+
+
+class TestInFlightNodes:
+    """suite_test.go "In-Flight Nodes"."""
+
+    def test_no_second_node_when_inflight_fits(self):
+        res = schedule([make_pod(requests={"cpu": "1"})], state_nodes=[state_node()])
+        assert not res.new_node_claims and len(res.existing_nodes[0].pods) == 1
+
+    def test_second_node_when_pod_wont_fit(self):
+        res = schedule(
+            [make_pod(requests={"cpu": "8"})], state_nodes=[state_node(cpu="2")]
+        )
+        assert len(res.new_node_claims) == 1
+
+    def test_second_node_on_incompatible_selector(self):
+        # in-flight node lacks the selected label; pool defines it
+        np_ = make_nodepool(labels={"custom/ig": "ig-1"})
+        res = schedule(
+            [make_pod(requests={"cpu": "1"}, node_selector={"custom/ig": "ig-1"})],
+            nodepools=[np_],
+            state_nodes=[state_node()],
+        )
+        assert len(res.new_node_claims) == 1
+        assert not res.existing_nodes or not res.existing_nodes[0].pods
+
+    def test_terminating_inflight_node_not_used(self):
+        # "should launch a second node if an in-flight node is
+        # terminating" — the PROVISIONER excludes marked-for-deletion
+        # nodes before the scheduler ever sees them (provisioner.py:120,
+        # mirroring the reference's cluster.Nodes().Active() split)
+        from karpenter_core_tpu.provisioning.provisioner import Provisioner
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        kube = KubeClient()
+        provider = FakeCloudProvider()
+        cluster = Cluster(kube, provider)
+        informers = Informers(kube, cluster)
+        informers.start()
+        try:
+            kube.create(make_nodepool())
+            node = make_node(
+                labels={
+                    wk.NODEPOOL_LABEL_KEY: "default",
+                    wk.NODE_REGISTERED_LABEL_KEY: "true",
+                    wk.NODE_INITIALIZED_LABEL_KEY: "true",
+                },
+                capacity={"cpu": "4", "memory": "16Gi", "pods": "10"},
+            )
+            kube.create(node)
+            cluster.mark_for_deletion(node.spec.provider_id)
+            kube.create(make_pod(requests={"cpu": "1"}))
+            prov = Provisioner(kube, provider, cluster, use_tpu_solver=False)
+            names, _ = prov.reconcile()
+            assert names, "a fresh claim must launch instead of the terminating node"
+        finally:
+            informers.stop()
+
+    def test_balance_zone_spread_with_inflight(self):
+        # "should balance pods across zones with in-flight nodes": the
+        # in-flight zone-1 node seeds the domain counts
+        sn = state_node(labels={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"}, cpu="16", pods="110")
+        pods = [
+            make_pod(
+                requests={"cpu": "100m"},
+                labels={"app": "web"},
+                topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": "web"})],
+            )
+            for _ in range(6)
+        ]
+        res = schedule(pods, state_nodes=[sn])
+        assert not res.pod_errors
+        zones = {}
+        for c in res.new_node_claims:
+            z = next(iter(c.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values))
+            zones[z] = zones.get(z, 0) + len(c.pods)
+        for e in res.existing_nodes:
+            z = e.state_node.labels().get(wk.LABEL_TOPOLOGY_ZONE)
+            if e.pods:
+                zones[z] = zones.get(z, 0) + len(e.pods)
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_assume_schedule_to_node_with_startup_taint(self):
+        # "should assume pod will schedule to a tainted node with a
+        # custom startup taint" — startup taints don't block placement
+        np_ = make_nodepool()
+        np_.spec.template.startup_taints = [Taint(key="custom-startup", effect="NoSchedule")]
+        node = make_node(
+            labels={wk.NODEPOOL_LABEL_KEY: "default", wk.NODE_REGISTERED_LABEL_KEY: "true"},
+            capacity={"cpu": "4", "memory": "16Gi", "pods": "10"},
+            taints=[Taint(key="custom-startup", effect="NoSchedule")],
+        )
+        from karpenter_core_tpu.apis.nodeclaim import NodeClaim
+
+        nc = NodeClaim()
+        nc.metadata.name = "startup-claim"
+        nc.spec.startup_taints = [Taint(key="custom-startup", effect="NoSchedule")]
+        sn = StateNode(node=node, node_claim=nc)
+        res = schedule([make_pod(requests={"cpu": "1"})], nodepools=[np_], state_nodes=[sn])
+        assert not res.new_node_claims and res.existing_nodes[0].pods
+
+    def test_not_assume_schedule_to_ordinary_tainted_node(self):
+        # "should not assume pod will schedule to a tainted node"
+        sn = state_node(taints=[Taint(key="foreign", effect="NoSchedule")])
+        res = schedule([make_pod(requests={"cpu": "1"})], state_nodes=[sn])
+        assert len(res.new_node_claims) == 1
+
+    def test_initialized_nodes_scheduled_first(self):
+        # "should order initialized nodes for scheduling un-initialized
+        # nodes": the initialized node fills before the un-initialized
+        init = state_node(cpu="2", initialized=True)
+        uninit = state_node(cpu="2", initialized=False)
+        res = schedule([make_pod(requests={"cpu": "1"})], state_nodes=[uninit, init])
+        placed = [e for e in res.existing_nodes if e.pods]
+        assert len(placed) == 1 and placed[0].state_node.initialized()
+
+    def test_existing_node_unowned_by_karpenter(self):
+        # "should schedule a pod to an existing node unowned by Karpenter"
+        node = make_node(capacity={"cpu": "4", "memory": "16Gi", "pods": "10"})
+        res = schedule([make_pod(requests={"cpu": "1"})], state_nodes=[StateNode(node=node)])
+        assert not res.new_node_claims and res.existing_nodes[0].pods
+
+    def test_incompatible_with_node_but_compatible_with_pool(self):
+        # pod can't land on the in-flight node (zone) but the pool offers
+        # the zone — a new claim launches
+        sn = state_node(labels={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+        res = schedule(
+            [make_pod(requests={"cpu": "1"}, node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"})],
+            state_nodes=[sn],
+        )
+        assert len(res.new_node_claims) == 1
+
+    def test_daemonset_overhead_not_compatible_with_existing_node(self):
+        # "should not subtract daemonset overhead that is not strictly
+        # compatible with an existing node"
+        ds_pod = make_pod(
+            requests={"cpu": "2"}, node_selector={"custom/only-new": "yes"},
+            owner_kind="DaemonSet",
+        )
+        sn = state_node(cpu="2")
+        res = schedule(
+            [make_pod(requests={"cpu": "1500m"})],
+            state_nodes=[sn],
+            daemonsets=[ds_pod],
+        )
+        # the DS can't land on the existing node, so its overhead must
+        # not block the pod from fitting there
+        assert res.existing_nodes and res.existing_nodes[0].pods
+
+
+class TestVolumeDrivenScheduling:
+    """suite_test.go volume specs (beyond the CSI-limit ones already
+    ported in test_solver_existing/test_scheduler_behavior)."""
+
+    def _kube_with_pvc(self, kube, name, storage_class="standard", pod_count=1):
+        pvc = PersistentVolumeClaim()
+        pvc.metadata.name = name
+        pvc.storage_class_name = storage_class
+        kube.create(pvc)
+        return pvc
+
+    def test_single_node_when_pods_share_pvc(self):
+        # "should launch a single node if all pods use the same PVC"
+        kube = KubeClient()
+        sc = StorageClass(provisioner="ebs.csi.aws.com")
+        sc.metadata.name = "standard"
+        kube.create(sc)
+        self._kube_with_pvc(kube, "shared")
+        pods = [
+            make_pod(requests={"cpu": "100m"}) for _ in range(3)
+        ]
+        for p in pods:
+            p.spec.volumes = [Volume(name="data", persistent_volume_claim="shared")]
+        res = schedule(pods, kube=kube)
+        assert not res.pod_errors and len(res.new_node_claims) == 1
+
+    def test_nonexistent_ephemeral_storage_class_fails(self):
+        # "should not launch nodes for pods with ephemeral volume using
+        # a non-existent storage class" — the PVC validation gate lives
+        # in the provisioner (provisioner.py:106), like the reference's
+        # provisioning-time VvalidatePod
+        from karpenter_core_tpu.provisioning.provisioner import Provisioner
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        kube = KubeClient()
+        provider = FakeCloudProvider()
+        cluster = Cluster(kube, provider)
+        informers = Informers(kube, cluster)
+        informers.start()
+        try:
+            kube.create(make_nodepool())
+            pod = make_pod(requests={"cpu": "100m"})
+            pod.spec.volumes = [Volume(name="scratch", ephemeral=True)]
+            pvc = PersistentVolumeClaim()
+            pvc.metadata.name = f"{pod.metadata.name}-scratch"
+            pvc.storage_class_name = "no-such-class"
+            kube.create(pvc)
+            kube.create(pod)
+            prov = Provisioner(kube, provider, cluster, use_tpu_solver=False)
+            names, _ = prov.reconcile()
+            assert not names, "no node may launch for an unresolvable storage class"
+        finally:
+            informers.stop()
