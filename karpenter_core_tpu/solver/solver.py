@@ -1076,16 +1076,24 @@ class TPUScheduler:
         # them — a retry pass folding stale ledger entries would count the
         # same pods twice (and count pods whose pack failed)
         self._prep_zone_ledger = []
-        # ledger only pods some in-batch counting selector can see — the
-        # fold is a Python scan, so plain ride-alongs nobody counts must
-        # not inflate it at headline scale
+        # ledger only pods a CROSS-counting selector can see: a spread
+        # selector matching only its own group is fully accounted by that
+        # group's water-fill, and the fold is a Python scan — at headline
+        # scale (50k pods, self-selecting spread) ledgering every bucketed
+        # pod costs ~1 s for entries nothing ever reads
         self._ledger_selectors = []
         for g in groups:
             zc = g.zone_spread()
-            if zc is not None:
-                self._ledger_selectors.append(
-                    (zc.label_selector, g.exemplar.namespace)
-                )
+            if zc is None:
+                continue
+            sel = zc.label_selector
+            if sel is None or any(
+                h is not g
+                and h.exemplar.namespace == g.exemplar.namespace
+                and sel.matches(h.exemplar.metadata.labels)
+                for h in groups
+            ):
+                self._ledger_selectors.append((sel, g.exemplar.namespace))
         # parked (pod-affinity) groups join the catalog/compat encode but
         # skip the round pipeline — they resolve post-pack, sequentially
         parked_from = len(groups)
