@@ -3315,7 +3315,14 @@ class TPUScheduler:
                     ),
                 )
                 m["members"].extend(r["members"])
-                m.pop("_limit_counts", None)  # membership grew: recount lazily
+                # merge the per-selector count caches additively: keys
+                # cached on BOTH sides stay exact (counts are disjoint
+                # membership sums); one-sided keys recompute lazily
+                m_cache = m.get("_limit_counts") or {}
+                r_cache = r.get("_limit_counts") or {}
+                m["_limit_counts"] = {
+                    k: m_cache[k] + r_cache[k] for k in m_cache.keys() & r_cache.keys()
+                }
                 placed = True
                 break
             if not placed:
