@@ -605,6 +605,12 @@ class TPUScheduler:
         # batch's committed placements — park them (r5; topologygroup.go:
         # 215-247 semantics under the ordering that places counted groups
         # first). Self-selecting single-term groups take the same path.
+        # A tensor spread group whose selector matches a PARKED group's
+        # labels deliberately does NOT see the parked placements: parked
+        # groups resolve last, which is the valid serial order "spread
+        # pods first" — their counts at placement time are exactly the
+        # seeds+ledger, and later unconstrained-by-that-constraint
+        # placements may unbalance them, as the reference permits.
         parked = [g for g in tensor_groups if g.tensor_pod_affinity() is not None]
         tensor_groups = exclude(tensor_groups, parked)
         # hostname topologies stay tensor even with existing capacity:
