@@ -514,7 +514,6 @@ class TPUScheduler:
         # selector-content fingerprint caches: many groups carry distinct
         # selector OBJECTS with identical content (one per signature), so
         # match results key on content, not identity
-        self._sel_fp_cache: Dict[int, tuple] = {}
         self._match_cache: Dict[Tuple[tuple, int], bool] = {}
         # (sel_fp, id(plan)) -> (members_len, matched) — anchor rescans
         # only when a plan grew
@@ -1796,8 +1795,12 @@ class TPUScheduler:
             self._seed_cache[key] = seeds
         return seeds
 
-    def _sel_fp(self, sel) -> tuple:
-        fp = self._sel_fp_cache.get(id(sel))
+    @staticmethod
+    def _sel_fp(sel) -> tuple:
+        # cached on the selector object itself (selectors are immutable
+        # once built): the hot paths call this hundreds of thousands of
+        # times per solve and the id-keyed dict lookup was measurable
+        fp = getattr(sel, "_solver_fp", None)
         if fp is None:
             fp = (
                 tuple(sorted(sel.match_labels.items())),
@@ -1806,7 +1809,7 @@ class TPUScheduler:
                     for e in sel.match_expressions
                 ),
             )
-            self._sel_fp_cache[id(sel)] = fp
+            sel._solver_fp = fp
         return fp
 
     def _sel_matches(self, sel, i: int, pods: List[Pod]) -> bool:
