@@ -226,14 +226,37 @@ def _oracle_parity(pods, provider, nodepool, tpu_result=None, subsample=None):
 
 def _split(solver) -> dict:
     """Device-vs-host wall split of the solver's most recent solve
-    (solver.last_timings; VERDICT r4: make "TPU-native" measurable)."""
+    (solver.last_timings; VERDICT r4: make "TPU-native" measurable),
+    plus the tracer's per-phase self-time breakdown and the top-3 host
+    phases (ISSUE 1: host-dominance must be structurally attributable,
+    not a single host_ms total). The breakdown's phases sum to the
+    solve's wall time by construction (self times partition the root)."""
     t = getattr(solver, "last_timings", None)
     if not t:
         return {}
-    return {
+    out = {
         "device_ms": round(t["device_ms"], 2),
         "host_ms": round(t["host_ms"], 2),
     }
+    trace_id = t.get("trace_id")
+    if trace_id:
+        from karpenter_core_tpu.tracing import tracer as _tracer
+
+        trace = _tracer.RING.get(trace_id)
+        if trace is not None:
+            breakdown = {
+                k: round(v, 2)
+                for k, v in sorted(trace.phase_breakdown_ms().items())
+            }
+            out["phase_breakdown_ms"] = breakdown
+            out["top_host_phases"] = [
+                [name, ms]
+                for name, ms in sorted(
+                    breakdown.items(), key=lambda kv: -kv[1]
+                )
+                if name != "device_wait"
+            ][:3]
+    return out
 
 
 def headline(out: dict) -> None:

@@ -22,6 +22,10 @@ class Event:
     dedupe_values: Tuple[str, ...] = ()
     dedupe_timeout: float = DEFAULT_DEDUPE_TIMEOUT
     rate_limit_per_minute: Optional[int] = None
+    # solve-trace correlation: stamped at publish time from the active
+    # trace (tracing/), so an event stream can be joined back to the
+    # exact /debug/traces entry that produced it
+    trace_id: str = ""
 
     def dedupe_key(self) -> tuple:
         if self.dedupe_values:
@@ -57,6 +61,10 @@ class Recorder:
     def _publish_one(self, e: Event) -> None:
         if e is None:
             return
+        if not e.trace_id:
+            from ..tracing.tracer import current_trace_id
+
+            e.trace_id = current_trace_id() or ""
         now = self.clock()
         with self._mu:
             key = e.dedupe_key()

@@ -1,2 +1,3 @@
 from .registry import Counter, Gauge, Histogram, Registry, Metrics
 from .store import MetricsStore
+from .textcheck import check_exposition

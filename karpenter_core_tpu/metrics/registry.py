@@ -38,7 +38,7 @@ class Counter:
         return self.values.get(_labels_key(labels), 0.0)
 
     def collect(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} counter"]
         with self._mu:
             items = sorted(self.values.items())
         for key, v in items:
@@ -66,7 +66,7 @@ class Gauge:
             self.values.pop(_labels_key(labels), None)
 
     def collect(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} gauge"]
         with self._mu:
             items = sorted(self.values.items())
         for key, v in items:
@@ -114,7 +114,7 @@ class Histogram:
         return _Timer()
 
     def collect(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} histogram"]
         with self._mu:
             snapshot = sorted(self.counts)
             counts = {k: list(v) for k, v in self.counts.items()}
@@ -129,11 +129,23 @@ class Histogram:
         return out
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — label values carry user-controlled strings (node names,
+    error reasons)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format (backslash, newline)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(key: tuple, **extra) -> str:
     pairs = list(key) + sorted(extra.items())
     if not pairs:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in pairs)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
     return "{" + inner + "}"
 
 
@@ -211,7 +223,7 @@ class Metrics:
         )
         self.solver_phase_duration = r.histogram(
             f"{ns}_tpu_solver_phase_duration_seconds",
-            "TPU solve phase wall time (existing_pack/encode/pack)",
+            "TPU solve phase wall time, per tracing span (coarse: existing_pack/encode/pack/affinity_postpass; fine: encode.*/pack.*/device_wait/... — see tracing/)",
             labels=["phase"],
         )
         self.solver_device_duration = r.histogram(

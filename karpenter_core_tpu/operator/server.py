@@ -1,6 +1,7 @@
 """Served operational surface (ref pkg/operator/operator.go:126-177):
-a metrics server (`/metrics`, plus `/debug/pprof/*` when profiling is
-enabled) and a probe server (`/healthz`, `/readyz`).
+a metrics server (`/metrics`, `/debug/traces[/last]`, plus
+`/debug/pprof/*` when profiling is enabled) and a probe server
+(`/healthz`, `/readyz`).
 
 The reference gets these from controller-runtime's manager; here they
 are two stdlib ThreadingHTTPServers. The pprof equivalents are
@@ -37,30 +38,69 @@ def _stack_dump(_query) -> Tuple[int, str, str]:
     return 200, "text/plain; charset=utf-8", "\n".join(lines)
 
 
+# single-flight gate for the sampling profiler: two overlapping captures
+# would double-count samples (both walk sys._current_frames and see each
+# other's handler thread) and burn two threads at 100 Hz
+_PROFILE_GATE = threading.Lock()
+
+
 def _collapsed_profile(query) -> Tuple[int, str, str]:
     """Sample every thread's stack for ?seconds=N (default 2, max 30) at
-    ~100 Hz; emit one collapsed stack per line with its sample count."""
+    ~100 Hz; emit one collapsed stack per line with its sample count.
+    Concurrent captures are rejected with 429."""
     try:
         seconds = min(float(query.get("seconds", ["2"])[0]), 30.0)
     except ValueError:
         return 400, "text/plain", "bad seconds parameter\n"
-    me = threading.get_ident()
-    samples: Counter = Counter()
-    deadline = time.monotonic() + seconds
-    while time.monotonic() < deadline:
-        for ident, frame in sys._current_frames().items():
-            if ident == me:
-                continue
-            stack = []
-            while frame is not None:
-                code = frame.f_code
-                stack.append(f"{code.co_name} ({code.co_filename}:{frame.f_lineno})")
-                frame = frame.f_back
-            if stack:
-                samples[";".join(reversed(stack))] += 1
-        time.sleep(0.01)
+    if not _PROFILE_GATE.acquire(blocking=False):
+        return 429, "text/plain", "profile capture already in flight\n"
+    try:
+        me = threading.get_ident()
+        samples: Counter = Counter()
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                stack = []
+                while frame is not None:
+                    code = frame.f_code
+                    stack.append(f"{code.co_name} ({code.co_filename}:{frame.f_lineno})")
+                    frame = frame.f_back
+                if stack:
+                    samples[";".join(reversed(stack))] += 1
+            time.sleep(0.01)
+    finally:
+        _PROFILE_GATE.release()
     body = "".join(f"{stack} {count}\n" for stack, count in samples.most_common())
     return 200, "text/plain; charset=utf-8", body or "no samples\n"
+
+
+def _traces(query) -> Tuple[int, str, str]:
+    """Chrome trace-event JSON of the buffered solve traces
+    (Perfetto / chrome://tracing loadable). ``?id=<trace_id>`` selects
+    one trace; default is every trace still in the ring."""
+    from ..tracing import RING, to_chrome_json
+
+    wanted = query.get("id", [None])[0]
+    if wanted is not None:
+        tr = RING.get(wanted)
+        if tr is None:
+            return 404, "text/plain", f"no buffered trace {wanted}\n"
+        traces = [tr]
+    else:
+        traces = RING.all()
+    return 200, "application/json", to_chrome_json(traces)
+
+
+def _traces_last(_query) -> Tuple[int, str, str]:
+    """The most recent solve trace as Chrome trace-event JSON."""
+    from ..tracing import RING, to_chrome_json
+
+    tr = RING.last()
+    if tr is None:
+        return 404, "text/plain", "no solve traces captured yet\n"
+    return 200, "application/json", to_chrome_json([tr])
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -152,7 +192,14 @@ class OperationalServer:
         return server
 
     def start(self) -> None:
-        metrics_routes: Dict[str, Route] = {"/metrics": self._metrics}
+        metrics_routes: Dict[str, Route] = {
+            "/metrics": self._metrics,
+            # solve traces are always on: the tracer's steady-state cost
+            # is a few dozen span records per solve, and the routes only
+            # read the ring buffer (ISSUE 1 tentpole)
+            "/debug/traces": _traces,
+            "/debug/traces/last": _traces_last,
+        }
         if self.enable_profiling:
             metrics_routes["/debug/pprof/"] = _stack_dump
             metrics_routes["/debug/pprof/profile"] = _collapsed_profile

@@ -25,6 +25,7 @@ from ..scheduler.nodeclaim import SchedulingNodeClaim
 from ..scheduler.scheduler import Results, SchedulerOptions
 from ..scheduler.volumetopology import VolumeTopology
 from ..state.cluster import Cluster
+from ..tracing import tracer
 from ..utils import pod as podutils
 from ..utils.pretty import ChangeMonitor
 from .batcher import Batcher
@@ -70,27 +71,38 @@ class Provisioner:
     # -- reconcile (provisioner.go:114) ------------------------------------
 
     def reconcile(self, wait_for_batch: bool = False) -> Tuple[List[str], Optional[str]]:
-        """One pass: returns (created nodeclaim names, requeue reason)."""
+        """One pass: returns (created nodeclaim names, requeue reason).
+        The pass runs under one trace root (batch → schedule → solve →
+        claim creation); the trace is buffered only when a solve ran, so
+        idle reconciles can't evict real solve traces."""
+        import time as _time
+
+        batch_t0 = _time.perf_counter()
         if wait_for_batch:
             if not self.batcher.wait():
                 return [], None
+        batch_wait_ms = round((_time.perf_counter() - batch_t0) * 1000.0, 3)
         if not self.cluster.synced():
             return [], "waiting on cluster sync"
-        results = self.schedule()
-        if results is None:
-            return [], None
-        names: List[str] = []
-        create_errors: List[str] = []
-        opts = LaunchOptions(record_pod_nomination=True, reason="provisioning")
-        if results.new_node_claims:
-            created, errs = self.create_node_claims(results.new_node_claims, opts)
-            names.extend(created)
-            create_errors.extend(errs)
-        for plan in getattr(results, "tpu_plans", []):
-            try:
-                names.append(self.create_from_plan(plan, opts))
-            except Exception as e:  # noqa: BLE001 — one failed plan must not skip the rest
-                create_errors.append(f"creating node claim from plan, {e}")
+        with tracer.trace_root(
+            "provisioner.reconcile", buffer_if="solve", batch_wait_ms=batch_wait_ms
+        ):
+            results = self.schedule()
+            if results is None:
+                return [], None
+            names: List[str] = []
+            create_errors: List[str] = []
+            opts = LaunchOptions(record_pod_nomination=True, reason="provisioning")
+            with tracer.span("create_node_claims"):
+                if results.new_node_claims:
+                    created, errs = self.create_node_claims(results.new_node_claims, opts)
+                    names.extend(created)
+                    create_errors.extend(errs)
+                for plan in getattr(results, "tpu_plans", []):
+                    try:
+                        names.append(self.create_from_plan(plan, opts))
+                    except Exception as e:  # noqa: BLE001 — one failed plan must not skip the rest
+                        create_errors.append(f"creating node claim from plan, {e}")
         # surface failures instead of looking like "nothing to do"
         reason = "; ".join(create_errors[:5]) if create_errors else None
         return names, reason
@@ -116,10 +128,12 @@ class Provisioner:
         # wrapper (operator.py) — observing here too would double-count
         # snapshot nodes BEFORE listing pods to avoid over-provisioning
         # (provisioner.go:301-312)
-        nodes = self.cluster.deep_copy_nodes()
+        with tracer.span("snapshot_nodes"):
+            nodes = self.cluster.deep_copy_nodes()
         active = [n for n in nodes if not n.marked_for_deletion]
         deleting = [n for n in nodes if n.marked_for_deletion]
-        pending = self.get_pending_pods()
+        with tracer.span("pending_pods"):
+            pending = self.get_pending_pods()
         # pods on deleting nodes need replacement capacity
         # (provisioner.go:317-323)
         deleting_pods: List[Pod] = []
@@ -164,7 +178,8 @@ class Provisioner:
             )
         except NodePoolsNotFoundError:
             return Results()
-        return scheduler.solve(pods)
+        with tracer.trace_root("oracle_solve", is_solve=True, pods=len(pods)):
+            return scheduler.solve(pods)
 
     def _schedule_tpu(self, pods: List[Pod], nodepools, state_nodes=None) -> Results:
         """TPU path: solve plans, then re-express them as scheduler results
@@ -253,12 +268,15 @@ class Provisioner:
             from ..scheduler.builder import build_scheduler
             from ..solver import TPUScheduler
 
-            o = build_scheduler(
-                self.kube_client, None, nodepools, self.cloud_provider, sub
-            ).solve(sub)
-            t = TPUScheduler(
-                nodepools, self.cloud_provider, kube_client=self.kube_client
-            ).solve(sub)
+            # the shadow's traces must not displace live solve traces in
+            # /debug/traces (it runs the same instrumented pipeline)
+            with tracer.trace_root("parity_shadow", buffer_if="never"):
+                o = build_scheduler(
+                    self.kube_client, None, nodepools, self.cloud_provider, sub
+                ).solve(sub)
+                t = TPUScheduler(
+                    nodepools, self.cloud_provider, kube_client=self.kube_client
+                ).solve(sub)
             o_scheduled = sum(len(c.pods) for c in o.new_node_claims)
             o_nodes = len(o.new_node_claims)
             if t.pods_scheduled < o_scheduled:

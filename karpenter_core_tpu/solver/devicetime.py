@@ -15,6 +15,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from ..tracing.tracer import span as _span
+
 _tls = threading.local()
 
 
@@ -28,8 +30,12 @@ def seconds() -> float:
 
 @contextmanager
 def track():
+    """Accumulate device-attributable time; under an active solve trace
+    each tracked region is also a ``device_wait`` span, so the exported
+    trace shows *where* in the host pipeline the device waits sit."""
     t0 = time.perf_counter()
     try:
-        yield
+        with _span("device_wait"):
+            yield
     finally:
         _tls.seconds = getattr(_tls, "seconds", 0.0) + (time.perf_counter() - t0)

@@ -30,6 +30,7 @@ from ..scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
     pod_requirements,
 )
+from ..tracing import tracer
 from ..utils import pod as podutils
 from .vocab import Vocab
 
@@ -228,6 +229,14 @@ class EncodedInstanceTypes:
 
 
 def encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis, vocab: Vocab) -> EncodedInstanceTypes:
+    """Tensorize one catalog (cold path: cached across solves by
+    solver._catalog_entry; traced because a catalog-generation bump
+    re-pays it inside a live solve)."""
+    with tracer.span("encode.instance_types", types=len(instance_types)):
+        return _encode_instance_types(instance_types, axis, vocab)
+
+
+def _encode_instance_types(instance_types: List[InstanceType], axis: ResourceAxis, vocab: Vocab) -> EncodedInstanceTypes:
     T = len(instance_types)
     # observe all values first so vocab widths are final
     for it in instance_types:

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from . import devicetime
+from ..tracing import tracer
 import numpy as np
 
 INT_INF = np.int32(2**31 - 1)
@@ -170,6 +171,17 @@ def run_pack_existing(
     scan. → (assign (P,), remaining free (M, R))."""
     if requests.shape[0] == 0 or free.shape[0] == 0:
         return np.full(requests.shape[0], -1, dtype=np.int32), free
+    with tracer.span("pack.existing_dispatch", pods=int(requests.shape[0])):
+        return _run_pack_existing(requests, sig_ids, compat, free, engine)
+
+
+def _run_pack_existing(
+    requests: np.ndarray,
+    sig_ids: np.ndarray,
+    compat: np.ndarray,
+    free: np.ndarray,
+    engine: str,
+) -> Tuple[np.ndarray, np.ndarray]:
     if engine in ("auto", "native"):
         from .. import native
 
@@ -252,6 +264,11 @@ def batch_pack(jobs: list, engine: str = "auto", mesh=None) -> list:
     Returns [(node_ids, node_count)] aligned with jobs."""
     if not jobs:
         return []
+    with tracer.span("pack.dispatch", jobs=len(jobs)):
+        return _batch_pack(jobs, engine, mesh)
+
+
+def _batch_pack(jobs: list, engine: str, mesh) -> list:
     if mesh is not None and engine in ("device", "sharded"):
         return _batch_pack_sharded(mesh, jobs)
     if engine in ("auto", "native"):

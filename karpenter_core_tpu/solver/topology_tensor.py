@@ -32,6 +32,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..tracing import tracer
+
 
 def _fill_unbounded(counts: np.ndarray, pods: int) -> np.ndarray:
     """Exact integer water-fill: pour ``pods`` units lowest-first onto
@@ -156,7 +158,10 @@ def seed_counts_for_selector(
         None,
         set(),
     )
-    return count_matching_pods_by_domain(kube_client, tg, excluded_uids)
+    # the count is a full kube-store pod scan — one of the host-dominated
+    # prefilter paths the solve trace attributes (ISSUE 1)
+    with tracer.span("topology.seed_counts", key=topology_key):
+        return count_matching_pods_by_domain(kube_client, tg, excluded_uids)
 
 
 def seed_counts_for_constraint(
@@ -188,4 +193,5 @@ def seed_counts_for_constraint(
         constraint.min_domains,
         set(),
     )
-    return count_matching_pods_by_domain(kube_client, tg, excluded_uids)
+    with tracer.span("topology.seed_counts", key=constraint.topology_key):
+        return count_matching_pods_by_domain(kube_client, tg, excluded_uids)

@@ -1,0 +1,26 @@
+"""Solve-trace subsystem (SURVEY §5 tracing; the reference's
+``--enable-profiling`` pprof surface, operator.go:144-160, taken one
+step further): structured span traces of every solve, exportable as
+Chrome trace-event JSON (Perfetto / ``chrome://tracing``), with a
+metrics bridge into ``solver_phase_duration`` and slow-solve capture
+to disk.
+
+Layers:
+  tracer.py  — thread-local span stack, monotonic clocks, ring buffer
+  export.py  — Chrome trace-event JSON (catapult TraceEvent format)
+  capture.py — slow-solve persistence behind env knobs
+"""
+
+from .tracer import (  # noqa: F401
+    RING,
+    Span,
+    Trace,
+    TraceRing,
+    current_trace,
+    current_trace_id,
+    enabled,
+    span,
+    trace_root,
+)
+from .export import to_chrome_events, to_chrome_json  # noqa: F401
+from .capture import maybe_capture  # noqa: F401

@@ -1,0 +1,81 @@
+"""Slow-solve capture: persist any finished trace whose wall time
+exceeds a configurable threshold, so a host-time regression observed
+once in production leaves a loadable artifact behind.
+
+Env knobs (read per capture so tests and live operators can flip them
+without restarting):
+
+  KARPENTER_TPU_TRACE_SLOW_MS   wall-time threshold in ms; unset/empty
+                                disables capture; "0" captures every
+                                buffered trace (debug mode)
+  KARPENTER_TPU_TRACE_DIR       output directory (created on demand);
+                                default /tmp/karpenter-tpu-traces
+  KARPENTER_TPU_TRACE_KEEP      max files retained (oldest pruned);
+                                default 100
+
+Failures are swallowed after a debug log: the capture path must never
+take a solve down.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from .tracer import Trace
+
+DEFAULT_DIR = "/tmp/karpenter-tpu-traces"
+DEFAULT_KEEP = 100
+
+
+def _threshold_ms() -> Optional[float]:
+    raw = os.environ.get("KARPENTER_TPU_TRACE_SLOW_MS", "")
+    if raw == "":
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def maybe_capture(trace: Trace) -> Optional[str]:
+    """Write ``trace`` as Chrome trace-event JSON if it crossed the
+    slow-solve threshold. Returns the file path, or None."""
+    threshold = _threshold_ms()
+    if threshold is None or trace.total_ms < threshold:
+        return None
+    out_dir = os.environ.get("KARPENTER_TPU_TRACE_DIR", DEFAULT_DIR)
+    try:
+        from .export import to_chrome_json
+
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir,
+            f"solve-{trace.wall_start:.3f}-{trace.trace_id}.trace.json",
+        )
+        with open(path, "w") as f:
+            f.write(to_chrome_json([trace]))
+        _prune(out_dir)
+        return path
+    except OSError:
+        logging.getLogger("karpenter").debug(
+            "slow-solve trace capture failed", exc_info=True
+        )
+        return None
+
+
+def _prune(out_dir: str) -> None:
+    """Keep the newest KARPENTER_TPU_TRACE_KEEP capture files."""
+    try:
+        keep = int(os.environ.get("KARPENTER_TPU_TRACE_KEEP", str(DEFAULT_KEEP)))
+    except ValueError:
+        keep = DEFAULT_KEEP
+    try:
+        files = sorted(
+            f for f in os.listdir(out_dir) if f.endswith(".trace.json")
+        )
+        for name in files[: max(0, len(files) - keep)]:
+            os.unlink(os.path.join(out_dir, name))
+    except OSError:
+        pass

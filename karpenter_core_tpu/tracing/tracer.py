@@ -1,0 +1,298 @@
+"""Low-overhead span tracer for the solve pipeline.
+
+Design constraints (the tracer instruments a path whose whole budget is
+<500 ms for 50k pods, ISSUE 1):
+
+- ``span()`` is a no-op costing one thread-local read when no trace is
+  active on the calling thread, so library code (devicetime, pack,
+  topology seeding) can be instrumented unconditionally.
+- All timestamps come from ``time.perf_counter_ns()`` — one monotonic
+  clock for every span, so durations nest exactly and the exported
+  trace is internally consistent (wall time is recorded once per trace
+  for file naming / correlation only).
+- Spans carry a parent reference and accumulate child time, so
+  *self time* (duration minus direct children) is exact without a
+  post-hoc interval scan; the sum of self times over a trace equals
+  the root duration, which is what lets ``bench.py`` emit a
+  ``phase_breakdown_ms`` that reconciles with ``host_ms + device_ms``.
+- Completed traces land in a fixed-capacity ring buffer (newest-wins)
+  read by the ``/debug/traces`` routes; nothing is retained beyond it
+  unless the slow-solve capture persists a copy.
+
+The metrics bridge: a trace may carry a histogram sink (the scheduler's
+``solver_phase_duration``); every completed span is observed under
+``phase=<span name>``, which keeps the pre-existing coarse labels
+(existing_pack / encode / pack / affinity_postpass) and adds the
+fine-grained ones (encode.compat_wait, pack.dispatch, ...). The bridge
+runs even when recording is disabled (KARPENTER_TPU_TRACE=0) so the
+metric surface never depends on the tracing knob.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+# synthetic-lane thread id: spans on this lane (e.g. the per-solve
+# device_total rollup) are derived quantities, not measured host spans —
+# excluded from phase breakdowns, exported on their own named track
+SYNTHETIC_TID = -1
+
+_trace_counter = itertools.count(1)
+
+
+def enabled() -> bool:
+    """Span *recording* switch (env, read per trace so tests and the
+    bench overhead comparison can flip it without reimporting). The
+    metrics bridge is unaffected — see module docstring."""
+    return os.environ.get("KARPENTER_TPU_TRACE", "1") != "0"
+
+
+class Span:
+    """One timed region. ``ts_ns``/``dur_ns`` are perf_counter_ns
+    values; ``parent`` is the enclosing Span (None for the root);
+    ``child_ns`` accumulates direct children's durations so
+    ``self_ns`` needs no interval arithmetic."""
+
+    __slots__ = ("name", "ts_ns", "dur_ns", "tid", "depth", "parent", "child_ns", "args")
+
+    def __init__(self, name: str, ts_ns: int, tid: int, depth: int, parent: Optional["Span"], args: Optional[dict]):
+        self.name = name
+        self.ts_ns = ts_ns
+        self.dur_ns = 0
+        self.tid = tid
+        self.depth = depth
+        self.parent = parent
+        self.child_ns = 0
+        self.args = args
+
+    @property
+    def self_ns(self) -> int:
+        return self.dur_ns - self.child_ns
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"Span({self.name!r}, dur={self.dur_ns / 1e6:.3f}ms, depth={self.depth})"
+
+
+class Trace:
+    """One traced operation (normally one solve): a ``trace_id``, the
+    completed spans, and optional sinks (metrics histogram)."""
+
+    __slots__ = (
+        "trace_id",
+        "name",
+        "start_ns",
+        "end_ns",
+        "wall_start",
+        "pid",
+        "spans",
+        "metrics_sink",
+        "record",
+        "contains_solve",
+        "args",
+    )
+
+    def __init__(self, name: str, trace_id: Optional[str] = None, metrics_sink=None, record: bool = True, **args):
+        if trace_id is None:
+            trace_id = f"t{next(_trace_counter):06d}-{os.getpid():x}"
+        self.trace_id = trace_id
+        self.name = name
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns: Optional[int] = None
+        self.wall_start = time.time()
+        self.pid = os.getpid()
+        self.spans: List[Span] = []
+        self.metrics_sink = metrics_sink
+        self.record = record
+        self.contains_solve = False
+        self.args = dict(args)
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def total_ms(self) -> float:
+        end = self.end_ns if self.end_ns is not None else time.perf_counter_ns()
+        return (end - self.start_ns) / 1e6
+
+    def add_synthetic(self, name: str, ts_ns: int, dur_ns: int, **args) -> Span:
+        """A derived span (e.g. accumulated device-attributable time) on
+        the synthetic lane — exported, excluded from breakdowns."""
+        s = Span(name, ts_ns, SYNTHETIC_TID, 0, None, args or None)
+        s.dur_ns = max(int(dur_ns), 0)
+        if self.record:
+            self.spans.append(s)
+        return s
+
+    def phase_breakdown_ms(self) -> Dict[str, float]:
+        """Self-time per span name, in ms. Synthetic spans are excluded,
+        so the values sum to the root span's duration (≈ host + device
+        wall time: device waits are real measured spans)."""
+        out: Dict[str, float] = {}
+        for s in self.spans:
+            if s.tid == SYNTHETIC_TID:
+                continue
+            out[s.name] = out.get(s.name, 0.0) + s.self_ns / 1e6
+        return out
+
+    def device_ms(self) -> float:
+        """Sum of measured device-wait span durations."""
+        return sum(s.dur_ns for s in self.spans if s.name == "device_wait") / 1e6
+
+
+class TraceRing:
+    """Fixed-capacity newest-wins buffer of completed traces."""
+
+    def __init__(self, capacity: int = 32):
+        self._mu = threading.Lock()
+        self._capacity = max(1, int(capacity))
+        self._items: List[Trace] = []
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._mu:
+            self._capacity = max(1, int(capacity))
+            del self._items[: -self._capacity]
+
+    def push(self, trace: Trace) -> None:
+        with self._mu:
+            self._items.append(trace)
+            if len(self._items) > self._capacity:
+                del self._items[: -self._capacity]
+
+    def last(self) -> Optional[Trace]:
+        with self._mu:
+            return self._items[-1] if self._items else None
+
+    def get(self, trace_id: str) -> Optional[Trace]:
+        with self._mu:
+            for t in reversed(self._items):
+                if t.trace_id == trace_id:
+                    return t
+        return None
+
+    def all(self) -> List[Trace]:
+        with self._mu:
+            return list(self._items)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._items.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._items)
+
+
+RING = TraceRing(int(os.environ.get("KARPENTER_TPU_TRACE_BUFFER", "32")))
+
+_tls = threading.local()
+
+
+def current_trace() -> Optional[Trace]:
+    return getattr(_tls, "trace", None)
+
+
+def current_trace_id() -> Optional[str]:
+    tr = getattr(_tls, "trace", None)
+    return tr.trace_id if tr is not None else None
+
+
+@contextmanager
+def span(name: str, **args):
+    """Time a region of the active trace. No active trace on this
+    thread → pure pass-through (one thread-local read)."""
+    tr = getattr(_tls, "trace", None)
+    if tr is None:
+        yield None
+        return
+    stack: List[Span] = _tls.stack
+    parent = stack[-1] if stack else None
+    s = Span(name, time.perf_counter_ns(), threading.get_ident(), len(stack), parent, args or None)
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        s.dur_ns = time.perf_counter_ns() - s.ts_ns
+        stack.pop()
+        if parent is not None:
+            parent.child_ns += s.dur_ns
+        if tr.record:
+            tr.spans.append(s)
+        sink = tr.metrics_sink
+        if sink is not None:
+            sink.observe(s.dur_ns / 1e9, phase=name)
+
+
+@contextmanager
+def trace_root(
+    name: str,
+    metrics_sink=None,
+    buffer_if: str = "always",
+    is_solve: bool = False,
+    **args,
+):
+    """Open a trace on this thread (or join the active one).
+
+    With an active trace this degrades to a plain ``span`` — the solver
+    joins a provisioner-opened trace instead of starting its own — and
+    attaches ``metrics_sink`` if the outer trace has none (the
+    provisioner opens the trace before it knows which scheduler runs).
+
+    ``buffer_if``: "always" pushes the finished trace to the ring;
+    "solve" pushes only when a solve span ran inside it (keeps
+    empty provisioner reconciles from evicting real solve traces);
+    "never" suppresses buffering and capture (shadow/simulation
+    solves that must not displace the live traffic's traces).
+    On finish the slow-solve capture (capture.py) sees every
+    buffered trace.
+    """
+    tr = getattr(_tls, "trace", None)
+    if tr is not None:
+        if metrics_sink is not None and tr.metrics_sink is None:
+            tr.metrics_sink = metrics_sink
+        if is_solve:
+            tr.contains_solve = True
+        with span(name, **args):
+            yield tr
+        return
+
+    record = enabled()
+    if not record and metrics_sink is None:
+        # nothing to record and nothing to observe: keep the whole
+        # trace a no-op (one env read per solve) so the disabled mode
+        # is genuinely free
+        yield None
+        return
+
+    tr = Trace(name, metrics_sink=metrics_sink, record=record, **args)
+    tr.contains_solve = is_solve
+    _tls.trace = tr
+    _tls.stack = []
+    root = Span(name, tr.start_ns, threading.get_ident(), 0, None, args or None)
+    _tls.stack.append(root)
+    try:
+        yield tr
+    finally:
+        root.dur_ns = time.perf_counter_ns() - root.ts_ns
+        tr.end_ns = root.ts_ns + root.dur_ns
+        if tr.record:
+            tr.spans.append(root)
+        sink = tr.metrics_sink
+        if sink is not None:
+            sink.observe(root.dur_ns / 1e9, phase=name)
+        _tls.trace = None
+        _tls.stack = []
+        if tr.record and (
+            buffer_if == "always" or (buffer_if == "solve" and tr.contains_solve)
+        ):
+            RING.push(tr)
+            from .capture import maybe_capture
+
+            maybe_capture(tr)

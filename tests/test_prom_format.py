@@ -1,0 +1,115 @@
+"""Metric-surface regression gate (ISSUE 1 satellite): the real
+Registry.expose() payload must pass the Prometheus text-format checker
+(HELP/TYPE pairing, label escaping, bucket monotonicity), and the
+checker itself must actually catch violations."""
+
+from helpers import make_node, make_nodepool, make_pod
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.metrics import Metrics, check_exposition
+from karpenter_core_tpu.solver import TPUScheduler
+from karpenter_core_tpu.state.statenode import StateNode
+
+
+def test_exposition_well_formed_after_real_solve():
+    """Populate the registry through a real traced solve (histogram with
+    fine-grained phase labels included), then lint the payload."""
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(5)
+    metrics = Metrics()
+    node = make_node(
+        labels={
+            wk.NODEPOOL_LABEL_KEY: "default",
+            wk.NODE_REGISTERED_LABEL_KEY: "true",
+            wk.NODE_INITIALIZED_LABEL_KEY: "true",
+        },
+        capacity={"cpu": "2", "memory": "8Gi", "pods": "10"},
+    )
+    solver = TPUScheduler(
+        [make_nodepool()], provider, kube_client=KubeClient(), metrics=metrics
+    )
+    solver.solve(
+        [make_pod(requests={"cpu": "1"}) for _ in range(6)],
+        state_nodes=[StateNode(node=node)],
+    )
+    text = metrics.registry.expose()
+    assert check_exposition(text) == [], check_exposition(text)
+
+
+def test_exposition_escapes_hostile_label_values():
+    m = Metrics()
+    m.node_allocatable.set(4.0, node='we"ird\\node\nname', resource="cpu")
+    m.reconcile_errors.inc(controller="a,b={c}")
+    text = m.registry.expose()
+    assert check_exposition(text) == [], check_exposition(text)
+
+
+def test_checker_flags_unescaped_quote():
+    bad = "\n".join(
+        [
+            "# HELP foo help",
+            "# TYPE foo counter",
+            'foo{a="un"escaped"} 1',
+        ]
+    )
+    assert check_exposition(bad)
+
+
+def test_checker_flags_missing_type_and_late_type():
+    assert any(
+        "no preceding TYPE" in p for p in check_exposition("# HELP foo h\nfoo 1")
+    )
+    late = "\n".join(["# HELP foo h", "foo 1", "# TYPE foo counter"])
+    assert any("after its samples" in p for p in check_exposition(late))
+
+
+def test_checker_flags_nonmonotone_buckets():
+    bad = "\n".join(
+        [
+            "# HELP h x",
+            "# TYPE h histogram",
+            'h_bucket{le="1"} 5',
+            'h_bucket{le="2"} 3',
+            'h_bucket{le="+Inf"} 6',
+            "h_sum 1.0",
+            "h_count 6",
+        ]
+    )
+    assert any("not cumulative" in p for p in check_exposition(bad))
+
+
+def test_checker_flags_inf_count_mismatch_and_missing_inf():
+    mismatch = "\n".join(
+        [
+            "# HELP h x",
+            "# TYPE h histogram",
+            'h_bucket{le="1"} 2',
+            'h_bucket{le="+Inf"} 5',
+            "h_sum 1.0",
+            "h_count 6",
+        ]
+    )
+    assert any("_count" in p for p in check_exposition(mismatch))
+    missing = "\n".join(
+        [
+            "# HELP h x",
+            "# TYPE h histogram",
+            'h_bucket{le="1"} 2',
+            "h_sum 1.0",
+            "h_count 2",
+        ]
+    )
+    assert any("+Inf" in p for p in check_exposition(missing))
+
+
+def test_checker_flags_duplicate_series():
+    dup = "\n".join(
+        [
+            "# HELP foo h",
+            "# TYPE foo counter",
+            'foo{a="1"} 1',
+            'foo{a="1"} 2',
+        ]
+    )
+    assert any("duplicate series" in p for p in check_exposition(dup))

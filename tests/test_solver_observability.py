@@ -37,6 +37,19 @@ def test_phase_histograms_observed():
     text = "\n".join(metrics.solver_phase_duration.collect())
     for phase in ("existing_pack", "encode", "pack"):
         assert f'phase="{phase}"' in text, text
+    # the tracing bridge (ISSUE 1) feeds every span into the histogram,
+    # so the coarse labels above are now joined by fine-grained ones
+    for phase in (
+        "solve",
+        "pod_memos",
+        "group_pods",
+        "encode.signatures",
+        "encode.compat_wait",
+        "pack.choose_pool",
+        "pack.dispatch",
+        "device_wait",
+    ):
+        assert f'phase="{phase}"' in text, text
 
 
 def test_profile_dir_produces_trace(tmp_path):
