@@ -238,6 +238,16 @@ def _split(solver) -> dict:
         "device_ms": round(t["device_ms"], 2),
         "host_ms": round(t["host_ms"], 2),
     }
+    ms = getattr(solver, "last_merge_stats", None)
+    if ms:
+        # cross-group merge observability (ISSUE 2): wall time of the
+        # merge pass plus the engine's screen/apply counters, so the
+        # BENCH trajectory can track the vectorized engine's win
+        out["merge_ms"] = round(float(ms.get("merge_ms", 0.0)), 2)
+        out["merge_candidates_screened"] = int(ms.get("merge_candidates_screened", 0))
+        out["merge_pairs_applied"] = int(ms.get("merge_pairs_applied", 0))
+        if ms.get("merge_engine"):
+            out["merge_engine"] = ms["merge_engine"]
     trace_id = t.get("trace_id")
     if trace_id:
         from karpenter_core_tpu.tracing import tracer as _tracer

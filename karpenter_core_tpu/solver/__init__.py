@@ -11,7 +11,10 @@ batched JAX pipeline:
    ``filterInstanceTypesByRequirements`` (nodeclaim.go:225).
 3. ``pack``: K-open-node first-fit-decreasing as a ``lax.scan``,
    vmapped over constraint-signature groups; cheapest-type assignment.
-4. ``solver``: the end-to-end TPUScheduler with CPU-oracle fallback for
+4. ``merge``: the bucketed, vectorized cross-group merge engine
+   (``KARPENTER_TPU_MERGE_ENGINE`` selects vector vs the scalar
+   reference loop; both are plan-identical by construction and test).
+5. ``solver``: the end-to-end TPUScheduler with CPU-oracle fallback for
    relational constraints (pod affinity) and parity metrics.
 """
 

@@ -1,0 +1,330 @@
+"""Bucketed, vectorized cross-group merge engine (ISSUE 2 tentpole).
+
+The scalar merge pass (`TPUScheduler._merge_scalar`) is a pure-Python
+O(N·K) pairwise first-fit loop: per candidate pair it runs a dozen small
+numpy ops, two fingerprint lookups, and a Requirements rebuild. PR 1's
+tracer attributed ~75% of config-2 host time to it. This module keeps
+the exact first-fit semantics (the scalar loop is the semantic twin of
+the Go oracle's shared-node behavior) but restructures the work:
+
+Phase 1 — bucket (host, `pack.merge.bucket`): records group by
+(encoding, pool) identity — the first checks the scalar loop makes —
+and each bucket precomputes stacked arrays: usage ``(N, R)``, seed
+``alloc_cap (N, R)``, bit-packed ``(N, ceil(T/8))`` screen masks
+(viable ∧ self-fits ∧ self-offering — each a *necessary* condition of
+the pair checks, see below), zone/capacity-type masks, zone-pin ids,
+and interned requirement fingerprints backed by a dense
+intersects matrix seeded from the solver's ``_intersects_cache`` —
+computed once per distinct fingerprint pair instead of per record pair.
+
+Phase 2 — screen + apply (`pack.merge.screen` / `pack.merge.apply`):
+records run in the global sorted order. Each record's full candidate
+row over its bucket's open clusters is computed in one broadcast:
+zone-pin agreement, nonempty zone/ct intersections, pinned-zone bit,
+bitwise-AND of the packed type masks, the combined-usage-vs-
+min(alloc_cap) reject, and the exact requirements-intersects lookup.
+Only the (typically tiny) surviving candidate list is walked in Python
+— in cluster-creation order, preserving first-fit — through
+``TPUScheduler._merge_pair_exact``, the same exact tail (combined-load
+fits against ``_alloc_full``, offering availability on the intersected
+masks, per-node hostname limits, Requirements union) the scalar engine
+uses, so the two engines cannot drift.
+
+Screen soundness: every vectorized reject is a necessary condition of
+the scalar accept. The packed per-record mask ANDs ``viable`` with
+"this record's own usage fits the type" and "the type has an available
+offering within this record's own zone/ct masks"; a cluster's mask is
+the AND over members. Combined usage ≥ each member's usage and the
+intersected zone/ct masks ⊆ each side's own, so any type passing the
+scalar's combined fits ∧ off_ok check sets the bit on every member and
+on the record — the AND is nonzero. The intersects lookup is *exact*
+(it is the scalar's own cached combined-fingerprint check, interned),
+so the apply tail skips it.
+
+Engine selection: ``KARPENTER_TPU_MERGE_ENGINE={vector,scalar}``
+(default vector; scalar is the escape hatch and the parity reference).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..tracing import tracer
+
+_ENGINES = ("vector", "scalar")
+
+# bucket-local fits precompute walks records in blocks so the
+# (block, T, R) broadcast stays small
+_FITS_BLOCK = 128
+
+
+def merge_engine() -> str:
+    """Active merge engine (env escape hatch; unknown values → vector)."""
+    eng = os.environ.get("KARPENTER_TPU_MERGE_ENGINE", "vector").strip().lower()
+    return eng if eng in _ENGINES else "vector"
+
+
+class _Bucket:
+    """One (encoding, pool) class: stacked per-record tensors plus the
+    live vectorized state of its open (screenable) merge clusters."""
+
+    __slots__ = (
+        "enc",
+        "Z",
+        "zone_index",
+        "usage",
+        "alloc_cap",
+        "zone_ok",
+        "ct_ok",
+        "zid",
+        "screen8",
+        "rec_fp",
+        "fp_ids",
+        "fps",
+        "fp_reqs",
+        "imat",
+        "k",
+        "cl_list",
+        "cl_usage",
+        "cl_alloc_cap",
+        "cl_zone_ok",
+        "cl_ct_ok",
+        "cl_zid",
+        "cl_screen8",
+        "cl_fp",
+    )
+
+    def __init__(self, solver, records: List[dict], idxs: List[int], scan_cap: int):
+        r0 = records[idxs[0]]
+        enc = r0["enc"]
+        self.enc = enc
+        T = len(enc.instance_types)
+        Z = len(enc.zones)
+        self.Z = Z
+        self.zone_index = {z: zi for zi, z in enumerate(enc.zones)}
+        N = len(idxs)
+        R = len(r0["usage"])
+
+        self.usage = np.empty((N, R), dtype=np.int64)
+        self.alloc_cap = np.empty((N, R), dtype=np.int64)
+        zone_ok = np.empty((N, Z), dtype=bool)
+        ct_ok = np.empty((N, len(enc.capacity_types)), dtype=bool)
+        self.zid = np.empty(N, dtype=np.int32)
+        viable = np.empty((N, T), dtype=bool)
+        for j, i in enumerate(idxs):
+            r = records[i]
+            self.usage[j] = r["usage"]
+            self.alloc_cap[j] = r["alloc_cap"]
+            zone_ok[j] = r["zone_ok"]
+            ct_ok[j] = r["ct_ok"]
+            viable[j] = r["viable"]
+            self.zid[j] = self.zone_index[r["zone"]] if r["zone"] is not None else -1
+        self.zone_ok = zone_ok
+        self.ct_ok = ct_ok
+
+        # self-fits: types holding each record's OWN usage — combined
+        # usage dominates every member's, so this is a sound screen bit
+        alloc = solver._alloc_full(enc, r0["daemon"])
+        fits = np.empty((N, T), dtype=bool)
+        for s in range(0, N, _FITS_BLOCK):
+            e = min(s + _FITS_BLOCK, N)
+            fits[s:e] = np.all(
+                self.usage[s:e, None, :] <= alloc[None, :, :], axis=-1
+            )
+
+        # self-offering: types with an available offering within the
+        # record's own zone/ct masks (zone-pin narrows to one zone);
+        # records of one pack job share masks, so combos dedupe hard
+        off = np.empty((N, T), dtype=bool)
+        combos: Dict[tuple, np.ndarray] = {}
+        avail = enc.offering_avail
+        for j in range(N):
+            if self.zid[j] >= 0:
+                zsel = np.zeros(Z, dtype=bool)
+                zsel[self.zid[j]] = True
+            else:
+                zsel = zone_ok[j]
+            ckey = (zsel.tobytes(), ct_ok[j].tobytes())
+            v = combos.get(ckey)
+            if v is None:
+                v = avail[:, zsel][:, :, ct_ok[j]].any(axis=(1, 2))
+                combos[ckey] = v
+            off[j] = v
+
+        self.screen8 = np.packbits(viable & fits & off, axis=1)
+
+        # requirement fingerprints interned per bucket; the intersects
+        # matrix is EXACT (the scalar's own check, memoized per distinct
+        # pair) and lazily filled, seeded from solver._intersects_cache
+        self.fp_ids: Dict[tuple, int] = {}
+        self.fps: List[tuple] = []
+        self.fp_reqs: List[object] = []
+        self.imat = np.full((16, 16), -1, dtype=np.int8)
+        self.rec_fp = np.empty(N, dtype=np.int32)
+        for j, i in enumerate(idxs):
+            merged = records[i]["merged"]
+            self.rec_fp[j] = (
+                -1 if merged is None else self._intern(merged.fingerprint(), merged)
+            )
+
+        # open-cluster state (only the globally screenable prefix)
+        cap = scan_cap
+        self.k = 0
+        self.cl_list: List[dict] = []
+        self.cl_usage = np.empty((cap, R), dtype=np.int64)
+        self.cl_alloc_cap = np.empty((cap, R), dtype=np.int64)
+        self.cl_zone_ok = np.empty((cap, Z), dtype=bool)
+        self.cl_ct_ok = np.empty((cap, ct_ok.shape[1]), dtype=bool)
+        self.cl_zid = np.empty(cap, dtype=np.int32)
+        self.cl_screen8 = np.empty((cap, self.screen8.shape[1]), dtype=np.uint8)
+        self.cl_fp = np.empty(cap, dtype=np.int32)
+
+    # -- fingerprint interning / exact intersects lookups ---------------
+
+    def _intern(self, fp: tuple, reqs) -> int:
+        fid = self.fp_ids.get(fp)
+        if fid is None:
+            fid = len(self.fps)
+            self.fp_ids[fp] = fid
+            self.fps.append(fp)
+            self.fp_reqs.append(reqs)
+            if fid >= self.imat.shape[0]:
+                grown = np.full((2 * fid, 2 * fid), -1, dtype=np.int8)
+                grown[: self.imat.shape[0], : self.imat.shape[1]] = self.imat
+                self.imat = grown
+        return fid
+
+    def _intersects_row(self, solver, cl_fp: np.ndarray, rid: int) -> np.ndarray:
+        """(len(cl_fp),) bool of exact Requirements.intersects verdicts
+        between each cluster fingerprint and the record's, via the dense
+        matrix; unknown pairs compute once and land in the matrix AND in
+        the solver's cross-engine ``_intersects_cache``."""
+        vals = self.imat[cl_fp, rid]
+        unknown = np.flatnonzero(vals < 0)
+        if unknown.size:
+            cache = solver._intersects_cache
+            fp_r, req_r = self.fps[rid], self.fp_reqs[rid]
+            for u in unknown:
+                aid = int(cl_fp[u])
+                key = (self.fps[aid], fp_r)
+                ok = cache.get(key)
+                if ok is None:
+                    ok = self.fp_reqs[aid].intersects(req_r) is None
+                    cache[key] = ok
+                    cache[(fp_r, self.fps[aid])] = ok
+                v = np.int8(1 if ok else 0)
+                self.imat[aid, rid] = v
+                self.imat[rid, aid] = v
+                vals[u] = v
+        return vals > 0
+
+    # -- cluster state ---------------------------------------------------
+
+    def add_cluster(self, m: dict, j: int) -> None:
+        """Track a fresh cluster (seeded from bucket-record j) in the
+        screenable window."""
+        k = self.k
+        self.cl_list.append(m)
+        self.cl_usage[k] = self.usage[j]
+        self.cl_alloc_cap[k] = self.alloc_cap[j]  # seed's — never updated,
+        # matching the scalar engine's cheap-reject exactly
+        self.cl_zone_ok[k] = self.zone_ok[j]
+        self.cl_ct_ok[k] = self.ct_ok[j]
+        self.cl_zid[k] = self.zid[j]
+        self.cl_screen8[k] = self.screen8[j]
+        self.cl_fp[k] = self.rec_fp[j]
+        self.k = k + 1
+
+    def absorb(self, k: int, j: int, m: dict) -> None:
+        """Fold record j into cluster row k after a successful exact
+        merge (m is the cluster dict _merge_pair_exact just updated)."""
+        self.cl_usage[k] += self.usage[j]
+        if self.cl_zid[k] < 0:
+            self.cl_zid[k] = self.zid[j]
+        self.cl_zone_ok[k] &= self.zone_ok[j]
+        self.cl_ct_ok[k] &= self.ct_ok[j]
+        self.cl_screen8[k] &= self.screen8[j]
+        merged = m["merged"]
+        self.cl_fp[k] = self._intern(merged.fingerprint(), merged)
+
+
+def merge_records_vector(
+    solver, records: List[dict], pods, scan_cap: int
+) -> List[dict]:
+    """Vectorized first-fit merge over pre-sorted records → the merged
+    cluster list (same order and contents as the scalar engine)."""
+    st = solver._merge_stats
+    merged: List[dict] = []
+
+    with tracer.span("pack.merge.bucket", records=len(records)):
+        by_key: Dict[tuple, List[int]] = {}
+        for i, r in enumerate(records):
+            # daemon rides in the key so every record of a bucket shares
+            # the _alloc_full table the fits screen precomputes against
+            # (one daemon vector per pool makes this a no-op split)
+            by_key.setdefault(
+                (id(r["enc"]), id(r["pool"]), len(r["usage"]), r["daemon"].tobytes()),
+                [],
+            ).append(i)
+        buckets: List[Optional[tuple]] = [None] * len(records)
+        for idxs in by_key.values():
+            b = _Bucket(solver, records, idxs, scan_cap)
+            for j, i in enumerate(idxs):
+                buckets[i] = (b, j)
+
+    screened = 0
+    applied = 0
+    with tracer.span("pack.merge.screen", records=len(records)):
+        for i, r in enumerate(records):
+            b, j = buckets[i]
+            placed = False
+            # clusters past the global scan cap are emit-only, exactly
+            # like the scalar engine's merged[:cap] window
+            K = b.k
+            if K and b.rec_fp[j] >= 0:
+                screened += K
+                rz = b.zid[j]
+                cand = (
+                    ((b.cl_zid[:K] == -1) | (rz == -1) | (b.cl_zid[:K] == rz))
+                    & (b.cl_fp[:K] >= 0)
+                )
+                zinter = b.cl_zone_ok[:K] & b.zone_ok[j][None, :]
+                cand &= zinter.any(axis=1)
+                cand &= (b.cl_ct_ok[:K] & b.ct_ok[j][None, :]).any(axis=1)
+                eff = np.where(b.cl_zid[:K] >= 0, b.cl_zid[:K], rz)
+                if b.Z and (eff >= 0).any():
+                    zbit = zinter[np.arange(K), np.clip(eff, 0, b.Z - 1)]
+                    cand &= (eff < 0) | zbit
+                cand &= ((b.cl_screen8[:K] & b.screen8[j][None, :]) != 0).any(axis=1)
+                cand &= np.all(
+                    b.cl_usage[:K] + b.usage[j][None, :]
+                    <= np.minimum(b.cl_alloc_cap[:K], b.alloc_cap[j][None, :]),
+                    axis=1,
+                )
+                rows = np.flatnonzero(cand)
+                if rows.size:
+                    ok = b._intersects_row(solver, b.cl_fp[rows], int(b.rec_fp[j]))
+                    rows = rows[ok]
+                if rows.size:
+                    with tracer.span("pack.merge.apply", candidates=int(rows.size)):
+                        for k in rows:
+                            m = b.cl_list[int(k)]
+                            if solver._merge_pair_exact(
+                                m, r, pods, skip_intersects=True
+                            ):
+                                b.absorb(int(k), j, m)
+                                applied += 1
+                                placed = True
+                                break
+            if not placed:
+                m = dict(r, members=list(r["members"]))
+                merged.append(m)
+                if len(merged) <= scan_cap:
+                    b.add_cluster(m, j)
+
+    st["merge_candidates_screened"] = st.get("merge_candidates_screened", 0) + screened
+    st["merge_pairs_applied"] = st.get("merge_pairs_applied", 0) + applied
+    return merged

@@ -49,17 +49,21 @@ def pareto_frontier(allocatable: np.ndarray) -> np.ndarray:
     pts = np.unique(allocatable, axis=0)  # unique also sorts — ties deduped
     # incremental scan sorted by total size desc: each point only needs a
     # dominance check against the (small) kept frontier, O(T·F·R) instead
-    # of the O(T²·R) pairwise broadcast
+    # of the O(T²·R) pairwise broadcast. The frontier lives in a doubling
+    # buffer — rebuilding the kept array per accepted point made the scan
+    # O(F²·R) in copies
     order = np.argsort(-pts.sum(axis=1, dtype=np.int64))
-    kept: list = []
-    kept_arr = np.zeros((0, pts.shape[1]), dtype=pts.dtype)
+    buf = np.empty((8, pts.shape[1]), dtype=pts.dtype)
+    n = 0
     for i in order:
         p = pts[i]
-        if len(kept) and bool(np.any(np.all(kept_arr >= p, axis=1))):
+        if n and bool(np.any(np.all(buf[:n] >= p, axis=1))):
             continue  # dominated (strictness guaranteed: duplicates removed)
-        kept.append(p)
-        kept_arr = np.asarray(kept)
-    return kept_arr.astype(np.int32)
+        if n == len(buf):
+            buf = np.concatenate([buf, np.empty_like(buf)])
+        buf[n] = p
+        n += 1
+    return buf[:n].astype(np.int32)
 
 
 @partial(jax.jit, static_argnames=("k_open",))
@@ -216,10 +220,18 @@ def assign_cheapest_types(
 
     if native.available() and node_usage.size and allocatable.size:
         return native.cheapest_types_native(node_usage, allocatable, prices)
-    fits = np.all(node_usage[:, None, :] <= allocatable[None, :, :], axis=-1)  # (N, T)
-    priced = np.where(fits, prices[None, :], np.inf)
-    best = np.argmin(priced, axis=1).astype(np.int32)
-    best[~fits.any(axis=1)] = -1
+    # numpy fallback chunks the node axis: the full (N, T, R) broadcast
+    # at consolidation-screen scale (5k nodes x 2k types x 6 resources)
+    # would materialize a ~120 MB transient
+    N = node_usage.shape[0]
+    best = np.empty(N, dtype=np.int32)
+    for s in range(0, max(N, 1), 1024):
+        blk = node_usage[s : s + 1024]
+        fits = np.all(blk[:, None, :] <= allocatable[None, :, :], axis=-1)  # (n, T)
+        priced = np.where(fits, prices[None, :], np.inf)
+        b = np.argmin(priced, axis=1).astype(np.int32)
+        b[~fits.any(axis=1)] = -1
+        best[s : s + 1024] = b
     return best
 
 

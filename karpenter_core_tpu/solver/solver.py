@@ -168,6 +168,22 @@ def _cache_put(enc: "EncodedInstanceTypes", key: tuple, value: np.ndarray) -> No
         enc.runtime_caches[key] = value
 
 
+def _offering_pmin(
+    enc: "EncodedInstanceTypes", zmask: np.ndarray, ct_ok: np.ndarray
+) -> np.ndarray:
+    """(T,) cheapest offering price per type within a (zone, capacity-
+    type) mask, cached on the encoding (offering_price is already inf
+    where no offering exists, so a plain min is the masked min)."""
+    key = ("pmin", zmask.tobytes(), ct_ok.tobytes())
+    cached = enc.runtime_caches.get(key)
+    if cached is None:
+        T = len(enc.instance_types)
+        prices = enc.offering_price[:, zmask][:, :, ct_ok].reshape(T, -1)
+        cached = prices.min(axis=1) if prices.size else np.full(T, np.inf)
+        _cache_put(enc, key, cached)
+    return cached
+
+
 def _requirements_fingerprint(reqs) -> tuple:
     """Canonical identity of a merged Requirements set (full algebra:
     operator polarity, values, Gt/Lt bounds) for class-merge equality.
@@ -438,6 +454,11 @@ class TPUScheduler:
         self.metrics = metrics
         # device/host wall-time split of the most recent solve
         self.last_timings: Optional[Dict[str, float]] = None
+        # cross-group merge observability: engine, merge_ms, and the
+        # screened/applied counters (reset per solve; bench.py reads
+        # last_merge_stats per config)
+        self._merge_stats: Dict[str, object] = {}
+        self.last_merge_stats: Optional[Dict[str, object]] = None
         # prep-time topology ledger state (rebuilt per tensor pass;
         # empty defaults keep direct sub-method calls in tests working)
         self._prep_zone_ledger: List[Tuple[int, str]] = []
@@ -497,6 +518,7 @@ class TPUScheduler:
                         int(device * 1e9),
                         note="sum of device_wait spans (dispatch+transfer+blocked)",
                     )
+                self.last_merge_stats = dict(self._merge_stats)
                 if self.metrics is not None:
                     self.metrics.solver_duration.observe(total)
                     self.metrics.solver_device_duration.observe(device)
@@ -508,6 +530,12 @@ class TPUScheduler:
         daemonset_pods: Optional[List[Pod]] = None,
     ) -> SolverResult:
         result = SolverResult()
+        self._merge_stats = {
+            "merge_ms": 0.0,
+            "merge_records": 0,
+            "merge_candidates_screened": 0,
+            "merge_pairs_applied": 0,
+        }
         from . import podcache
 
         with tracer.span("pod_memos"):
@@ -3240,59 +3268,75 @@ class TPUScheduler:
         sorted_ids = node_ids[valid][order]
         sorted_idx = idx[valid][order]
         bounds = np.searchsorted(sorted_ids, np.arange(node_count + 1))
+        # per-node routing decided in one vectorized pass (the old loop
+        # ran several small numpy ops per node): capped / limited groups
+        # merge too (r5) — the merge check enforces each side's per-node
+        # limits on the combined membership; only no_merge jobs (zone
+        # anti-affinity) stay out
+        usage64 = usage.astype(np.int64)
+        ok = chosen_types >= 0
+        if meta["no_merge"]:
+            to_record = np.zeros(node_count, dtype=bool)
+        elif merge_all:
+            to_record = ok.copy()
+        else:
+            to_record = ok & np.all(
+                usage64 * 2 <= alloc_cap.astype(np.int64)[None, :], axis=1
+            )
+        # one masked argmin over (N, Z, C) replaces a _cheapest_offering
+        # call per emitted node
+        plan_nodes = np.flatnonzero(ok & ~to_record)
+        if plan_nodes.size:
+            t_global = viable_idx[chosen_types[plan_nodes]]
+            off_zone, off_ct, off_price = self._cheapest_offering_batch(
+                enc, t_global, zone_ok, ct_ok, zone
+            )
+        # records of one job share every per-job array and list (the
+        # merge engines replace, never mutate, record entries)
+        job_limits = list(meta["per_node_limits"])
+        max_per_node = meta["max_per_node"]
+        pi = 0
         for n in range(node_count):
-            ti = chosen_types[n]
             members = sorted_idx[bounds[n] : bounds[n + 1]].tolist()
-            if ti < 0:
+            if not ok[n]:
                 for i in members:
                     result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
                 continue
-            # capped / limited groups merge too (r5): the oracle shares
-            # nodes across independent hostname-spread groups freely —
-            # the merge check enforces each side's per-node limits on
-            # the combined membership. Only no_merge jobs (zone
-            # anti-affinity) stay out.
-            mergeable = not meta["no_merge"]
-            if mergeable and (
-                merge_all or np.all(usage[n].astype(np.int64) * 2 <= alloc_cap)
-            ):
+            if to_record[n]:
                 records.append(
                     dict(
                         enc=enc,
                         pool=pool,
                         zone=zone,
-                        zone_ok=zone_ok.copy(),
-                        ct_ok=ct_ok.copy(),
+                        zone_ok=zone_ok,
+                        ct_ok=ct_ok,
                         viable=viable_bool,
-                        usage=usage[n].astype(np.int64),
+                        usage=usage64[n],
                         members=members,
                         daemon=meta["daemon"],
                         alloc_cap=alloc_cap,
                         merged=meta["merged"],
-                        max_per_node=meta["max_per_node"],
-                        limits=list(meta["per_node_limits"]),
+                        max_per_node=max_per_node,
+                        limits=job_limits,
                     )
                 )
                 continue
-            it = enc.instance_types[int(viable_idx[ti])]
-            # concrete offering: cheapest allowed for that type (zone-pinned)
-            offering_zone, offering_ct, offering_price = self._cheapest_offering(
-                enc, int(viable_idx[ti]), zone_ok, ct_ok, zone
-            )
+            t = int(t_global[pi])
             result.node_plans.append(
                 NodePlan(
                     nodepool_name=pool.nodepool.name,
-                    instance_type=it,
-                    zone=offering_zone,
-                    capacity_type=offering_ct,
-                    price=offering_price,
+                    instance_type=enc.instance_types[t],
+                    zone=off_zone[pi],
+                    capacity_type=off_ct[pi],
+                    price=float(off_price[pi]),
                     pod_indices=members,
                     requirements=meta["merged"],
-                    max_pods_per_node=int(meta["max_per_node"]),
-                    node_limits=list(meta["per_node_limits"]),
+                    max_pods_per_node=int(max_per_node),
+                    node_limits=list(job_limits),
                     _pod_requests=[self._all_requests[i] for i in members],
                 )
             )
+            pi += 1
 
     # ------------------------------------------------------------------
 
@@ -3321,14 +3365,46 @@ class TPUScheduler:
         their zone pins agree (pods never change zones, so topology-
         spread counts are untouched), the intersected zone/capacity-type
         masks stay nonempty, and some commonly-viable instance type
-        holds the combined load with an available offering."""
+        holds the combined load with an available offering.
+
+        Dispatches to the bucketed vector engine (merge.py) unless
+        KARPENTER_TPU_MERGE_ENGINE=scalar; both engines share
+        ``_merge_pair_exact`` and produce identical merged clusters."""
         if not records:
             return
+        import time as _time
+
+        from . import merge as merge_mod
+
+        t0 = _time.perf_counter()
         records.sort(key=lambda r: -int(r["usage"][0]))
+        engine = merge_mod.merge_engine()
+        if engine == "vector":
+            merged = merge_mod.merge_records_vector(
+                self, records, pods, self._MERGE_SCAN_CAP
+            )
+        else:
+            merged = self._merge_scalar(records, pods)
+        with tracer.span("pack.merge.emit", plans=len(merged)):
+            for m in merged:
+                self._emit_record(m, pods, result)
+        st = self._merge_stats
+        st["merge_engine"] = engine
+        st["merge_records"] = st.get("merge_records", 0) + len(records)
+        st["merge_ms"] = st.get("merge_ms", 0.0) + (_time.perf_counter() - t0) * 1000.0
+
+    def _merge_scalar(self, records: List[dict], pods: List[Pod]) -> List[dict]:
+        """Reference merge engine: the pure-Python pairwise first-fit
+        loop over pre-sorted records. Kept as the escape hatch and the
+        parity oracle for the vector engine (merge.py)."""
+        st = self._merge_stats
+        screened = 0
+        applied = 0
         merged: List[dict] = []
         for r in records:
             placed = False
             for m in merged[: self._MERGE_SCAN_CAP]:
+                screened += 1
                 if m["enc"] is not r["enc"] or m["pool"] is not r["pool"]:
                     continue
                 if m["zone"] is not None and r["zone"] is not None and m["zone"] != r["zone"]:
@@ -3349,75 +3425,124 @@ class TPUScheduler:
                 # vs team=b pods can never share a node)
                 if m["merged"] is None or r["merged"] is None:
                     continue
-                ikey = (m["merged"].fingerprint(), r["merged"].fingerprint())
-                compat_ok = self._intersects_cache.get(ikey)
-                if compat_ok is None:
-                    compat_ok = m["merged"].intersects(r["merged"]) is None
-                    self._intersects_cache[ikey] = compat_ok
-                if not compat_ok:
-                    continue
-                usage = m["usage"] + r["usage"]
                 # cheap reject: combined load exceeds even the elementwise
                 # max of both sides' viable capacities
-                if np.any(usage > np.minimum(m["alloc_cap"], r["alloc_cap"])):
+                if np.any(
+                    m["usage"] + r["usage"] > np.minimum(m["alloc_cap"], r["alloc_cap"])
+                ):
                     continue
-                alloc = self._alloc_full(enc, r["daemon"])
-                fits = viable & np.all(usage[None, :] <= alloc, axis=1)
-                if not fits.any():
-                    continue
-                zmask = zone_ok
-                if zone is not None:
-                    zmask = np.zeros(len(enc.zones), dtype=bool)
-                    zmask[enc.zones.index(zone)] = True
-                off_ok = enc.offering_avail[:, zmask][:, :, ct_ok].any(axis=(1, 2))
-                if not (fits & off_ok).any():
-                    continue
-                limits = m.get("limits", []) + r.get("limits", [])
-                if limits:
-                    # every hostname-level constraint of either side must
-                    # hold on the merged membership (the oracle's per-node
-                    # count check at placement time); per-side counts are
-                    # cached so mega-memberships aren't rescanned per pair
-                    ok = True
-                    for sel, ns, cap in limits:
-                        count = self._record_limit_count(
-                            m, sel, ns, pods
-                        ) + self._record_limit_count(r, sel, ns, pods)
-                        if count > cap:
-                            ok = False
-                            break
-                    if not ok:
-                        continue
-                combined = Requirements(*m["merged"].values_list())
-                combined.add(*r["merged"].values_list())
-                m.update(
-                    usage=usage,
-                    zone=zone,
-                    zone_ok=zone_ok,
-                    ct_ok=ct_ok,
-                    viable=viable,
-                    merged=combined,
-                    limits=limits,
-                    max_per_node=min(
-                        m.get("max_per_node", 2**31 - 1),
-                        r.get("max_per_node", 2**31 - 1),
-                    ),
-                )
-                m["members"].extend(r["members"])
-                # merge the per-selector count caches additively: keys
-                # cached on BOTH sides stay exact (counts are disjoint
-                # membership sums); one-sided keys recompute lazily
-                m_cache = m.get("_limit_counts") or {}
-                r_cache = r.get("_limit_counts") or {}
-                m["_limit_counts"] = {
-                    k: m_cache[k] + r_cache[k] for k in m_cache.keys() & r_cache.keys()
-                }
-                placed = True
-                break
+                if self._merge_pair_exact(
+                    m, r, pods, zone=zone, zone_ok=zone_ok, ct_ok=ct_ok, viable=viable
+                ):
+                    applied += 1
+                    placed = True
+                    break
             if not placed:
                 merged.append(dict(r, members=list(r["members"])))
-        for m in merged:
-            self._emit_record(m, pods, result)
+        st["merge_candidates_screened"] = st.get("merge_candidates_screened", 0) + screened
+        st["merge_pairs_applied"] = st.get("merge_pairs_applied", 0) + applied
+        return merged
+
+    def _merge_pair_exact(
+        self,
+        m: dict,
+        r: dict,
+        pods: List[Pod],
+        skip_intersects: bool = False,
+        zone=None,
+        zone_ok=None,
+        ct_ok=None,
+        viable=None,
+    ) -> bool:
+        """Exact tail of one merge-pair check — requirement-set
+        intersection, combined-load fits, offering availability on the
+        intersected masks, hostname-level limits — then the apply
+        (Requirements union, cache carry-over, membership join).
+        Shared by the scalar and vector engines so their accept/apply
+        semantics cannot drift. Mutates ``m`` and returns True when
+        ``r`` was absorbed. Callers have already verified: same
+        enc/pool, zone pins agree, intersected zone/ct/viable masks
+        nonempty, both merged sets present, and the alloc_cap cheap
+        reject. The vector engine's screen resolves intersects exactly
+        (interned fingerprint matrix) and passes skip_intersects."""
+        enc = r["enc"]
+        if zone is None:
+            zone = m["zone"] if m["zone"] is not None else r["zone"]
+        if zone_ok is None:
+            zone_ok = m["zone_ok"] & r["zone_ok"]
+        if ct_ok is None:
+            ct_ok = m["ct_ok"] & r["ct_ok"]
+        if viable is None:
+            viable = m["viable"] & r["viable"]
+        if not skip_intersects:
+            ikey = (m["merged"].fingerprint(), r["merged"].fingerprint())
+            compat_ok = self._intersects_cache.get(ikey)
+            if compat_ok is None:
+                compat_ok = m["merged"].intersects(r["merged"]) is None
+                self._intersects_cache[ikey] = compat_ok
+            if not compat_ok:
+                return False
+        usage = m["usage"] + r["usage"]
+        alloc = self._alloc_full(enc, r["daemon"])
+        fits = viable & np.all(usage[None, :] <= alloc, axis=1)
+        if not fits.any():
+            return False
+        zmask = zone_ok
+        if zone is not None:
+            zmask = np.zeros(len(enc.zones), dtype=bool)
+            zmask[enc.zones.index(zone)] = True
+        off_ok = enc.offering_avail[:, zmask][:, :, ct_ok].any(axis=(1, 2))
+        if not (fits & off_ok).any():
+            return False
+        limits = m.get("limits", []) + r.get("limits", [])
+        if limits:
+            # every hostname-level constraint of either side must
+            # hold on the merged membership (the oracle's per-node
+            # count check at placement time); per-side counts are
+            # cached so mega-memberships aren't rescanned per pair
+            for sel, ns, cap in limits:
+                count = self._record_limit_count(
+                    m, sel, ns, pods
+                ) + self._record_limit_count(r, sel, ns, pods)
+                if count > cap:
+                    return False
+        combined = Requirements(*m["merged"].values_list())
+        combined.add(*r["merged"].values_list())
+        # merge the per-selector count caches additively BEFORE the
+        # memberships join: keys cached on BOTH sides stay exact (counts
+        # are disjoint membership sums); one-sided keys are completed by
+        # computing the missing side now — while the sides are still
+        # separate — so mega-merges never rescan O(members) later (the
+        # sel objects needed ride in _limit_sels)
+        m_cache = m.get("_limit_counts") or {}
+        r_cache = r.get("_limit_counts") or {}
+        shared = m_cache.keys() & r_cache.keys()
+        counts = {k: m_cache[k] + r_cache[k] for k in shared}
+        if limits:
+            sels = {**(r.get("_limit_sels") or {}), **(m.get("_limit_sels") or {})}
+            for k in (m_cache.keys() | r_cache.keys()) - shared:
+                if k not in sels:
+                    continue
+                counts[k] = self._record_limit_count(
+                    m, sels[k], k[1], pods
+                ) + self._record_limit_count(r, sels[k], k[1], pods)
+            m["_limit_sels"] = sels
+        m.update(
+            usage=usage,
+            zone=zone,
+            zone_ok=zone_ok,
+            ct_ok=ct_ok,
+            viable=viable,
+            merged=combined,
+            limits=limits,
+            max_per_node=min(
+                m.get("max_per_node", 2**31 - 1),
+                r.get("max_per_node", 2**31 - 1),
+            ),
+        )
+        m["members"].extend(r["members"])
+        m["_limit_counts"] = counts
+        return True
 
     def _record_limit_count(self, record: dict, sel, ns: str, pods: List[Pod]) -> int:
         cache = record.setdefault("_limit_counts", {})
@@ -3430,6 +3555,9 @@ class TPUScheduler:
                 if pods[i].namespace == ns and self._sel_matches(sel, i, pods)
             )
             cache[key] = count
+            # the sel object rides along so a future merge can complete
+            # a one-sided cache entry without the caller re-supplying it
+            record.setdefault("_limit_sels", {})[key] = sel
         return count
 
     def _emit_record(self, m: dict, pods: List[Pod], result: SolverResult) -> None:
@@ -3441,13 +3569,10 @@ class TPUScheduler:
         if zone is not None:
             zmask = np.zeros(len(enc.zones), dtype=bool)
             zmask[enc.zones.index(zone)] = True
-        prices = enc.offering_price[:, zmask][:, :, ct_ok].reshape(len(fits), -1)
-        p = (
-            np.where(np.isfinite(prices), prices, np.inf).min(axis=1)
-            if prices.size
-            else np.full(len(fits), np.inf)
-        )
-        p = np.where(fits, p, np.inf)
+        # per-type cheapest price within the (zone, ct) mask comes from a
+        # table cached on the encoding — merged records share few
+        # distinct masks, so emit stops re-reducing (T, Z, C) per record
+        p = np.where(fits, _offering_pmin(enc, zmask, ct_ok), np.inf)
         t = int(np.argmin(p))
         if not np.isfinite(p[t]):
             for i in m["members"]:
@@ -3488,3 +3613,29 @@ class TPUScheduler:
         masked = np.where(mask, prices, np.inf)
         zi, ci = np.unravel_index(np.argmin(masked), masked.shape)
         return enc.zones[zi], enc.capacity_types[ci], float(masked[zi, ci])
+
+    @staticmethod
+    def _cheapest_offering_batch(
+        enc: EncodedInstanceTypes,
+        types: np.ndarray,
+        zone_ok: np.ndarray,
+        ct_ok: np.ndarray,
+        zone: Optional[str],
+    ) -> Tuple[List[str], List[str], np.ndarray]:
+        """_cheapest_offering over many nodes' chosen types at once: one
+        masked argmin over (N, Z, C). Row-major argmin + unravel matches
+        the scalar's tie-breaking exactly."""
+        prices = enc.offering_price[types]  # (N, Z, C)
+        mask = np.isfinite(prices) & ct_ok[None, None, :] & zone_ok[None, :, None]
+        if zone is not None:
+            zmask = np.zeros(len(enc.zones), dtype=bool)
+            zmask[enc.zones.index(zone)] = True
+            mask = mask & zmask[None, :, None]
+        masked = np.where(mask, prices, np.inf).reshape(len(types), -1)
+        flat = np.argmin(masked, axis=1)
+        zi, ci = np.unravel_index(flat, prices.shape[1:])
+        return (
+            [enc.zones[z] for z in zi],
+            [enc.capacity_types[c] for c in ci],
+            masked[np.arange(len(types)), flat],
+        )

@@ -69,6 +69,17 @@ def main():
         res = solver.solve(pods)
         print(f"warm: {(time.perf_counter()-t0)*1000:.1f} ms "
               f"({res.pods_scheduled} pods, {res.node_count} nodes)", file=sys.stderr)
+    ms = solver.last_merge_stats or {}
+    print(
+        "merge: engine={} {:.1f} ms, {} records, {} screened, {} applied".format(
+            ms.get("merge_engine", "-"),
+            ms.get("merge_ms", 0.0),
+            ms.get("merge_records", 0),
+            ms.get("merge_candidates_screened", 0),
+            ms.get("merge_pairs_applied", 0),
+        ),
+        file=sys.stderr,
+    )
 
     pr = cProfile.Profile()
     pr.enable()

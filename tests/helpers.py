@@ -257,3 +257,99 @@ class Env:
 
 def running_pod(cpu="100m", labels=None):
     return make_pod(requests={"cpu": cpu}, labels=labels, pending_unschedulable=False)
+
+
+# ---------------------------------------------------------------------------
+# merge-pass harness (tests/test_merge_semantics.py, test_merge_bench_smoke.py)
+
+
+def merge_env(n_types: int = 12):
+    """A (solver, enc, pool, axis) quad wired for direct _merge_and_emit
+    calls: real encoded catalog, a PoolEncoding, and the per-solve caches
+    the merge pass reads initialized."""
+    import numpy as np
+
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.scheduling import Requirements, Taints
+    from karpenter_core_tpu.solver import TPUScheduler
+    from karpenter_core_tpu.solver.encode import (
+        PoolEncoding,
+        build_catalog_axis,
+        encode_instance_types,
+    )
+    from karpenter_core_tpu.solver.vocab import Vocab
+
+    cat = instance_types(n_types)
+    axis = build_catalog_axis(cat)
+    enc = encode_instance_types(cat, axis, Vocab())
+    provider = FakeCloudProvider()
+    provider.instance_types = cat
+    solver = TPUScheduler([make_nodepool()], provider)
+    # per-solve state normally installed by _solve()
+    solver._intersects_cache = {}
+    solver._match_cache = {}
+    solver._all_requests = []
+    pool = PoolEncoding(make_nodepool(), Requirements(), Taints([]))
+    return solver, enc, pool, axis
+
+
+_MERGE_DEFAULT_REQS = object()  # sentinel: merged=None is meaningful (inert)
+
+
+def make_merge_record(
+    solver,
+    enc,
+    pool,
+    usage,
+    members,
+    zone: Optional[str] = None,
+    viable=None,
+    zone_ok=None,
+    ct_ok=None,
+    merged=_MERGE_DEFAULT_REQS,
+    max_per_node: int = 2**31 - 1,
+    limits=(),
+):
+    """One merge-pass record of the shape _finalize_job emits."""
+    import numpy as np
+
+    from karpenter_core_tpu.scheduling import Requirements
+
+    T = len(enc.instance_types)
+    R = enc.allocatable.shape[1]
+    daemon = np.zeros(R, dtype=np.int32)
+    viable = np.ones(T, dtype=bool) if viable is None else np.asarray(viable, bool)
+    alloc = solver._alloc_full(enc, daemon)[viable]
+    alloc_cap = (
+        alloc.max(axis=0) if len(alloc) else np.zeros(R, dtype=np.int64)
+    ).astype(np.int64)
+    return dict(
+        enc=enc,
+        pool=pool,
+        zone=zone,
+        zone_ok=np.ones(len(enc.zones), bool) if zone_ok is None else np.asarray(zone_ok, bool),
+        ct_ok=np.ones(len(enc.capacity_types), bool) if ct_ok is None else np.asarray(ct_ok, bool),
+        viable=viable,
+        usage=np.asarray(usage, dtype=np.int64),
+        members=list(members),
+        daemon=daemon,
+        alloc_cap=alloc_cap,
+        merged=Requirements() if merged is _MERGE_DEFAULT_REQS else merged,
+        max_per_node=max_per_node,
+        limits=list(limits),
+    )
+
+
+def plan_key(plan) -> tuple:
+    """Canonical comparable identity of a NodePlan for engine parity."""
+    return (
+        plan.nodepool_name,
+        plan.instance_type.name,
+        plan.zone,
+        plan.capacity_type,
+        round(plan.price, 9),
+        tuple(plan.pod_indices),
+        plan.max_pods_per_node,
+        len(plan.node_limits),
+        plan.requirements.fingerprint() if plan.requirements is not None else None,
+    )

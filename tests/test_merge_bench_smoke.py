@@ -1,0 +1,75 @@
+"""Tier-1 canary for the vectorized merge engine (ISSUE 2): a 500-record
+merge runs under both engines; the test fails if the vector engine's
+plan count exceeds 3x the scalar engine's or the emitted plans diverge
+— a cheap guard against silent semantic drift between the engines."""
+
+import numpy as np
+
+from helpers import make_merge_record, make_pod, merge_env, plan_key
+from karpenter_core_tpu.kube.objects import OP_IN
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+from karpenter_core_tpu.solver.solver import SolverResult
+
+
+def _bench_records(solver, enc, pool, rng, n=500):
+    """Bench-shaped record stream: a few distinct job profiles (shared
+    masks/requirements), sizes spread so merges happen but not all
+    records collapse into one node."""
+    T = len(enc.instance_types)
+    Z = len(enc.zones)
+    R = enc.allocatable.shape[1]
+    cap = enc.allocatable.max(axis=0).astype(np.int64)
+    profiles = []
+    for p in range(6):
+        viable = rng.rand(T) < 0.8
+        if not viable.any():
+            viable[rng.randint(T)] = True
+        merged = (
+            Requirements()
+            if p % 3 == 0
+            else Requirements(Requirement("team", OP_IN, ["a" if p % 2 else "b"]))
+        )
+        zone = enc.zones[rng.randint(Z)] if p % 3 == 2 else None
+        profiles.append((viable, merged, zone))
+    records = []
+    for i in range(n):
+        viable, merged, zone = profiles[rng.randint(len(profiles))]
+        frac = rng.uniform(0.05, 0.45)
+        usage = np.maximum((cap * frac).astype(np.int64), 1)[:R]
+        records.append(
+            make_merge_record(
+                solver, enc, pool, usage, [i],
+                zone=zone, viable=viable.copy(), merged=merged,
+            )
+        )
+    return records
+
+
+def _run(engine, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_MERGE_ENGINE", engine)
+    solver, enc, pool, _ = merge_env()
+    rng = np.random.RandomState(99)
+    records = _bench_records(solver, enc, pool, rng)
+    pods = [make_pod() for _ in range(len(records))]
+    solver._all_requests = [{"cpu": 1}] * len(records)
+    result = SolverResult()
+    solver._merge_and_emit(records, pods, result)
+    return result, solver._merge_stats
+
+
+def test_vector_vs_scalar_500_record_smoke(monkeypatch):
+    vec, vec_st = _run("vector", monkeypatch)
+    sca, sca_st = _run("scalar", monkeypatch)
+    assert sca.node_plans, "smoke harness emitted no plans"
+    # hard parity: same ordered plan list (the stronger form of the
+    # "diverges in parity" canary)
+    assert [plan_key(p) for p in vec.node_plans] == [
+        plan_key(p) for p in sca.node_plans
+    ]
+    # and the explicit 3x plan-count ceiling the issue asks for, so a
+    # future relaxation of exact parity still has a floor
+    assert len(vec.node_plans) <= 3 * len(sca.node_plans)
+    assert vec_st["merge_pairs_applied"] == sca_st["merge_pairs_applied"] > 0
+    # every record is accounted for exactly once across the plans
+    members = sorted(i for p in vec.node_plans for i in p.pod_indices)
+    assert members == list(range(500))
