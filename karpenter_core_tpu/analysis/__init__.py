@@ -1,0 +1,26 @@
+"""Repo-native static analysis (ISSUE 3).
+
+Four AST rule families guard the invariants the test suite can only
+catch by luck — lock discipline in the controller state, host<->device
+sync boundaries in the solver hot path, tracer safety inside jit/vmap,
+and general hygiene — plus an eval_shape-backed shape-contract verifier
+for the solver's tensor functions.
+
+Run ``python -m karpenter_core_tpu.analysis`` (AST rules, stdlib-only)
+or ``--contracts`` (adds the jax.eval_shape pass). The tier-1 gate is
+``tests/test_static_analysis.py``. Rule catalog: ``RULES.md`` next to
+this file; per-line suppression is ``# analysis: allow-<rule>``;
+grandfathered findings live in ``baseline.json``.
+"""
+
+from .engine import (  # noqa: F401
+    AnalysisConfig,
+    DEFAULT_CONFIG,
+    Report,
+    analyze_paths,
+    analyze_repo,
+    default_baseline_path,
+    registered_rules,
+    repo_root,
+)
+from .findings import Baseline, Finding, SEV_ERROR, SEV_WARNING  # noqa: F401

@@ -1,0 +1,124 @@
+"""CLI: ``python -m karpenter_core_tpu.analysis``.
+
+Exit 0 when the repo is clean (every finding fixed, suppressed with a
+marker, or baselined — and no stale baseline entries); 1 otherwise.
+``--format json`` emits machine-readable findings for CI tooling, like
+``profile_solve.py`` does for perf.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import (
+    analyze_paths,
+    default_baseline_path,
+    registered_rules,
+    repo_root,
+)
+from .findings import Baseline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_core_tpu.analysis",
+        description="Repo-native static analysis: lock discipline, host-sync "
+        "boundaries, tracer safety, hygiene, shape contracts.",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to scan (default: the karpenter_core_tpu package)",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: the checked-in analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="report grandfathered findings too"
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit",
+    )
+    parser.add_argument(
+        "--rules", default=None, help="comma-separated rule subset (see --list-rules)"
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--contracts",
+        action="store_true",
+        help="also verify @contract shape declarations via jax.eval_shape",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, desc in registered_rules().items():
+            print(f"{name}: {desc}")
+        return 0
+
+    root = repo_root()
+    paths = args.paths or [os.path.join(root, "karpenter_core_tpu")]
+    rules = args.rules.split(",") if args.rules else None
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = None if args.no_baseline else Baseline.load(baseline_path)
+
+    report = analyze_paths(paths, root=root, baseline=baseline, rules=rules)
+
+    if args.write_baseline:
+        merged = report.findings + report.baselined
+        Baseline.from_findings(merged).save(baseline_path)
+        print(f"baseline: {len(merged)} findings -> {baseline_path}")
+        return 0
+
+    contract_results = []
+    contracts_ok = True
+    if args.contracts:
+        # pin the platform before jax loads: a dead TPU plugin must cost
+        # nothing here (same rationale as solver/backend.py)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from .shape_contracts import verify_contracts
+
+        contract_results = verify_contracts()
+        contracts_ok = all(r.ok for r in contract_results)
+
+    if args.format == "json":
+        payload = report.to_dict()
+        if args.contracts:
+            payload["contracts"] = [
+                {"name": r.name, "ok": r.ok, "checked": r.checked, "detail": r.detail}
+                for r in contract_results
+            ]
+            payload["ok"] = payload["ok"] and contracts_ok
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+    else:
+        for f in report.findings:
+            print(f.format())
+        for e in report.stale_baseline:
+            print(
+                f"STALE baseline entry (fixed? run --write-baseline): "
+                f"{e['path']}: {e['rule']}: {e['message']}"
+            )
+        for e in report.parse_errors:
+            print(f"PARSE ERROR: {e}")
+        for r in contract_results:
+            status = "ok" if r.ok else "FAIL"
+            mode = "eval_shape" if r.checked else "runtime-only"
+            print(f"contract {r.name}: {status} [{mode}] {r.detail}")
+        print(
+            f"{report.files_scanned} files; {len(report.findings)} findings, "
+            f"{len(report.suppressed)} suppressed, {len(report.baselined)} baselined"
+            + (f", {len(contract_results)} contracts" if args.contracts else "")
+        )
+    return 0 if (report.ok and contracts_ok) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,326 @@
+"""Rule engine: walks files, parses once, runs registered rules, applies
+suppressions and the baseline. stdlib ``ast`` only — the default run
+never imports jax (shape-contract verification is a separate mode, see
+``shape_contracts.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .findings import Baseline, Finding, is_suppressed, split_by_baseline
+
+# ---------------------------------------------------------------------------
+# configuration
+
+
+@dataclass(frozen=True)
+class AnalysisConfig:
+    """Repo-specific knowledge the rules key off. Paths are repo-relative
+    posix suffixes/prefixes."""
+
+    # modules on the solve hot path where any host<->device sync is a
+    # latency bug unless explicitly annotated (ISSUE 3: an accidental
+    # np.asarray in a hot loop re-introduces per-pod host serialization)
+    device_hot_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/pack.py",
+        "karpenter_core_tpu/solver/sharding.py",
+        "karpenter_core_tpu/solver/backend.py",
+        "karpenter_core_tpu/solver/kernels.py",
+        "karpenter_core_tpu/solver/pallas_kernels.py",
+    )
+    # control-plane packages that must never import jax: a stray jnp op
+    # in a controller thread would initialize the backend (and possibly
+    # block on a dead TPU plugin) outside the solver's probe/fallback
+    host_only_prefixes: Tuple[str, ...] = (
+        "karpenter_core_tpu/state/",
+        "karpenter_core_tpu/metrics/",
+        "karpenter_core_tpu/operator/",
+        "karpenter_core_tpu/kube/",
+        "karpenter_core_tpu/apis/",
+        "karpenter_core_tpu/events/",
+        "karpenter_core_tpu/scheduling/",
+        "karpenter_core_tpu/scheduler/",
+        "karpenter_core_tpu/provisioning/",
+        "karpenter_core_tpu/lifecycle/",
+        "karpenter_core_tpu/utils/",
+        "karpenter_core_tpu/cloudprovider/",
+        "karpenter_core_tpu/tracing/",
+    )
+    # cross-module device-array-returning functions (jit-decorated
+    # functions in the SAME module are detected automatically)
+    device_producers: Tuple[str, ...] = (
+        "sharded_batch_pack",
+        "sharded_prefix_screen",
+        "sharded_compat",
+        "allowed_sharded",
+        "device_put",
+        "compat_pallas",
+        "allowed_pallas",
+        "ffd_pack",
+        "ffd_pack_batched",
+        "pack_existing",
+        "compat_kernel",
+        "offering_kernel",
+        "allowed_kernel",
+        "prefix_screen_kernel",
+        "single_screen_kernel",
+    )
+
+
+DEFAULT_CONFIG = AnalysisConfig()
+
+
+@dataclass
+class FileContext:
+    relpath: str  # repo-relative posix path
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    config: AnalysisConfig
+
+    def is_device_hot(self) -> bool:
+        return any(self.relpath.endswith(m) for m in self.config.device_hot_modules)
+
+    def is_host_only(self) -> bool:
+        return any(self.relpath.startswith(p) for p in self.config.host_only_prefixes)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+RuleFn = Callable[[FileContext], Iterable[Finding]]
+
+_RULES: Dict[str, Tuple[RuleFn, str]] = {}
+
+
+def rule(name: str, description: str):
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES[name] = (fn, description)
+        return fn
+
+    return deco
+
+
+def registered_rules() -> Dict[str, str]:
+    _load_rules()
+    return {name: desc for name, (_, desc) in sorted(_RULES.items())}
+
+
+_LOADED = False
+
+
+def _load_rules() -> None:
+    global _LOADED
+    if not _LOADED:
+        from . import hygiene, hostsync, locks, tracersafety  # noqa: F401
+
+        _LOADED = True
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several rules)
+
+
+def qualify(tree: ast.Module) -> Dict[ast.AST, str]:
+    """node → enclosing 'Class.method' / 'function' symbol map."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, sym: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_sym = sym
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                child_sym = f"{sym}.{child.name}" if sym else child.name
+            out[child] = child_sym
+            walk(child, child_sym)
+
+    walk(tree, "")
+    return out
+
+
+def symbol_at(tree: ast.Module, node: ast.AST, cache: dict) -> str:
+    if "qual" not in cache:
+        cache["qual"] = qualify(tree)
+    return cache["qual"].get(node, "")
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def jit_decoration(fn: ast.AST) -> Optional[dict]:
+    """If ``fn`` is decorated with jax.jit / jax.vmap (bare or via
+    functools.partial), return {'kind', 'static_names', 'static_nums'};
+    else None."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        inner = None
+        if name.endswith("partial") and isinstance(dec, ast.Call) and dec.args:
+            inner = dec.args[0]
+            iname = dotted_name(inner)
+            if iname in ("jax.jit", "jit", "jax.vmap", "vmap"):
+                info = {"kind": iname.split(".")[-1], "static_names": [], "static_nums": []}
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        info["static_names"] = _const_strings(kw.value)
+                    elif kw.arg == "static_argnums":
+                        info["static_nums"] = _const_ints(kw.value)
+                return info
+        elif name in ("jax.jit", "jit", "jax.vmap", "vmap"):
+            info = {"kind": name.split(".")[-1], "static_names": [], "static_nums": []}
+            if isinstance(dec, ast.Call):
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        info["static_names"] = _const_strings(kw.value)
+                    elif kw.arg == "static_argnums":
+                        info["static_nums"] = _const_ints(kw.value)
+            return info
+    return None
+
+
+def _const_strings(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)  # active (gate fails)
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_baseline and not self.parse_errors
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "stale_baseline": self.stale_baseline,
+            "parse_errors": self.parse_errors,
+        }
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    config: Optional[AnalysisConfig] = None,
+    baseline: Optional[Baseline] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> Report:
+    """Run the rule set over ``paths`` (files or directories)."""
+    _load_rules()
+    config = config or DEFAULT_CONFIG
+    if root is None:
+        root = os.getcwd()
+    selected = {
+        name: fn for name, (fn, _) in _RULES.items() if rules is None or name in rules
+    }
+    report = Report()
+    raw: List[Finding] = []
+    for path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            report.parse_errors.append(f"{rel}: {e}")
+            continue
+        ctx = FileContext(rel, source, source.splitlines(), tree, config)
+        report.files_scanned += 1
+        for fn in selected.values():
+            for finding in fn(ctx):
+                if is_suppressed(finding, ctx.lines):
+                    report.suppressed.append(finding)
+                else:
+                    raw.append(finding)
+    active, baselined, stale = split_by_baseline(raw, baseline)
+    report.findings = sorted(active, key=lambda f: (f.path, f.line, f.rule))
+    report.baselined = baselined
+    report.stale_baseline = stale
+    return report
+
+
+def repo_root() -> str:
+    """The repo checkout containing this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def analyze_repo(
+    baseline_path: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    use_baseline: bool = True,
+) -> Report:
+    """The gate entrypoint: scan the package with the checked-in
+    baseline."""
+    root = repo_root()
+    pkg = os.path.join(root, "karpenter_core_tpu")
+    baseline = None
+    if use_baseline:
+        baseline = Baseline.load(baseline_path or default_baseline_path())
+    return analyze_paths([pkg], root=root, baseline=baseline, rules=rules)

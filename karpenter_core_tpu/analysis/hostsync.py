@@ -1,0 +1,207 @@
+"""host-sync: host<->device synchronization inside device-hot modules.
+
+The 50k-pod/<500 ms target forbids per-pod host round trips; an
+accidental ``np.asarray``/``.item()`` on a device value inside
+``solver/pack.py`` silently re-introduces exactly the serialization the
+tensor path exists to remove. Intentional sync points (the one
+``np.asarray`` after a batched dispatch) carry
+``# analysis: allow-host-sync`` markers.
+
+Detection is a linear, order-aware dataflow over each function body:
+names assigned from calls to device-array-producing functions (jit-
+decorated in the same module, or the configured cross-module producer
+list) become *device values*; reassignment from anything else clears
+them. Flagged operations:
+
+- ``.block_until_ready()``, ``.item()``, ``.tolist()``,
+  ``jax.device_get(...)`` — always (these only exist to synchronize);
+- ``np.asarray / np.array / np.ascontiguousarray / float / int / bool``
+  applied to an expression referencing a device value.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .engine import FileContext, dotted_name, jit_decoration, rule
+from .findings import SEV_ERROR, Finding
+
+_ALWAYS_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_CONVERTERS = {"asarray", "array", "ascontiguousarray"}
+_SCALAR_CASTS = {"float", "int", "bool"}
+
+
+def _module_jit_functions(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if jit_decoration(node) is not None:
+                out.add(node.name)
+    return out
+
+
+def _callee_basename(call: ast.Call) -> str:
+    name = dotted_name(call.func)
+    return name.split(".")[-1] if name else ""
+
+
+def _refs_any(expr: ast.AST, names: Set[str]) -> Optional[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in names:
+            return node.id
+    return None
+
+
+def _assign_targets(node: ast.AST) -> List[str]:
+    out: List[str] = []
+
+    def collect(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            collect(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        collect(node.target)
+    return out
+
+
+class _FunctionScan:
+    def __init__(self, ctx: FileContext, producers: Set[str], symbol: str):
+        self.ctx = ctx
+        self.producers = producers
+        self.symbol = symbol
+        self.device: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def flag(self, line: int, what: str) -> None:
+        self.findings.append(
+            Finding(
+                rule="host-sync",
+                path=self.ctx.relpath,
+                line=line,
+                symbol=self.symbol,
+                message=(
+                    f"{what} in device-hot module — host<->device sync; if this "
+                    f"is an intentional post-dispatch sync point, mark it "
+                    f"'# analysis: allow-host-sync'"
+                ),
+                severity=SEV_ERROR,
+            )
+        )
+
+    def check_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _ALWAYS_SYNC_METHODS:
+                self.flag(node.lineno, f"'.{f.attr}()'")
+                continue
+            name = dotted_name(f)
+            if name in ("jax.device_get",):
+                self.flag(node.lineno, "'jax.device_get'")
+                continue
+            base = name.split(".")[-1] if name else ""
+            if (
+                base in _NP_CONVERTERS
+                and name.split(".")[0] in ("np", "numpy")
+                and node.args
+            ):
+                var = _refs_any(node.args[0], self.device)
+                if var:
+                    self.flag(node.lineno, f"'{name}' on device value '{var}'")
+            elif name in _SCALAR_CASTS and node.args:
+                var = _refs_any(node.args[0], self.device)
+                if var:
+                    self.flag(node.lineno, f"'{name}()' on device value '{var}'")
+
+    def run_body(self, body: Iterable[ast.AST]) -> None:
+        for stmt in body:
+            self.run_stmt(stmt)
+
+    def run_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested functions are scanned as their own scope
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                self.check_expr(value)
+            targets = _assign_targets(stmt)
+            produced = (
+                isinstance(value, ast.Call)
+                and _callee_basename(value) in self.producers
+            )
+            for t in targets:
+                self.device.discard(t)
+                if produced:
+                    self.device.add(t)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.check_expr(stmt.test)
+            self.run_body(stmt.body)
+            self.run_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self.check_expr(stmt.iter)
+            self.run_body(stmt.body)
+            self.run_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.check_expr(item.context_expr)
+            self.run_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self.run_body(stmt.body)
+            for h in stmt.handlers:
+                self.run_body(h.body)
+            self.run_body(stmt.orelse)
+            self.run_body(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)) and stmt.value is not None:
+            self.check_expr(stmt.value)
+            return
+        # default: scan any expressions hanging off the statement
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.check_expr(child)
+
+
+@rule(
+    "host-sync",
+    "no un-annotated host<->device syncs (np.asarray/.item()/...) in device-hot modules",
+)
+def check_host_sync(ctx: FileContext):
+    if not ctx.is_device_hot():
+        return
+    producers = _module_jit_functions(ctx.tree) | set(ctx.config.device_producers)
+    symbols: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, sym: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # jit-decorated functions (and everything nested in them)
+                # are device code — conversions inside are traced ops,
+                # not host syncs
+                if jit_decoration(child) is not None:
+                    continue
+                child_sym = f"{sym}.{child.name}" if sym else child.name
+                symbols[child] = child_sym
+                visit(child, child_sym)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{sym}.{child.name}" if sym else child.name)
+            else:
+                visit(child, sym)
+
+    visit(ctx.tree, "")
+    for fn, sym in symbols.items():
+        scan = _FunctionScan(ctx, producers, sym)
+        scan.run_body(fn.body)
+        for f in scan.findings:
+            yield f

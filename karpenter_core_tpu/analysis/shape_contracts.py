@@ -1,0 +1,133 @@
+"""Static verification of the solver's shape contracts.
+
+For every ``@contract``-annotated tensor function, bind each dimension
+letter to a distinct prime, build abstract ``jax.ShapeDtypeStruct``
+inputs, and run ``jax.eval_shape`` — the function is traced with its
+real jit pipeline but no kernel executes, so the declared output shape
+is checked against what XLA would actually produce, in milliseconds.
+Distinct primes make accidental dimension transposition impossible to
+miss (P·R == R·P but (3, 5) != (5, 3)).
+
+This module is the only part of the analysis package that imports jax;
+the AST rule engine stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+_PRIMES = [3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41]
+
+_DTYPES = {
+    None: "int32",
+    "i4": "int32",
+    "i8": "int64",
+    "f4": "float32",
+    "f8": "float64",
+    "b1": "bool",
+}
+
+
+@dataclass
+class ContractResult:
+    name: str
+    ok: bool
+    checked: bool  # False = runtime-only contract (host/numpy fn)
+    detail: str = ""
+
+
+class _DimEnv:
+    """letter → concrete prime size, bound on first use."""
+
+    def __init__(self) -> None:
+        self.env: Dict[str, int] = {}
+        self._next = 0
+
+    def __call__(self, letter: str) -> int:
+        if letter.isdigit():
+            return int(letter)
+        if letter in ("*", "_"):
+            v = _PRIMES[self._next % len(_PRIMES)]
+            self._next += 1
+            return v
+        if letter not in self.env:
+            self.env[letter] = _PRIMES[self._next % len(_PRIMES)]
+            self._next += 1
+        return self.env[letter]
+
+
+def _build_input(spec: Optional[str], dtype_code: Optional[str], dims: _DimEnv):
+    import jax
+    import numpy as np
+
+    from ..solver.contracts import _parse
+
+    tokens = _parse(spec)
+    if tokens is None:
+        return None
+    shape = tuple(dims(t) for t in tokens)
+    return jax.ShapeDtypeStruct(shape, np.dtype(_DTYPES.get(dtype_code, dtype_code)))
+
+
+def verify_contracts(names: Optional[List[str]] = None) -> List[ContractResult]:
+    """Run eval_shape over the contract registry → per-function results."""
+    import jax
+
+    from ..solver import contracts as C
+
+    # importing the solver modules registers their contracts
+    from ..solver import encode, kernels, merge, pack  # noqa: F401
+
+    results: List[ContractResult] = []
+    for entry in C.REGISTRY:
+        name = entry["name"]
+        if names is not None and name not in names:
+            continue
+        if not entry.get("eval_shape", True):
+            results.append(
+                ContractResult(name, True, checked=False, detail="runtime-only (host fn)")
+            )
+            continue
+        dims = _DimEnv()
+        try:
+            if entry["example"] is not None:
+                args, kwargs = entry["example"](dims)
+            else:
+                dtypes = entry["dtypes"] or (None,) * len(entry["in_specs"])
+                args = tuple(
+                    _build_input(spec, dt, dims)
+                    for spec, dt in zip(entry["in_specs"], dtypes)
+                )
+                if any(a is None for a in args):
+                    results.append(
+                        ContractResult(
+                            name,
+                            True,
+                            checked=False,
+                            detail="unspecced args and no example builder",
+                        )
+                    )
+                    continue
+                kwargs = dict(entry["static"])
+            fn = entry["fn"]
+            if kwargs:
+                # eval_shape abstracts every argument; static kwargs
+                # (e.g. the compat kernels' `keys` tuple) must be closed
+                # over so the jit wrapper sees them as static
+                import functools
+
+                fn = functools.partial(fn, **kwargs)
+            out = jax.eval_shape(fn, *args)
+            C._check_out(name, entry["out"], out, dims.env)
+            results.append(
+                ContractResult(
+                    name,
+                    True,
+                    checked=True,
+                    detail=", ".join(f"{k}={v}" for k, v in sorted(dims.env.items())),
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — every failure becomes a report entry
+            results.append(ContractResult(name, False, checked=True, detail=str(e)[:500]))
+    return results

@@ -1,0 +1,208 @@
+"""tracer-safety: Python control flow on traced values inside jit/vmap.
+
+A Python ``if``/``while``/``assert`` on a traced array inside a
+``@jax.jit`` function raises ``TracerBoolConversionError`` at trace time
+— but only on the first call with a new shape signature, so it can hide
+until a production batch hits an untested size class. Worse, a branch on
+a *concrete* value captured by closure silently bakes one side into the
+compiled program. This rule finds both shapes statically:
+
+- the *traced set* starts as the function's parameters minus
+  ``static_argnames``/``static_argnums`` and grows through assignments
+  (a value computed from a traced value is traced);
+- ``.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` / ``len()`` punch out
+  of the traced set — shapes are static under jit, branching on them is
+  the normal and correct pattern;
+- ``if`` / ``while`` / ``assert`` tests and ``for`` iterables that
+  reference a traced name are findings, as are nested ``lax.scan``/
+  ``vmap`` body functions (their parameters are traced too).
+
+Also checked: every ``static_argnames`` entry must name a real
+parameter (a typo silently makes the argument traced), and a static
+parameter must not have a mutable (unhashable) default — jit requires
+hashable statics.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .engine import FileContext, jit_decoration, rule
+from .findings import SEV_ERROR, Finding
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "itemsize"}
+_STATIC_FNS = {"len", "isinstance", "type", "hasattr", "getattr"}
+
+
+def _params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _refs_traced(expr: ast.AST, traced: Set[str]) -> str:
+    """Name of a traced value the expression depends on, or ''. Shape/
+    dtype accesses and len() are static under jit and stop the search."""
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _SHAPE_ATTRS:
+            return ""
+        return _refs_traced(expr.value, traced)
+    if isinstance(expr, ast.Call):
+        fname = expr.func.id if isinstance(expr.func, ast.Name) else ""
+        if fname in _STATIC_FNS:
+            return ""
+        hit = ""
+        for child in list(expr.args) + [kw.value for kw in expr.keywords]:
+            hit = _refs_traced(child, traced)
+            if hit:
+                return hit
+        if not isinstance(expr.func, ast.Name):
+            return _refs_traced(expr.func, traced)
+        return ""
+    if isinstance(expr, ast.Name):
+        return expr.id if expr.id in traced else ""
+    for child in ast.iter_child_nodes(expr):
+        hit = _refs_traced(child, traced)
+        if hit:
+            return hit
+    return ""
+
+
+def _scan_jit_body(
+    ctx: FileContext, fn: ast.AST, symbol: str, traced: Set[str]
+) -> Iterable[Finding]:
+    def finding(line: int, kind: str, name: str) -> Finding:
+        return Finding(
+            rule="tracer-safety",
+            path=ctx.relpath,
+            line=line,
+            symbol=symbol,
+            message=(
+                f"Python {kind} on traced value '{name}' inside a jit/vmap "
+                f"function — use jnp.where/lax.cond or mark the argument static"
+            ),
+            severity=SEV_ERROR,
+        )
+
+    def visit(body: Iterable[ast.AST], traced: Set[str]) -> Iterable[Finding]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested function (scan/vmap body): its params are traced
+                inner = set(traced) | set(_params(stmt))
+                yield from visit(stmt.body, inner)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = stmt.value
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        targets.extend(_target_names(t))
+                else:
+                    targets.extend(_target_names(stmt.target))
+                if value is not None and _refs_traced(value, traced):
+                    traced.update(targets)
+                else:
+                    for t in targets:
+                        traced.discard(t)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                name = _refs_traced(stmt.test, traced)
+                if name:
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    yield finding(stmt.lineno, f"'{kind}'", name)
+                yield from visit(stmt.body, traced)
+                yield from visit(stmt.orelse, traced)
+                continue
+            if isinstance(stmt, ast.Assert):
+                name = _refs_traced(stmt.test, traced)
+                if name:
+                    yield finding(stmt.lineno, "'assert'", name)
+                continue
+            if isinstance(stmt, ast.For):
+                name = _refs_traced(stmt.iter, traced)
+                if name:
+                    yield finding(stmt.lineno, "'for' iteration", name)
+                yield from visit(stmt.body, traced)
+                yield from visit(stmt.orelse, traced)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from visit(stmt.body, traced)
+                continue
+            if isinstance(stmt, ast.Try):
+                yield from visit(stmt.body, traced)
+                for h in stmt.handlers:
+                    yield from visit(h.body, traced)
+                yield from visit(stmt.orelse, traced)
+                yield from visit(stmt.finalbody, traced)
+                continue
+
+    yield from visit(fn.body, set(traced))
+
+
+def _target_names(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    return []
+
+
+_MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+@rule(
+    "tracer-safety",
+    "no Python control flow on traced values in jit/vmap functions; statics must be real, hashable params",
+)
+def check_tracer_safety(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = jit_decoration(node)
+        if info is None:
+            continue
+        params = _params(node)
+        static: Set[str] = set(info["static_names"])
+        for i in info["static_nums"]:
+            if 0 <= i < len(params):
+                static.add(params[i])
+        symbol = node.name
+        for sname in info["static_names"]:
+            if sname not in params:
+                yield Finding(
+                    rule="tracer-safety",
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    symbol=symbol,
+                    message=(
+                        f"static_argnames entry '{sname}' is not a parameter of "
+                        f"'{node.name}' — the argument it meant to pin stays traced"
+                    ),
+                    severity=SEV_ERROR,
+                )
+        # mutable default on a static param — unhashable at dispatch time
+        a = node.args
+        pos = a.posonlyargs + a.args
+        for p, d in zip(pos[len(pos) - len(a.defaults) :], a.defaults):
+            if p.arg in static and isinstance(d, _MUTABLE_DEFAULTS):
+                yield Finding(
+                    rule="tracer-safety",
+                    path=ctx.relpath,
+                    line=d.lineno,
+                    symbol=symbol,
+                    message=(
+                        f"static parameter '{p.arg}' has an unhashable default — "
+                        f"jit requires hashable static arguments"
+                    ),
+                    severity=SEV_ERROR,
+                )
+        traced = {p for p in params if p not in static and p != "self"}
+        yield from _scan_jit_body(ctx, node, symbol, traced)

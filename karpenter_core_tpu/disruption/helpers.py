@@ -4,6 +4,7 @@ pdblimits.go)."""
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..apis import labels as wk
@@ -126,7 +127,10 @@ def get_candidates(
     for name, np_ in nodepool_map.items():
         try:
             instance_type_map[name] = {it.name: it for it in cloud_provider.get_instance_types(np_)}
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — one bad pool must not stop disruption
+            logging.getLogger("karpenter.disruption").debug(
+                "skipping nodepool %s: instance-type fetch failed: %s", name, e
+            )
             continue
     pods_by_node: Dict[str, list] = {}
     for p in kube_client.list("Pod"):

@@ -97,7 +97,8 @@ class NodeClaimDisruptionController:
             return req_drift
         try:
             return self.cloud_provider.is_drifted(nc) or ""
-        except Exception:
+        except Exception:  # analysis: allow-broad-except — provider drift probe is
+            # advisory; a failing probe must read as not-drifted, never disrupt
             return ""
 
     @staticmethod

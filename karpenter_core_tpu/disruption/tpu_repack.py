@@ -20,6 +20,7 @@ down on verification failure.
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import List
 
@@ -151,7 +152,10 @@ def _largest_launchable(ctx, axis) -> np.ndarray:
         try:
             for it in ctx.cloud_provider.get_instance_types(np_):
                 new_node_cap = np.maximum(new_node_cap, quantize_capacity(it.allocatable(), axis))
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — one bad pool must not stop the repack
+            logging.getLogger("karpenter.disruption").debug(
+                "skipping nodepool %s: instance-type fetch failed: %s", np_.name, e
+            )
             continue
     return new_node_cap
 

@@ -55,7 +55,10 @@ class KubeClient:
         return (obj.namespace, obj.name)
 
     def _notify(self, event: str, obj: KubeObject) -> None:
-        for cb in list(self._watchers.get(obj.kind, ())):
+        # deliberately outside self._lock: watch callbacks reenter the
+        # client (informers re-list, controllers read state) and would
+        # deadlock or invert lock order if notified under it
+        for cb in list(self._watchers.get(obj.kind, ())):  # analysis: allow-lock-discipline
             cb(event, obj)
 
     # -- CRUD --------------------------------------------------------------

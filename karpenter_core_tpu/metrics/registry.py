@@ -35,7 +35,8 @@ class Counter:
             self.values[key] = self.values.get(key, 0.0) + value
 
     def get(self, **labels) -> float:
-        return self.values.get(_labels_key(labels), 0.0)
+        with self._mu:
+            return self.values.get(_labels_key(labels), 0.0)
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} counter"]
@@ -59,7 +60,8 @@ class Gauge:
             self.values[_labels_key(labels)] = value
 
     def get(self, **labels) -> Optional[float]:
-        return self.values.get(_labels_key(labels))
+        with self._mu:
+            return self.values.get(_labels_key(labels))
 
     def delete(self, **labels) -> None:
         with self._mu:
@@ -170,8 +172,10 @@ class Registry:
 
     def expose(self) -> str:
         """Prometheus text exposition format (the /metrics payload)."""
+        with self._mu:
+            metrics = list(self.metrics)
         lines: List[str] = []
-        for m in self.metrics:
+        for m in metrics:
             lines.extend(m.collect())
         return "\n".join(lines) + "\n"
 

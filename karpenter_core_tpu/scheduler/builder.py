@@ -4,6 +4,7 @@ NewScheduler). Shared by the Provisioner and tests."""
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Set
 
 from ..apis.nodepool import NodePool, order_by_weight
@@ -65,9 +66,11 @@ def build_scheduler(
     for np in nodepools:
         try:
             options = cloud_provider.get_instance_types(np)
-        except Exception:
-            # a single misconfigured pool must not stop scheduling
+        except Exception as e:  # noqa: BLE001 — one bad pool must not stop scheduling
             # (provisioner.go:236-240)
+            logging.getLogger("karpenter").debug(
+                "skipping nodepool %s: instance-type fetch failed: %s", np.name, e
+            )
             continue
         if not options:
             continue

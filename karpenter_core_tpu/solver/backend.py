@@ -61,8 +61,14 @@ def enable_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception:  # older jax without these knobs — cache is best-effort
-        pass
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        import logging
+
+        # older jax without these knobs: every solve pays cold compiles,
+        # which is worth one debug line instead of silence
+        logging.getLogger("karpenter.solver").debug(
+            "persistent compilation cache unavailable: %s", e
+        )
     _CACHE_ENABLED = True
 
 

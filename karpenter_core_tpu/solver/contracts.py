@@ -1,0 +1,184 @@
+"""Shape contracts for the solver's tensor functions.
+
+A contract declares the dimensional type of a tensor function once, at
+the def site, in einops-style letters::
+
+    @contract("P R", "F R", "()", out=("P", "()"))
+    @partial(jax.jit, static_argnames=("k_open",))
+    def ffd_pack(requests, frontier, max_pods_per_node, k_open=16): ...
+
+Same letter = same size, bound left to right across arguments and then
+checked on the outputs; integer tokens pin an exact size; ``"()"``
+accepts a 0-d array or a Python scalar; ``None`` skips an argument
+(dicts, static config). Letters that first appear in ``out`` bind free
+(e.g. the frontier count of ``pareto_frontier``) — only their arity and
+already-bound letters are checked.
+
+Two consumers:
+
+- **runtime asserts** (cheap: a handful of int comparisons per call,
+  zero device work — shapes live on the host even for jax arrays),
+  enabled under tests via ``KARPENTER_TPU_SHAPE_CONTRACTS=1`` or
+  :func:`enable`; disabled by default so production solves pay one
+  truthiness check;
+- **static verification**: ``karpenter_core_tpu/analysis`` binds each
+  letter to a distinct prime and runs ``jax.eval_shape`` over the
+  registry (``python -m karpenter_core_tpu.analysis --contracts``) — no
+  kernels execute, but every contract is checked against the real
+  traced output shapes.
+
+Keep this module dependency-free (no jax/numpy import): it is imported
+by every solver module at startup.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_ENABLED = os.environ.get("KARPENTER_TPU_SHAPE_CONTRACTS", "0") not in ("", "0", "false", "off")
+
+#: all contracted functions, for the static verifier:
+#: dicts with fn (undecorated), wrapper, name, in_specs, out_spec,
+#: dtypes, example (optional builder for eval_shape inputs), static (kwargs)
+REGISTRY: List[dict] = []
+
+
+class ContractError(TypeError):
+    """A tensor function was called with (or returned) shapes violating
+    its declared contract."""
+
+
+def enable(on: bool = True) -> None:
+    """Flip runtime checking (tests use this; production leaves it off)."""
+    global _ENABLED
+    _ENABLED = on
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _parse(spec: Optional[str]) -> Optional[Tuple[str, ...]]:
+    if spec is None:
+        return None
+    spec = spec.strip()
+    if spec in ("()", ""):
+        return ()
+    return tuple(spec.split())
+
+
+def _shape_of(value: Any) -> Optional[Tuple[int, ...]]:
+    shape = getattr(value, "shape", None)
+    if shape is not None:
+        try:
+            return tuple(int(d) for d in shape)
+        except TypeError:
+            return None  # symbolic dims — leave to eval_shape mode
+    if isinstance(value, (int, float, bool)):
+        return ()  # Python scalar ⇒ 0-d
+    return None
+
+
+def _check_one(
+    name: str, what: str, dims: Tuple[str, ...], value: Any, env: Dict[str, int]
+) -> None:
+    shape = _shape_of(value)
+    if shape is None:
+        raise ContractError(
+            f"{name}: {what} expected an array of rank {len(dims)} "
+            f"({' '.join(dims) or 'scalar'}), got {type(value).__name__}"
+        )
+    if len(shape) != len(dims):
+        raise ContractError(
+            f"{name}: {what} expected rank {len(dims)} ({' '.join(dims) or 'scalar'}), "
+            f"got shape {shape}"
+        )
+    for letter, actual in zip(dims, shape):
+        if letter in ("*", "_"):
+            continue
+        if letter.isdigit():
+            if actual != int(letter):
+                raise ContractError(
+                    f"{name}: {what} dim '{letter}' expected {letter}, got {actual} "
+                    f"(shape {shape})"
+                )
+            continue
+        bound = env.get(letter)
+        if bound is None:
+            env[letter] = actual
+        elif bound != actual:
+            raise ContractError(
+                f"{name}: {what} dim '{letter}'={actual} contradicts "
+                f"'{letter}'={bound} bound earlier (shape {shape})"
+            )
+
+
+def _check_out(name: str, out_specs, result: Any, env: Dict[str, int]) -> None:
+    if out_specs is None:
+        return
+    if isinstance(out_specs, str):
+        parts: List[Optional[str]] = [out_specs]
+        values: tuple = (result,)
+    else:
+        parts = list(out_specs)
+        values = tuple(result) if isinstance(result, (tuple, list)) else (result,)
+        if len(parts) != len(values):
+            raise ContractError(
+                f"{name}: output expected {len(parts)} values, got {len(values)}"
+            )
+    for i, (spec, value) in enumerate(zip(parts, values)):
+        dims = _parse(spec)
+        if dims is None:
+            continue
+        _check_one(name, f"output[{i}]", dims, value, env)
+
+
+def contract(
+    *in_specs: Optional[str],
+    out=None,
+    dtypes: Optional[Sequence[str]] = None,
+    example=None,
+    static: Optional[dict] = None,
+    eval_shape: bool = True,
+):
+    """Declare a shape contract. ``in_specs`` align with positional
+    parameters; ``out`` is a spec or tuple of specs; ``dtypes`` (aligned
+    with in_specs, default int32) and ``example``/``static`` feed the
+    eval_shape verifier for functions whose inputs a plain spec cannot
+    describe (dict pytrees, static kwargs). ``eval_shape=False`` marks
+    host/numpy functions that cannot be abstractly traced — they keep
+    runtime checks but are skipped by the static verifier."""
+    parsed_in = [_parse(s) for s in in_specs]
+
+    def deco(fn):
+        name = getattr(fn, "__name__", str(fn))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _ENABLED:
+                return fn(*args, **kwargs)
+            env: Dict[str, int] = {}
+            for i, dims in enumerate(parsed_in):
+                if dims is None or i >= len(args):
+                    continue
+                _check_one(name, f"arg[{i}]", dims, args[i], env)
+            result = fn(*args, **kwargs)
+            _check_out(name, out, result, env)
+            return result
+
+        wrapper.__shape_contract__ = {
+            "name": name,
+            "fn": fn,
+            "in_specs": tuple(in_specs),
+            "out": out,
+            "dtypes": tuple(dtypes) if dtypes is not None else None,
+            "example": example,
+            "static": dict(static or {}),
+            "eval_shape": eval_shape,
+        }
+        REGISTRY.append(wrapper.__shape_contract__)
+        return wrapper
+
+    return deco

@@ -16,7 +16,44 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .contracts import contract
 from .encode import EncodedInstanceTypes, SignaturePoolCompat
+
+
+def _compat_example(dims):
+    """eval_shape inputs for the dict-pytree compat kernels (see
+    analysis/shape_contracts.py): two keys, everything abstract."""
+    import jax
+
+    S, T, V = dims("S"), dims("T"), dims("V")
+    keys = ("key-a", "key-b")
+
+    def b(shape):
+        return jax.ShapeDtypeStruct(shape, np.bool_)
+
+    sig = {"valid": b((S,))}
+    tm, th, tn = {}, {}, {}
+    for k in keys:
+        sig[f"mask:{k}"] = b((S, V))
+        sig[f"has:{k}"] = b((S,))
+        sig[f"neg:{k}"] = b((S,))
+        tm[k] = b((T, V))
+        th[k] = b((T,))
+        tn[k] = b((T,))
+    return (sig, tm, th, tn), {"keys": keys}
+
+
+def _allowed_example(dims):
+    import jax
+
+    (sig, tm, th, tn), kw = _compat_example(dims)
+    S, T = dims("S"), dims("T")
+    Z, C = dims("Z"), dims("C")
+
+    def b(shape):
+        return jax.ShapeDtypeStruct(shape, np.bool_)
+
+    return (sig, tm, th, tn, b((S, Z)), b((S, C)), b((T, Z, C))), kw
 
 
 def build_compat_inputs(
@@ -48,6 +85,7 @@ def build_compat_inputs(
     return arrays
 
 
+@contract(None, None, None, None, out="S T", example=_compat_example)
 @partial(jax.jit, static_argnames=("keys",))
 def compat_kernel(
     sig_arrays: Dict[str, jnp.ndarray],
@@ -71,6 +109,7 @@ def compat_kernel(
     return ok
 
 
+@contract("S Z", "S C", "T Z C", dtypes=("b1", "b1", "b1"), out="S T")
 @jax.jit
 def offering_kernel(
     zone_ok: jnp.ndarray,  # (S, Z) bool — signature allows zone
@@ -84,6 +123,7 @@ def offering_kernel(
     return jnp.einsum("szc,tzc->st", pair_ok.astype(jnp.float32), avail.astype(jnp.float32)) > 0
 
 
+@contract(None, None, None, None, "S Z", "S C", "T Z C", out="S T", example=_allowed_example)
 @partial(jax.jit, static_argnames=("keys",))
 def allowed_kernel(
     sig_arrays: Dict[str, jnp.ndarray],
@@ -104,6 +144,7 @@ def allowed_kernel(
     return compat & offering_kernel(zone_ok, ct_ok, avail)
 
 
+@contract(None, None, None, None, "S Z", "S C", "T Z C", out="S T", eval_shape=False)
 def allowed_host(
     sig_arrays: Dict[str, np.ndarray],
     type_masks: Dict[str, np.ndarray],

@@ -52,6 +52,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .contracts import contract
 from ..tracing import tracer
 
 _ENGINES = ("vector", "scalar")
@@ -251,6 +252,47 @@ class _Bucket:
         self.cl_fp[k] = self._intern(merged.fingerprint(), merged)
 
 
+@contract(
+    "K", "K", "K Z", "K C", "K B", "K R", "K R", "()", "Z", "C", "B", "R", "R",
+    out="K",
+    eval_shape=False,
+)
+def screen_candidates(
+    cl_zid: np.ndarray,
+    cl_fp: np.ndarray,
+    cl_zone_ok: np.ndarray,
+    cl_ct_ok: np.ndarray,
+    cl_screen8: np.ndarray,
+    cl_usage: np.ndarray,
+    cl_alloc_cap: np.ndarray,
+    rz,
+    zone_ok: np.ndarray,
+    ct_ok: np.ndarray,
+    screen8: np.ndarray,
+    usage: np.ndarray,
+    alloc_cap: np.ndarray,
+) -> np.ndarray:
+    """One record's full candidate row over K open clusters in one
+    broadcast (every reject a *necessary* condition of the scalar
+    accept — see module docstring) → (K,) bool."""
+    K = cl_zid.shape[0]
+    Z = zone_ok.shape[0]
+    cand = ((cl_zid == -1) | (rz == -1) | (cl_zid == rz)) & (cl_fp >= 0)
+    zinter = cl_zone_ok & zone_ok[None, :]
+    cand &= zinter.any(axis=1)
+    cand &= (cl_ct_ok & ct_ok[None, :]).any(axis=1)
+    eff = np.where(cl_zid >= 0, cl_zid, rz)
+    if Z and (eff >= 0).any():
+        zbit = zinter[np.arange(K), np.clip(eff, 0, Z - 1)]
+        cand &= (eff < 0) | zbit
+    cand &= ((cl_screen8 & screen8[None, :]) != 0).any(axis=1)
+    cand &= np.all(
+        cl_usage + usage[None, :] <= np.minimum(cl_alloc_cap, alloc_cap[None, :]),
+        axis=1,
+    )
+    return cand
+
+
 def merge_records_vector(
     solver, records: List[dict], pods, scan_cap: int
 ) -> List[dict]:
@@ -286,23 +328,20 @@ def merge_records_vector(
             K = b.k
             if K and b.rec_fp[j] >= 0:
                 screened += K
-                rz = b.zid[j]
-                cand = (
-                    ((b.cl_zid[:K] == -1) | (rz == -1) | (b.cl_zid[:K] == rz))
-                    & (b.cl_fp[:K] >= 0)
-                )
-                zinter = b.cl_zone_ok[:K] & b.zone_ok[j][None, :]
-                cand &= zinter.any(axis=1)
-                cand &= (b.cl_ct_ok[:K] & b.ct_ok[j][None, :]).any(axis=1)
-                eff = np.where(b.cl_zid[:K] >= 0, b.cl_zid[:K], rz)
-                if b.Z and (eff >= 0).any():
-                    zbit = zinter[np.arange(K), np.clip(eff, 0, b.Z - 1)]
-                    cand &= (eff < 0) | zbit
-                cand &= ((b.cl_screen8[:K] & b.screen8[j][None, :]) != 0).any(axis=1)
-                cand &= np.all(
-                    b.cl_usage[:K] + b.usage[j][None, :]
-                    <= np.minimum(b.cl_alloc_cap[:K], b.alloc_cap[j][None, :]),
-                    axis=1,
+                cand = screen_candidates(
+                    b.cl_zid[:K],
+                    b.cl_fp[:K],
+                    b.cl_zone_ok[:K],
+                    b.cl_ct_ok[:K],
+                    b.cl_screen8[:K],
+                    b.cl_usage[:K],
+                    b.cl_alloc_cap[:K],
+                    b.zid[j],
+                    b.zone_ok[j],
+                    b.ct_ok[j],
+                    b.screen8[j],
+                    b.usage[j],
+                    b.alloc_cap[j],
                 )
                 rows = np.flatnonzero(cand)
                 if rows.size:

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from . import devicetime
+from .contracts import contract
 from ..tracing import tracer
 import numpy as np
 
@@ -39,6 +40,7 @@ INT_INF = np.int32(2**31 - 1)
 NATIVE_K_OPEN = int(os.environ.get("KARPENTER_TPU_K_OPEN", "1024"))
 
 
+@contract("T R", out="F R", eval_shape=False)
 def pareto_frontier(allocatable: np.ndarray) -> np.ndarray:
     """Maximal points of the viable types' allocatable vectors (F, R).
     A usage vector fits some type iff it fits some frontier point.
@@ -66,6 +68,7 @@ def pareto_frontier(allocatable: np.ndarray) -> np.ndarray:
     return buf[:n].astype(np.int32)
 
 
+@contract("P R", "F R", "()", out=("P", "()"))
 @partial(jax.jit, static_argnames=("k_open",))
 def ffd_pack(
     requests: jnp.ndarray,  # (P, R) int32, pre-sorted descending by primary
@@ -139,6 +142,7 @@ def ffd_pack(
     return node_ids, final["next_id"]
 
 
+@contract("P R", "P", "S M", "M R", dtypes=("i4", "i4", "b1", "i4"), out=("P", "M R"))
 @jax.jit
 def pack_existing(
     requests: jnp.ndarray,  # (P, R) int32, pre-sorted descending by primary
@@ -205,9 +209,11 @@ def _run_pack_existing(
         jnp.asarray(compat.astype(bool)),
         jnp.asarray(free),
     )
+    # analysis: allow-host-sync — the ONE intended sync of this dispatch
     return np.asarray(assign), np.asarray(free_out)
 
 
+@contract("N R", "T R", "T", out="N", eval_shape=False)
 def assign_cheapest_types(
     node_usage: np.ndarray,  # (N, R) int32 summed requests per node
     allocatable: np.ndarray,  # (T, R) int32 (viable types only)
@@ -235,6 +241,7 @@ def assign_cheapest_types(
     return best
 
 
+@contract("G P R", "G F R", "G", out=("G P", "G"))
 @partial(jax.jit, static_argnames=("k_open",))
 def ffd_pack_batched(
     requests: jnp.ndarray,  # (G, P, R)
@@ -327,8 +334,9 @@ def _batch_pack(jobs: list, engine: str, mesh) -> list:
             node_ids, counts = ffd_pack_batched(
                 jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
             )
-            node_ids = np.asarray(node_ids)
-            counts = np.asarray(counts)
+            # one sync per size class, after the batched dispatch
+            node_ids = np.asarray(node_ids)  # analysis: allow-host-sync
+            counts = np.asarray(counts)  # analysis: allow-host-sync
         for slot, g in enumerate(members):
             results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
     return results
@@ -365,8 +373,9 @@ def _batch_pack_sharded(mesh, jobs: list) -> list:
             node_ids, counts, _fleet = sharded_batch_pack(
                 mesh, jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
             )
-            node_ids = np.asarray(node_ids)
-            counts = np.asarray(counts)
+            # one sync per size class, after the mesh-sharded dispatch
+            node_ids = np.asarray(node_ids)  # analysis: allow-host-sync
+            counts = np.asarray(counts)  # analysis: allow-host-sync
         for slot, g in enumerate(members):
             results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
     return results
@@ -392,6 +401,7 @@ def pad_for_pack(requests: np.ndarray, frontier: np.ndarray) -> Tuple[np.ndarray
     return requests, frontier, P
 
 
+@contract("P R", "P", "()", out="N R", eval_shape=False)
 def node_usage_from_assignment(
     requests: np.ndarray, node_ids: np.ndarray, node_count: int
 ) -> np.ndarray:

@@ -46,7 +46,7 @@ def active_mesh(backend: str) -> Optional[Mesh]:
         return None
     try:
         n = len(jax.devices())
-    except Exception:
+    except Exception:  # analysis: allow-broad-except — no devices ⇒ single-device path
         return None
     if n < 2 or (mode == "auto" and backend != "tpu"):
         return None

@@ -30,6 +30,7 @@ exposes node count and total price for comparison.
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 import threading
@@ -37,6 +38,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+log = logging.getLogger("karpenter.solver")
 
 from ..apis import labels as wk
 from ..apis.nodepool import NodePool, order_by_weight
@@ -1179,7 +1182,12 @@ class TPUScheduler:
             for np_ in self.nodepools:
                 try:
                     its = self.cloud_provider.get_instance_types(np_)
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — one bad pool must not stop the solve
+                    log.debug(
+                        "skipping nodepool %s: instance-type fetch failed: %s",
+                        np_.name,
+                        e,
+                    )
                     continue
                 if not its:
                     continue

@@ -319,11 +319,16 @@ class Cluster:
 
     def mark_unconsolidated(self) -> float:
         now = self.clock()
-        self._consolidation_timestamp = now
+        # under the (reentrant) mutex: callers inside update paths already
+        # hold it, but external callers (disruption controller) race the
+        # watch threads without it
+        with self._mu:
+            self._consolidation_timestamp = now
         return now
 
     def consolidation_state(self) -> float:
-        return self._consolidation_timestamp
+        with self._mu:
+            return self._consolidation_timestamp
 
     def reset(self) -> None:
         """Testing support (cluster.go:328)."""

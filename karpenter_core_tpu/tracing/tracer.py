@@ -153,7 +153,8 @@ class TraceRing:
 
     @property
     def capacity(self) -> int:
-        return self._capacity
+        with self._mu:
+            return self._capacity
 
     def set_capacity(self, capacity: int) -> None:
         with self._mu:

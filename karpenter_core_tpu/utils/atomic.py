@@ -24,7 +24,8 @@ class Lazy(Generic[T]):
     def get(self, resolve: Optional[Callable[[], T]] = None) -> T:
         # read into a local once: a racing reset() must not turn an
         # already-checked slot back into the sentinel mid-return
-        value = self._value
+        # (double-checked locking — the lock-free fast path is the point)
+        value = self._value  # analysis: allow-lock-discipline
         if value is not _UNSET:
             return value  # type: ignore[return-value]
         with self._lock:

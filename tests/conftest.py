@@ -9,6 +9,10 @@ touches JAX.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# runtime shape-contract asserts (solver/contracts.py) are on for every
+# test; production leaves them disabled. Must be set before the solver
+# modules import.
+os.environ.setdefault("KARPENTER_TPU_SHAPE_CONTRACTS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,500 @@
+"""Tier-1 gate for the static-analysis subsystem (ISSUE 3).
+
+Three layers:
+- per-rule fixture tests (positive snippet -> finding; negative ->
+  clean; suppression marker -> suppressed; baseline round-trip);
+- the META-TEST: the full-repo run must match the checked-in baseline
+  exactly (no new findings, no stale entries) — this is the gate that
+  keeps future PRs lock-clean and sync-clean;
+- shape contracts: the eval_shape registry verifies clean, and the
+  runtime asserts (enabled suite-wide by conftest) catch violations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.analysis import (
+    AnalysisConfig,
+    Baseline,
+    analyze_paths,
+    analyze_repo,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_snippet(tmp_path, code, rules=None, config=None, baseline=None, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return analyze_paths(
+        [str(p)], root=str(tmp_path), rules=rules, config=config, baseline=baseline
+    )
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline fixtures
+
+LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self.items = {}
+
+        def put(self, k, v):
+            with self._mu:
+                self.items[k] = v
+
+        def get(self, k):
+            __BODY__
+"""
+
+
+def test_lock_discipline_positive(tmp_path):
+    code = LOCKED_CLASS.replace('__BODY__', "return self.items.get(k)")
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.rule == "lock-discipline"
+    assert f.symbol == "Box.get"
+    assert "'items'" in f.message
+
+
+def test_lock_discipline_negative_locked_read(tmp_path):
+    code = LOCKED_CLASS.replace(
+        "__BODY__", "with self._mu:\n                return self.items.get(k)"
+    )
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert report.findings == []
+
+
+def test_lock_discipline_readonly_config_field_not_guarded(tmp_path):
+    # a field only ever READ under the lock (never mutated there) is
+    # config, not state — no finding for unlocked reads elsewhere
+    code = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.limit = 10
+                self.items = {}
+
+            def put(self, k, v):
+                with self._mu:
+                    if len(self.items) < self.limit:
+                        self.items[k] = v
+
+            def limit_hint(self):
+                return self.limit
+    """
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert report.findings == []
+
+
+def test_lock_discipline_private_helper_called_under_lock(tmp_path):
+    code = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._mu:
+                    self._store(k, v)
+
+            def _store(self, k, v):
+                self.items[k] = v
+    """
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert report.findings == []
+
+
+def test_lock_discipline_private_helper_with_unlocked_callsite(tmp_path):
+    code = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._mu:
+                    self._store(k, v)
+
+            def sneak(self, k, v):
+                self._store(k, v)
+
+            def _store(self, k, v):
+                self.items[k] = v
+    """
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert [f.symbol for f in report.findings] == ["Box._store"]
+
+
+def test_lock_discipline_suppression(tmp_path):
+    code = LOCKED_CLASS.replace(
+        "__BODY__", "return self.items.get(k)  # analysis: allow-lock-discipline"
+    )
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# host-sync fixtures
+
+HOT_CONFIG = AnalysisConfig(device_hot_modules=("snippet.py",))
+
+HOT_SNIPPET = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def kernel(x):
+        return x * 2
+
+    def driver(x):
+        y = kernel(x)
+        {line}
+"""
+
+
+def test_host_sync_positive_asarray_on_device_value(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        HOT_SNIPPET.format(line="return np.asarray(y)"),
+        rules=["host-sync"],
+        config=HOT_CONFIG,
+    )
+    assert len(report.findings) == 1
+    assert "np.asarray" in report.findings[0].message
+    assert report.findings[0].symbol == "driver"
+
+
+def test_host_sync_positive_item(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        HOT_SNIPPET.format(line="return y.sum().item()"),
+        rules=["host-sync"],
+        config=HOT_CONFIG,
+    )
+    assert any("'.item()'" in f.message for f in report.findings)
+
+
+def test_host_sync_negative_host_value(tmp_path):
+    # np.asarray on a host value (reassigned) is not a sync
+    report = run_snippet(
+        tmp_path,
+        HOT_SNIPPET.format(line="y = np.zeros(3)\n        return np.asarray(y)"),
+        rules=["host-sync"],
+        config=HOT_CONFIG,
+    )
+    assert report.findings == []
+
+
+def test_host_sync_not_device_hot_module(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        HOT_SNIPPET.format(line="return np.asarray(y)"),
+        rules=["host-sync"],  # default config: snippet.py is not device-hot
+    )
+    assert report.findings == []
+
+
+def test_host_sync_suppression(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        HOT_SNIPPET.format(line="return np.asarray(y)  # analysis: allow-host-sync"),
+        rules=["host-sync"],
+        config=HOT_CONFIG,
+    )
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# tracer-safety fixtures
+
+
+def test_tracer_safety_positive_if_on_traced(tmp_path):
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert len(report.findings) == 1
+    assert "'if'" in report.findings[0].message
+
+
+def test_tracer_safety_negative_shape_branch_and_static(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if x.shape[0] > 4 and mode == "wide":
+                return x * 2
+            return x
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert report.findings == []
+
+
+def test_tracer_safety_propagates_through_assignment(tmp_path):
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            while y > 0:
+                y = y - 1
+            return y
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert any("'while'" in f.message for f in report.findings)
+
+
+def test_tracer_safety_static_argnames_typo(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k_opne",))
+        def f(x, k_open=4):
+            return x * k_open
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert any("k_opne" in f.message for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# hygiene fixtures
+
+
+def test_broad_except_positive(tmp_path):
+    code = """
+        def f():
+            try:
+                return 1
+            except Exception:
+                pass
+    """
+    report = run_snippet(tmp_path, code, rules=["broad-except"])
+    assert len(report.findings) == 1
+
+
+def test_broad_except_negative_logged(tmp_path):
+    code = """
+        import logging
+
+        def f():
+            try:
+                return 1
+            except Exception as e:
+                logging.getLogger("x").warning("failed: %s", e)
+                return 0
+    """
+    report = run_snippet(tmp_path, code, rules=["broad-except"])
+    assert report.findings == []
+
+
+def test_broad_except_noqa_alias(tmp_path):
+    code = """
+        def f():
+            try:
+                return 1
+            except Exception:  # noqa: BLE001 — loop must never die
+                pass
+    """
+    report = run_snippet(tmp_path, code, rules=["broad-except"])
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_mutable_default(tmp_path):
+    code = """
+        def f(x, acc=[]):
+            acc.append(x)
+            return acc
+
+        def g(x, acc=None):
+            return acc
+    """
+    report = run_snippet(tmp_path, code, rules=["mutable-default"])
+    assert len(report.findings) == 1
+    assert "'acc'" in report.findings[0].message
+
+
+def test_jnp_host_only(tmp_path):
+    cfg = AnalysisConfig(host_only_prefixes=("hostmod/",))
+    d = tmp_path / "hostmod"
+    d.mkdir()
+    (d / "ctrl.py").write_text("import jax.numpy as jnp\n")
+    report = analyze_paths([str(d)], root=str(tmp_path), rules=["jnp-host-only"], config=cfg)
+    assert len(report.findings) == 1
+    assert "jax.numpy" in report.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+
+
+def test_baseline_round_trip(tmp_path):
+    code = LOCKED_CLASS.replace('__BODY__', "return self.items.get(k)")
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"])
+    assert len(report.findings) == 1
+
+    baseline = Baseline.from_findings(report.findings, justification="grandfathered")
+    bpath = tmp_path / "baseline.json"
+    baseline.save(str(bpath))
+    reloaded = Baseline.load(str(bpath))
+
+    report2 = run_snippet(tmp_path, code, rules=["lock-discipline"], baseline=reloaded)
+    assert report2.findings == []
+    assert len(report2.baselined) == 1
+    assert report2.ok
+
+
+def test_baseline_stale_entry_fails(tmp_path):
+    code = LOCKED_CLASS.replace(
+        "__BODY__", "with self._mu:\n                return self.items.get(k)"
+    )
+    stale = Baseline(
+        [
+            {
+                "rule": "lock-discipline",
+                "path": "snippet.py",
+                "symbol": "Box.get",
+                "message": "field 'items' accessed without holding 'self._mu' "
+                "(guarded: used under the lock elsewhere in Box)",
+            }
+        ]
+    )
+    report = run_snippet(tmp_path, code, rules=["lock-discipline"], baseline=stale)
+    assert report.findings == []
+    assert len(report.stale_baseline) == 1
+    assert not report.ok
+
+
+# ---------------------------------------------------------------------------
+# the meta-test: full-repo run matches the checked-in baseline
+
+
+def test_repo_matches_checked_in_baseline():
+    report = analyze_repo()
+    msgs = [f.format() for f in report.findings]
+    stale = [e["message"] for e in report.stale_baseline]
+    assert report.findings == [], (
+        "new static-analysis findings (fix, suppress with a justified "
+        "'# analysis: allow-<rule>' marker, or baseline):\n" + "\n".join(msgs)
+    )
+    assert report.stale_baseline == [], (
+        "stale baseline entries — the finding was fixed, remove it from "
+        "analysis/baseline.json (or run --write-baseline):\n" + "\n".join(stale)
+    )
+    assert report.parse_errors == []
+    assert report.files_scanned > 100  # the whole package was really scanned
+
+
+def test_cli_json_clean_and_machine_readable():
+    proc = subprocess.run(
+        [sys.executable, "-m", "karpenter_core_tpu.analysis", "--format", "json"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert payload["files_scanned"] > 100
+
+
+def test_cli_fails_on_injected_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n    try:\n        return 1\n    except Exception:\n        pass\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "karpenter_core_tpu.analysis",
+            "--no-baseline",
+            str(bad),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "broad-except" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# shape contracts
+
+
+def test_contract_registry_verifies_via_eval_shape():
+    from karpenter_core_tpu.analysis.shape_contracts import verify_contracts
+
+    results = verify_contracts()
+    failures = [r for r in results if not r.ok]
+    assert failures == [], [f"{r.name}: {r.detail}" for r in failures]
+    checked = [r for r in results if r.checked]
+    assert len(checked) >= 6, (
+        "ISSUE 3 acceptance: at least 6 solver tensor functions verified "
+        f"via jax.eval_shape, got {len(checked)}"
+    )
+
+
+def test_runtime_contract_catches_dim_mismatch():
+    from karpenter_core_tpu.solver import contracts
+    from karpenter_core_tpu.solver.pack import ffd_pack
+
+    assert contracts.enabled()  # conftest sets KARPENTER_TPU_SHAPE_CONTRACTS=1
+    requests = np.ones((4, 3), dtype=np.int32)
+    frontier = np.ones((2, 2), dtype=np.int32)  # R=2 contradicts R=3
+    with pytest.raises(contracts.ContractError, match="'R'"):
+        ffd_pack(requests, frontier, np.int32(10))
+
+
+def test_runtime_contract_catches_rank_mismatch():
+    from karpenter_core_tpu.solver import contracts
+    from karpenter_core_tpu.solver.pack import pareto_frontier
+
+    with pytest.raises(contracts.ContractError, match="rank 2"):
+        pareto_frontier(np.ones(5, dtype=np.int32))
+
+
+def test_runtime_contract_passes_valid_call():
+    from karpenter_core_tpu.solver.pack import pareto_frontier
+
+    out = pareto_frontier(np.array([[4, 2], [2, 4], [1, 1]], dtype=np.int32))
+    assert out.ndim == 2 and out.shape[1] == 2  # dominated (1,1) dropped
+    assert len(out) == 2
