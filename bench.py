@@ -54,6 +54,25 @@ def nogc():
 BASELINE_PODS_PER_SEC = 100.0  # scheduling_benchmark_test.go:51,177-181
 
 
+@contextlib.contextmanager
+def incremental_off():
+    """The headline and configs 1-6 re-solve an unchanged batch, which
+    the steady-state incremental path (ISSUE 4) would legitimately
+    replay in a few ms — correct, but it would stop measuring the
+    solver pipeline and break comparability with earlier rounds'
+    BENCH_r*.json. Those configs pin the cold pipeline; config 7
+    measures the incremental steady state explicitly."""
+    prev = os.environ.get("KARPENTER_TPU_INCREMENTAL")
+    os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        else:
+            os.environ["KARPENTER_TPU_INCREMENTAL"] = prev
+
+
 def resolve_backend(out: dict) -> str:
     """Pick the JAX platform for this process, trying hard for the chip.
 
@@ -238,6 +257,14 @@ def _split(solver) -> dict:
         "device_ms": round(t["device_ms"], 2),
         "host_ms": round(t["host_ms"], 2),
     }
+    cs = getattr(solver, "last_cache_stats", None)
+    if cs and (cs.get("hits") or cs.get("misses")):
+        # steady-state incremental solve (ISSUE 4): per-solve cache
+        # traffic and the aggregate hit rate, per cache layer
+        out["cache_hits"] = dict(cs.get("hits", {}))
+        out["cache_misses"] = dict(cs.get("misses", {}))
+        if "hit_rate" in cs:
+            out["cache_hit_rate"] = cs["hit_rate"]
     ms = getattr(solver, "last_merge_stats", None)
     if ms:
         # cross-group merge observability (ISSUE 2): wall time of the
@@ -725,6 +752,225 @@ def config6() -> dict:
     }
 
 
+def config7() -> dict:
+    """Steady-state incremental solve (ISSUE 4): N ticks over a churning
+    config-2-shaped workload — mixed cpu/mem/gpu pod sizes spread over
+    team deployments (distinct signatures/classes, how real clusters
+    shard into NodeClaim label sets), ~5% pod add/remove per tick
+    concentrated on a few teams, plus periodic catalog price mutation
+    and pool mutation (the invalidation events a live provisioner sees).
+
+    Every tick solves TWICE over the same logical inputs:
+      cold — a restart-shaped solve: fresh pod objects, fresh catalog
+             objects, fresh solver, incremental path disabled. This is
+             what EVERY tick cost before the cross-tick caches and what
+             a provisioner restart pays per tick (the bench-wide
+             meaning of "cold": headline cold_ms = encode cost).
+      warm — the long-lived solver through the incremental path
+             (mutation ticks pay their invalidation here, raising the
+             warm p99 — that spread is the point of the config).
+    The cold solve doubles as the plan-identity oracle: the warm plan
+    must be identical, every tick. Gate: warm_tick_host_ms_p50 ≥3×
+    lower than cold_tick_host_ms_p50, plan_identical_ticks == ticks."""
+    import copy as _copy
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+    from karpenter_core_tpu.kube.objects import NodeSelectorRequirement
+    from karpenter_core_tpu.solver import TPUScheduler
+    from karpenter_core_tpu.solver import incremental
+
+    rng = np.random.RandomState(23)
+    ticks = int(os.environ.get("BENCH_TICKS", "30"))
+    churn = float(os.environ.get("BENCH_CHURN", "0.05"))
+    mutate_every = int(os.environ.get("BENCH_MUTATE_EVERY", "10"))
+    n_pods = _scale(10_000)
+    teams = 40
+
+    from karpenter_core_tpu.cloudprovider.types import Offering
+
+    cat_specs = [
+        (
+            f"cap-{i}",
+            {"cpu": str((i % 64) + 1), "memory": f"{2 * ((i % 64) + 1)}Gi", "pods": "110"},
+        )
+        for i in range(_scale(480))
+    ] + [
+        # gpu-bearing types for the config-2 pod mix's 10% gpu slice
+        (
+            f"cap-gpu-{g}",
+            {"cpu": str(8 * (g + 1)), "memory": f"{16 * (g + 1)}Gi",
+             "pods": "110", "nvidia.com/gpu": str(min(8, g + 1))},
+        )
+        for g in range(20)
+    ]
+    provider = FakeCloudProvider()
+    provider.instance_types = [new_instance_type(n, r) for n, r in cat_specs]
+    provider.bump_catalog_generation()  # bench owns catalog invalidation
+
+    def clone_catalog():
+        """Fresh InstanceType objects carrying the CURRENT (mutated)
+        prices — the restart-shaped cold solve must not share cached
+        tensors with the warm solver's catalog objects."""
+        out = []
+        for (name, res), live in zip(cat_specs, provider.instance_types):
+            offerings = [
+                Offering(o.capacity_type, o.zone, o.price, o.available)
+                for o in live.offerings
+            ]
+            out.append(new_instance_type(name, res, offerings=offerings))
+        return out
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+    nodepool.spec.template.requirements = [
+        NodeSelectorRequirement("bench-team", "In", [f"t{t}" for t in range(teams)])
+    ]
+
+    counter = [0]
+
+    def mk(team):
+        i = counter[0]
+        counter[0] += 1
+        cpu = ["100m", "250m", "500m", "1", "2", "4"][rng.randint(6)]
+        mem = ["128Mi", "512Mi", "1Gi", "2Gi", "4Gi"][rng.randint(5)]
+        gpu = "1" if rng.rand() < 0.1 else None
+        p = _mk_pod(
+            i, cpu, mem, gpu=gpu,
+            selector={"bench-team": f"t{team}"},
+            labels={"bench-team": f"t{team}"},
+        )
+        p._bench_spec = (cpu, mem, gpu, team)  # clone recipe for cold ticks
+        return p
+
+    def clone_pod(i, p):
+        cpu, mem, gpu, team = p._bench_spec
+        return _mk_pod(
+            i, cpu, mem, gpu=gpu,
+            selector={"bench-team": f"t{team}"},
+            labels={"bench-team": f"t{team}"},
+        )
+
+    pods = [mk(t % teams) for t in range(n_pods)]
+
+    def canon(res, uid_of):
+        """Position-keyed plan canonicalization (cold ticks solve clone
+        objects, so uids differ; batch order is shared)."""
+        return (
+            sorted(
+                (
+                    p.nodepool_name,
+                    p.instance_type.name,
+                    p.zone,
+                    p.capacity_type,
+                    round(p.price, 9),
+                    tuple(sorted(p.pod_indices)),
+                )
+                for p in res.node_plans
+            ),
+            sorted(uid_of[uid] for uid in res.pod_errors),
+        )
+
+    def churn_tick():
+        """~churn fraction of pods swapped, concentrated on a few teams
+        (a deployment-rollout shape, not uniform noise)."""
+        hit = rng.choice(teams, max(1, teams // 10), replace=False)
+        target = int(len(pods) * churn)
+        removed = 0
+        keep = []
+        for p in pods:
+            t = int(p.metadata.labels["bench-team"][1:])
+            if t in hit and removed < target and rng.rand() < 0.5:
+                removed += 1
+                continue
+            keep.append(p)
+        pods[:] = keep
+        for k in range(removed):
+            pods.append(mk(int(hit[k % len(hit)])))
+
+    incremental.reset()
+    # config 7 runs last in the bench process: collect the earlier
+    # configs' garbage and freeze the survivors so their heap doesn't
+    # tax every tick's collections (the tick loop allocates clones with
+    # GC enabled; only the timed solves run GC-free)
+    gc.collect()
+    gc.freeze()
+    warm_solver = TPUScheduler([nodepool], provider)
+    cold_host, warm_host = [], []
+    identical = 0
+    hit_rates = []
+    last_warm_stats: dict = {}
+    for tick in range(ticks):
+        mutated = tick > 0 and mutate_every > 0 and tick % mutate_every == 0
+        if tick > 0:
+            churn_tick()
+            if mutated:
+                # in-place catalog price mutation + generation bump, and
+                # a pool-template mutation (weight) — both invalidation
+                # classes a live operator sees
+                for it in provider.instance_types[:: max(1, len(provider.instance_types) // 16)]:
+                    for o in it.offerings:
+                        o.price *= 1.01
+                provider.bump_catalog_generation()
+                nodepool.spec.weight = (nodepool.spec.weight or 0) + 1
+        # cold: restart-shaped solve of the same logical tick (fresh
+        # pod/catalog/pool objects, fresh solver, incremental off) —
+        # also the plan-identity oracle. Clone construction happens
+        # outside every timed window; each solve runs GC-free.
+        clone_pods = [clone_pod(i, p) for i, p in enumerate(pods)]
+        cold_provider = FakeCloudProvider()
+        cold_provider.instance_types = clone_catalog()
+        cold_pool = _copy.deepcopy(nodepool)
+        os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+        try:
+            cold_solver = TPUScheduler([cold_pool], cold_provider)
+            with nogc():
+                ref = cold_solver.solve(clone_pods)
+            cold_host.append(cold_solver.last_timings["host_ms"])
+        finally:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        with nogc():
+            res = warm_solver.solve(pods)
+        warm_host.append(warm_solver.last_timings["host_ms"])
+        ref_uid = {p.uid: i for i, p in enumerate(clone_pods)}
+        warm_uid = {p.uid: i for i, p in enumerate(pods)}
+        if canon(ref, ref_uid) == canon(res, warm_uid):
+            identical += 1
+        cs = warm_solver.last_cache_stats or {}
+        if "hit_rate" in cs:
+            hit_rates.append(cs["hit_rate"])
+            last_warm_stats = cs
+    # one no-op tick: unchanged inputs must fully replay
+    with nogc():
+        res = warm_solver.solve(pods)
+    noop_host = warm_solver.last_timings["host_ms"]
+    noop_stats = warm_solver.last_cache_stats or {}
+    gc.unfreeze()
+
+    def pct(a, q):
+        return round(float(np.percentile(np.asarray(a), q)), 2) if a else 0.0
+
+    ratio = (
+        round(pct(cold_host, 50) / pct(warm_host, 50), 2)
+        if warm_host and pct(warm_host, 50) > 0
+        else 0.0
+    )
+    return {
+        "config": f"7: steady-state incremental solve, {len(pods)} pods x {len(provider.instance_types)} types, {ticks} ticks @ {churn:.0%} churn",
+        "ticks": ticks,
+        "plan_identical_ticks": identical,
+        "cold_tick_host_ms_p50": pct(cold_host, 50),
+        "cold_tick_host_ms_p99": pct(cold_host, 99),
+        "warm_tick_host_ms_p50": pct(warm_host, 50),
+        "warm_tick_host_ms_p99": pct(warm_host, 99),
+        "cold_vs_warm_host_p50_ratio": ratio,
+        "noop_tick_host_ms": round(noop_host, 2),
+        "noop_tick_cache": noop_stats,
+        "warm_cache_hit_rate_mean": round(float(np.mean(hit_rates)), 4) if hit_rates else 0.0,
+        "warm_cache_hits": last_warm_stats.get("hits", {}),
+        "warm_cache_misses": last_warm_stats.get("misses", {}),
+        "nodes": res.node_count,
+    }
+
+
 # ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
@@ -847,15 +1093,20 @@ def main() -> None:
         out["probe_error"] = backend_mod.LAST_PROBE_ERROR
 
     try:
-        headline(out)
+        with incremental_off():
+            headline(out)
     except Exception:
         out["error"] = traceback.format_exc()[-1500:]
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6):
+        for fn in (config1, config2, config3, config4, config5, config6, config7):
             try:
-                configs.append(fn())
+                if fn is config7:  # measures the incremental path itself
+                    configs.append(fn())
+                else:
+                    with incremental_off():
+                        configs.append(fn())
             except Exception:
                 configs.append({"config": fn.__name__, "error": traceback.format_exc()[-800:]})
         out["configs"] = configs

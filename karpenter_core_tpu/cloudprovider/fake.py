@@ -174,9 +174,23 @@ class FakeCloudProvider(CloudProvider):
         self.created_node_claims: Dict[str, NodeClaim] = {}
         self.drifted: str = "drifted"
         self._lock = threading.RLock()
+        # catalog generation: None (default) = no signal, the solver
+        # content-fingerprints each solve; once bump_catalog_generation()
+        # is called the CALLER owns invalidation and must bump on every
+        # in-place catalog mutation (bench.py's steady-state config does)
+        self._catalog_generation: Optional[int] = None
 
     def reset(self) -> None:
         self.__init__()
+
+    def catalog_generation(self, nodepool=None) -> Optional[int]:
+        with self._lock:
+            return self._catalog_generation
+
+    def bump_catalog_generation(self) -> int:
+        with self._lock:
+            self._catalog_generation = (self._catalog_generation or 0) + 1
+            return self._catalog_generation
 
     # -- SPI ----------------------------------------------------------------
 

@@ -167,3 +167,12 @@ class CloudProvider(abc.ABC):
     @abc.abstractmethod
     def name(self) -> str:
         ...
+
+    def catalog_generation(self, nodepool: Optional[NodePool] = None) -> Optional[int]:
+        """Monotonic counter bumped on ANY catalog mutation (prices,
+        capacities, offerings, requirements), or None when the provider
+        doesn't maintain one. A non-None value lets the solver's
+        cross-solve catalog/compat caches skip content fingerprinting —
+        the provider then owns invalidation: serving a mutated catalog
+        under an unbumped generation serves stale tensors."""
+        return None

@@ -234,6 +234,20 @@ class Metrics:
             f"{ns}_tpu_solver_device_duration_seconds",
             "Device-attributable time per solve (dispatch + transfer + blocked-on-device)",
         )
+        # steady-state incremental solve (solver/incremental.py): cross-
+        # solve cache traffic, labeled by cache layer (catalog | compat |
+        # route | job | merge | seeds | warmstart)
+        self.solver_cache_hits = r.counter(
+            f"{ns}_tpu_solver_cache_hits", "Cross-solve solver cache hits", ["cache"]
+        )
+        self.solver_cache_misses = r.counter(
+            f"{ns}_tpu_solver_cache_misses", "Cross-solve solver cache misses", ["cache"]
+        )
+        self.solver_cache_evictions = r.counter(
+            f"{ns}_tpu_solver_cache_evictions",
+            "Cross-solve solver cache evictions (LRU caps, env-tunable)",
+            ["cache"],
+        )
         # node/nodepool/pod scrapers (metrics/{node,nodepool,pod})
         self.node_allocatable = r.gauge(f"{ns}_nodes_allocatable", "Node allocatable", ["node", "resource"])
         self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])

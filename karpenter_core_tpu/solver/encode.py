@@ -446,6 +446,10 @@ class SignatureGroup:
     signature: tuple
     exemplar: Pod
     pod_indices: List[int] = field(default_factory=list)  # into the batch array
+    # interned signature id (podcache.intern_sig) — the cross-solve
+    # compat/route cache key. None for ad-hoc groups (relaxation
+    # retries), which bypass every incremental cache.
+    sig_id: Optional[int] = None
 
     def _is_self_term(self, term) -> bool:
         """The term's selector matches the exemplar's own labels in its
@@ -622,7 +626,7 @@ def group_pods(pods: List[Pod], memos=None) -> List[SignatureGroup]:
             m.sig_state = state
         g = get(state[2])
         if g is None:
-            g = SignatureGroup(signature=state[1], exemplar=pod)
+            g = SignatureGroup(signature=state[1], exemplar=pod, sig_id=state[2])
             groups[state[2]] = g
         g.pod_indices.append(i)
     return list(groups.values())

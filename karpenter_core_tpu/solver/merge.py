@@ -61,6 +61,11 @@ _ENGINES = ("vector", "scalar")
 # (block, T, R) broadcast stays small
 _FITS_BLOCK = 128
 
+# optimistic pairwise screen cap: above this many records per bucket the
+# (N, N, R) combined-usage broadcast outgrows its win — fall back to the
+# per-record screen
+_PAIR_MAX = 768
+
 
 def merge_engine() -> str:
     """Active merge engine (env escape hatch; unknown values → vector)."""
@@ -75,6 +80,7 @@ class _Bucket:
     __slots__ = (
         "enc",
         "Z",
+        "T",
         "zone_index",
         "usage",
         "alloc_cap",
@@ -96,6 +102,9 @@ class _Bucket:
         "cl_zid",
         "cl_screen8",
         "cl_fp",
+        "cl_seed",
+        "dirty",
+        "pair_cand",
     )
 
     def __init__(self, solver, records: List[dict], idxs: List[int], scan_cap: int):
@@ -105,6 +114,7 @@ class _Bucket:
         T = len(enc.instance_types)
         Z = len(enc.zones)
         self.Z = Z
+        self.T = T
         self.zone_index = {z: zi for zi, z in enumerate(enc.zones)}
         N = len(idxs)
         R = len(r0["usage"])
@@ -114,48 +124,75 @@ class _Bucket:
         zone_ok = np.empty((N, Z), dtype=bool)
         ct_ok = np.empty((N, len(enc.capacity_types)), dtype=bool)
         self.zid = np.empty(N, dtype=np.int32)
-        viable = np.empty((N, T), dtype=bool)
         for j, i in enumerate(idxs):
             r = records[i]
             self.usage[j] = r["usage"]
             self.alloc_cap[j] = r["alloc_cap"]
             zone_ok[j] = r["zone_ok"]
             ct_ok[j] = r["ct_ok"]
-            viable[j] = r["viable"]
             self.zid[j] = self.zone_index[r["zone"]] if r["zone"] is not None else -1
         self.zone_ok = zone_ok
         self.ct_ok = ct_ok
 
-        # self-fits: types holding each record's OWN usage — combined
-        # usage dominates every member's, so this is a sound screen bit
-        alloc = solver._alloc_full(enc, r0["daemon"])
-        fits = np.empty((N, T), dtype=bool)
-        for s in range(0, N, _FITS_BLOCK):
-            e = min(s + _FITS_BLOCK, N)
-            fits[s:e] = np.all(
-                self.usage[s:e, None, :] <= alloc[None, :, :], axis=-1
-            )
+        # packed screen rows (viable ∧ self-fits ∧ self-offering): a pure
+        # function of each record's content, so records carrying a job-
+        # memo identity reuse last tick's row (solver/incremental.py)
+        # instead of re-broadcasting the (N, T, R) fits check
+        ws = getattr(solver, "_warm", None)
+        stats = getattr(solver, "_cstats", None)
+        rkeys = [records[i].get("_rkey") for i in idxs]
+        self.screen8 = np.empty((N, (T + 7) // 8), dtype=np.uint8)
+        missing: List[int] = []
+        if ws is None:
+            missing = list(range(N))
+        else:
+            for j, rk in enumerate(rkeys):
+                row = ws.screen_rows.get(rk, stats) if rk is not None else None
+                if row is None:
+                    missing.append(j)
+                else:
+                    self.screen8[j] = row
 
-        # self-offering: types with an available offering within the
-        # record's own zone/ct masks (zone-pin narrows to one zone);
-        # records of one pack job share masks, so combos dedupe hard
-        off = np.empty((N, T), dtype=bool)
-        combos: Dict[tuple, np.ndarray] = {}
-        avail = enc.offering_avail
-        for j in range(N):
-            if self.zid[j] >= 0:
-                zsel = np.zeros(Z, dtype=bool)
-                zsel[self.zid[j]] = True
-            else:
-                zsel = zone_ok[j]
-            ckey = (zsel.tobytes(), ct_ok[j].tobytes())
-            v = combos.get(ckey)
-            if v is None:
-                v = avail[:, zsel][:, :, ct_ok[j]].any(axis=(1, 2))
-                combos[ckey] = v
-            off[j] = v
+        if missing:
+            M = len(missing)
+            viable = np.empty((M, T), dtype=bool)
+            for k, j in enumerate(missing):
+                viable[k] = records[idxs[j]]["viable"]
+            # self-fits: types holding each record's OWN usage — combined
+            # usage dominates every member's, so this is a sound screen bit
+            alloc = solver._alloc_full(enc, r0["daemon"])
+            usage_m = self.usage[missing]
+            fits = np.empty((M, T), dtype=bool)
+            for s in range(0, M, _FITS_BLOCK):
+                e = min(s + _FITS_BLOCK, M)
+                fits[s:e] = np.all(
+                    usage_m[s:e, None, :] <= alloc[None, :, :], axis=-1
+                )
 
-        self.screen8 = np.packbits(viable & fits & off, axis=1)
+            # self-offering: types with an available offering within the
+            # record's own zone/ct masks (zone-pin narrows to one zone);
+            # records of one pack job share masks, so combos dedupe hard
+            off = np.empty((M, T), dtype=bool)
+            combos: Dict[tuple, np.ndarray] = {}
+            avail = enc.offering_avail
+            for k, j in enumerate(missing):
+                if self.zid[j] >= 0:
+                    zsel = np.zeros(Z, dtype=bool)
+                    zsel[self.zid[j]] = True
+                else:
+                    zsel = zone_ok[j]
+                ckey = (zsel.tobytes(), ct_ok[j].tobytes())
+                v = combos.get(ckey)
+                if v is None:
+                    v = avail[:, zsel][:, :, ct_ok[j]].any(axis=(1, 2))
+                    combos[ckey] = v
+                off[k] = v
+
+            sub8 = np.packbits(viable & fits & off, axis=1)
+            for k, j in enumerate(missing):
+                self.screen8[j] = sub8[k]
+                if ws is not None and rkeys[j] is not None:
+                    ws.screen_rows.put(rkeys[j], sub8[k].copy(), stats)
 
         # requirement fingerprints interned per bucket; the intersects
         # matrix is EXACT (the scalar's own check, memoized per distinct
@@ -182,6 +219,15 @@ class _Bucket:
         self.cl_zid = np.empty(cap, dtype=np.int32)
         self.cl_screen8 = np.empty((cap, self.screen8.shape[1]), dtype=np.uint8)
         self.cl_fp = np.empty(cap, dtype=np.int32)
+        # optimistic screen state: while no cluster of this bucket has
+        # absorbed anything, every open cluster is bit-identical to its
+        # seed record, so the per-record screen is a row gather from ONE
+        # pairwise record×record candidate matrix (computed lazily).
+        # The first absorb sets ``dirty`` and the bucket falls back to
+        # the per-record broadcast (screen_candidates) for good.
+        self.cl_seed = np.empty(cap, dtype=np.int64)
+        self.dirty = False
+        self.pair_cand: Optional[np.ndarray] = None
 
     # -- fingerprint interning / exact intersects lookups ---------------
 
@@ -222,6 +268,53 @@ class _Bucket:
                 vals[u] = v
         return vals > 0
 
+    def pair_candidates(self, solver) -> np.ndarray:
+        """(N, N) screen verdicts between every record pair of this
+        bucket, condition-for-condition identical to screen_candidates
+        PLUS the exact intersects lookup — valid against any cluster
+        that is still bit-identical to its seed record (no absorbs).
+        Computed once per bucket, lazily."""
+        if self.pair_cand is not None:
+            return self.pair_cand
+        N = self.usage.shape[0]
+        zid = self.zid
+        # zone-pin agreement (cluster axis = columns / seeds)
+        cand = (zid[None, :] == -1) | (zid[:, None] == -1) | (
+            zid[None, :] == zid[:, None]
+        )
+        # both sides carry a requirement fingerprint
+        cand &= (self.rec_fp[:, None] >= 0) & (self.rec_fp[None, :] >= 0)
+        zo = self.zone_ok.astype(np.float32)
+        co = self.ct_ok.astype(np.float32)
+        cand &= (zo @ zo.T) > 0
+        cand &= (co @ co.T) > 0
+        # the effective pinned zone must survive the intersection
+        if self.Z:
+            eff = np.where(zid[None, :] >= 0, zid[None, :], zid[:, None])
+            effc = np.clip(eff, 0, self.Z - 1)
+            rows = np.arange(N)
+            zi_at = self.zone_ok[rows[:, None], effc]
+            zj_at = self.zone_ok[rows[None, :], effc]
+            cand &= (eff < 0) | (zi_at & zj_at)
+        # packed screen masks overlap (viable ∧ fits ∧ offering)
+        sb = np.unpackbits(self.screen8, axis=1)[:, : self.T].astype(np.float32)
+        cand &= (sb @ sb.T) > 0
+        # combined usage within both sides' alloc_cap seeds
+        cand &= np.all(
+            self.usage[:, None, :] + self.usage[None, :, :]
+            <= np.minimum(self.alloc_cap[:, None, :], self.alloc_cap[None, :, :]),
+            axis=-1,
+        )
+        # exact pairwise intersects via the interned fingerprint matrix
+        # (fills the same imat / cross-solve cache the fallback uses)
+        fps = np.unique(self.rec_fp[self.rec_fp >= 0])
+        for fid in fps:
+            self._intersects_row(solver, fps, int(fid))
+        safe = np.clip(self.rec_fp, 0, None)
+        cand &= self.imat[safe[:, None], safe[None, :]] > 0
+        self.pair_cand = cand
+        return cand
+
     # -- cluster state ---------------------------------------------------
 
     def add_cluster(self, m: dict, j: int) -> None:
@@ -237,11 +330,13 @@ class _Bucket:
         self.cl_zid[k] = self.zid[j]
         self.cl_screen8[k] = self.screen8[j]
         self.cl_fp[k] = self.rec_fp[j]
+        self.cl_seed[k] = j
         self.k = k + 1
 
     def absorb(self, k: int, j: int, m: dict) -> None:
         """Fold record j into cluster row k after a successful exact
         merge (m is the cluster dict _merge_pair_exact just updated)."""
+        self.dirty = True  # cluster k no longer mirrors its seed record
         self.cl_usage[k] += self.usage[j]
         if self.cl_zid[k] < 0:
             self.cl_zid[k] = self.zid[j]
@@ -328,25 +423,35 @@ def merge_records_vector(
             K = b.k
             if K and b.rec_fp[j] >= 0:
                 screened += K
-                cand = screen_candidates(
-                    b.cl_zid[:K],
-                    b.cl_fp[:K],
-                    b.cl_zone_ok[:K],
-                    b.cl_ct_ok[:K],
-                    b.cl_screen8[:K],
-                    b.cl_usage[:K],
-                    b.cl_alloc_cap[:K],
-                    b.zid[j],
-                    b.zone_ok[j],
-                    b.ct_ok[j],
-                    b.screen8[j],
-                    b.usage[j],
-                    b.alloc_cap[j],
-                )
-                rows = np.flatnonzero(cand)
-                if rows.size:
-                    ok = b._intersects_row(solver, b.cl_fp[rows], int(b.rec_fp[j]))
-                    rows = rows[ok]
+                if not b.dirty and b.usage.shape[0] <= _PAIR_MAX:
+                    # optimistic path: every open cluster still mirrors
+                    # its seed record, so the row is a gather from the
+                    # pairwise matrix (intersects already folded in)
+                    rows = np.flatnonzero(
+                        b.pair_candidates(solver)[j, b.cl_seed[:K]]
+                    )
+                else:
+                    cand = screen_candidates(
+                        b.cl_zid[:K],
+                        b.cl_fp[:K],
+                        b.cl_zone_ok[:K],
+                        b.cl_ct_ok[:K],
+                        b.cl_screen8[:K],
+                        b.cl_usage[:K],
+                        b.cl_alloc_cap[:K],
+                        b.zid[j],
+                        b.zone_ok[j],
+                        b.ct_ok[j],
+                        b.screen8[j],
+                        b.usage[j],
+                        b.alloc_cap[j],
+                    )
+                    rows = np.flatnonzero(cand)
+                    if rows.size:
+                        ok = b._intersects_row(
+                            solver, b.cl_fp[rows], int(b.rec_fp[j])
+                        )
+                        rows = rows[ok]
                 if rows.size:
                     with tracer.span("pack.merge.apply", candidates=int(rows.size)):
                         for k in rows:

@@ -128,19 +128,31 @@ def _build(pod) -> PodMemo:
 
 
 def get_memos(pods) -> List[PodMemo]:
+    return get_memos_rvs(pods)[0]
+
+
+def get_memos_rvs(pods) -> Tuple[List[PodMemo], List[object]]:
+    """Memos plus the resource_versions read while validating them —
+    one walk serves both the encode path and the incremental solve's
+    replay identity check (solver/incremental.py), which would
+    otherwise re-read every pod's rv."""
     out: List[PodMemo] = []
+    rvs: List[object] = []
     append = out.append
+    rv_append = rvs.append
     build = _build
     for pod in pods:
         d = pod.__dict__
+        rv = pod.metadata.resource_version
+        rv_append(rv)
         cached = d.get("_karp_memo")
-        if cached is not None and cached[0] == pod.metadata.resource_version:
+        if cached is not None and cached[0] == rv:
             append(cached[1])
             continue
         memo = build(pod)
-        d["_karp_memo"] = (pod.metadata.resource_version, memo)
+        d["_karp_memo"] = (rv, memo)
         append(memo)
-    return out
+    return out, rvs
 
 
 def reset() -> None:

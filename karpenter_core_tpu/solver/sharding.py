@@ -25,6 +25,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .pack import ffd_pack
 
+# jax.shard_map landed at top level only in newer jax; older images ship
+# it under jax.experimental.shard_map. Feature-detect once so the
+# sharded pack/screen paths work on both (and skip cleanly on neither).
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # analysis: allow-broad-except — no shard_map in this jax
+        _shard_map = None
+
+
+def shard_map_available() -> bool:
+    """True when this jax exposes shard_map (top-level or experimental)."""
+    return _shard_map is not None
+
+
+def _require_shard_map():
+    if _shard_map is None:
+        raise RuntimeError(
+            "shard_map is unavailable in this jax build "
+            "(neither jax.shard_map nor jax.experimental.shard_map)"
+        )
+    return _shard_map
+
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
     devices = jax.devices()
@@ -75,7 +99,7 @@ def sharded_batch_pack(
         return node_ids, counts, fleet_total
 
     shard = partial(
-        jax.shard_map,
+        _require_shard_map(),
         mesh=mesh,
         in_specs=(P("groups"), P("groups"), P("groups")),
         out_specs=(P("groups"), P("groups"), P()),
@@ -131,7 +155,7 @@ def sharded_prefix_screen(
         return jnp.all(cum_load <= headroom, axis=-1)
 
     shard = partial(
-        jax.shard_map,
+        _require_shard_map(),
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P()),
         out_specs=P(axis),

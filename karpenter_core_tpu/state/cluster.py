@@ -40,6 +40,21 @@ class Cluster:
         self._csi_limits_by_node: Dict[str, Dict[str, int]] = {}
         self._unsynced_start: Optional[float] = None
         self._consolidation_timestamp: float = clock()
+        # monotonic mutation counter: bumped on every state change the
+        # solver's cross-tick caches could observe (nodes, claims, pod
+        # bindings, daemonsets, CSI limits, deletion marks). The
+        # incremental solve (solver/incremental.py) scopes its topology
+        # seed cache to this value — unchanged generation proves the
+        # cluster-derived inputs of a warm solve are unchanged.
+        self._generation: int = 0
+
+    def generation(self) -> int:
+        with self._mu:
+            return self._generation
+
+    def _bump(self) -> None:
+        # callers hold self._mu (RLock) — every mutator below does
+        self._generation += 1
 
     # -- sync gate (cluster.go:89) -----------------------------------------
 
@@ -107,6 +122,7 @@ class Cluster:
 
     def mark_for_deletion(self, *provider_ids: str) -> None:
         with self._mu:
+            self._bump()
             for pid in provider_ids:
                 n = self.nodes.get(pid)
                 if n is not None:
@@ -114,6 +130,7 @@ class Cluster:
 
     def unmark_for_deletion(self, *provider_ids: str) -> None:
         with self._mu:
+            self._bump()
             for pid in provider_ids:
                 n = self.nodes.get(pid)
                 if n is not None:
@@ -123,6 +140,7 @@ class Cluster:
 
     def update_node_claim(self, node_claim: NodeClaim) -> None:
         with self._mu:
+            self._bump()
             if node_claim.status.provider_id:
                 old = self.nodes.get(node_claim.status.provider_id)
                 state = StateNode(old.node if old else None, node_claim)
@@ -136,6 +154,7 @@ class Cluster:
 
     def delete_node_claim(self, name: str) -> None:
         with self._mu:
+            self._bump()
             pid = self.node_claim_name_to_provider_id.pop(name, None)
             if pid:
                 state = self.nodes.get(pid)
@@ -148,6 +167,7 @@ class Cluster:
 
     def update_node(self, node: Node) -> None:
         with self._mu:
+            self._bump()
             pid = node.spec.provider_id or node.name
             old_pid = self.node_name_to_provider_id.get(node.name)
             old = self.nodes.get(pid) or (self.nodes.get(old_pid) if old_pid else None)
@@ -195,6 +215,7 @@ class Cluster:
 
     def delete_node(self, name: str) -> None:
         with self._mu:
+            self._bump()
             # drop cached CSI attach limits so a re-created node with the
             # same name can't inherit stale limits before its CSINode event
             self._csi_limits_by_node.pop(name, None)
@@ -231,6 +252,7 @@ class Cluster:
 
     def update_pod(self, pod: Pod) -> None:
         with self._mu:
+            self._bump()
             if podutils.is_terminal(pod):
                 self._remove_pod_usage((pod.namespace, pod.name))
             else:
@@ -253,6 +275,7 @@ class Cluster:
 
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._mu:
+            self._bump()
             self.anti_affinity_pods.pop((namespace, name), None)
             self._remove_pod_usage((namespace, name))
             self.mark_unconsolidated()
@@ -286,6 +309,7 @@ class Cluster:
             if d.allocatable_count is not None
         }
         with self._mu:
+            self._bump()
             self._csi_limits_by_node[csi_node.name] = limits
             pid = self.node_name_to_provider_id.get(csi_node.name)
             state = self.nodes.get(pid) if pid else None
@@ -294,6 +318,7 @@ class Cluster:
 
     def delete_csi_node(self, name: str) -> None:
         with self._mu:
+            self._bump()
             self._csi_limits_by_node.pop(name, None)
             pid = self.node_name_to_provider_id.get(name)
             state = self.nodes.get(pid) if pid else None
@@ -302,6 +327,7 @@ class Cluster:
 
     def update_daemonset(self, daemonset: DaemonSet) -> None:
         with self._mu:
+            self._bump()
             pod = Pod(spec=daemonset.pod_template_spec)
             pod.metadata.namespace = daemonset.namespace
             pod.metadata.name = f"{daemonset.name}-pod"
@@ -309,6 +335,7 @@ class Cluster:
 
     def delete_daemonset(self, namespace: str, name: str) -> None:
         with self._mu:
+            self._bump()
             self.daemonset_pods.pop((namespace, name), None)
 
     def get_daemonset_pods(self) -> List[Pod]:

@@ -1,9 +1,19 @@
 """Dev-only: profile the config-3 warm solve (cProfile + phase timers).
 
-Usage: python profile_solve.py [pods] [types]
-Env: BENCH_BACKEND=cpu to force the CPU fallback for comparison.
+Usage: python profile_solve.py [pods] [types] [--ticks N] [--churn RATE]
+
+With --ticks, drives N repeated solves through the steady-state
+incremental path (solver/incremental.py) over a churning batch —
+RATE (default 0.05) of the pods are swapped each tick — printing each
+tick's host/device split and cache hit counts, then cProfile of one
+steady-state warm tick. Without --ticks, the original single-solve
+profile runs.
+
+Env: BENCH_BACKEND=cpu to force the CPU fallback for comparison;
+KARPENTER_TPU_INCREMENTAL=0 to profile the cold pipeline tick over tick.
 """
 
+import argparse
 import cProfile
 import io
 import os
@@ -18,7 +28,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import bench
 
 
+def _parse_args():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("pods", nargs="?", type=int, default=50_000)
+    ap.add_argument("types", nargs="?", type=int, default=2_000)
+    ap.add_argument("--ticks", type=int, default=0,
+                    help="steady-state mode: repeated solves with churn")
+    ap.add_argument("--churn", type=float, default=0.05,
+                    help="fraction of pods swapped per tick (with --ticks)")
+    return ap.parse_args()
+
+
 def main():
+    args = _parse_args()
     out = {}
     backend = bench.resolve_backend(out)
     print("backend:", backend, file=sys.stderr)
@@ -33,8 +55,8 @@ def main():
     )
     from karpenter_core_tpu.solver import TPUScheduler
 
-    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
-    n_types = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
+    n_pods = args.pods
+    n_types = args.types
     rng = np.random.RandomState(11)
     provider = FakeCloudProvider()
     provider.instance_types = instance_types(n_types)
@@ -61,6 +83,9 @@ def main():
 
     pods = [constrained(i) for i in range(n_pods)]
     solver = TPUScheduler([nodepool], provider)
+    if args.ticks:
+        _tick_mode(args, solver, pods, constrained, rng)
+        return
     t0 = time.perf_counter()
     solver.solve(pods)
     print(f"cold: {(time.perf_counter()-t0)*1000:.1f} ms", file=sys.stderr)
@@ -88,6 +113,46 @@ def main():
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)
+    print(s.getvalue())
+
+
+def _tick_mode(args, solver, pods, make_pod, rng):
+    """--ticks N --churn RATE: repeated solves through the incremental
+    path, per-tick host/device + cache traffic, then cProfile of one
+    steady-state warm tick."""
+    next_id = [len(pods)]
+
+    def churn():
+        n = max(1, int(len(pods) * args.churn))
+        drop = set(rng.choice(len(pods), n, replace=False).tolist())
+        pods[:] = [p for i, p in enumerate(pods) if i not in drop]
+        for _ in range(n):
+            pods.append(make_pod(next_id[0]))
+            next_id[0] += 1
+
+    for tick in range(args.ticks):
+        if tick:
+            churn()
+        t0 = time.perf_counter()
+        res = solver.solve(pods)
+        dt = (time.perf_counter() - t0) * 1000.0
+        t = solver.last_timings or {}
+        cs = solver.last_cache_stats or {}
+        print(
+            f"tick {tick}: {dt:.1f} ms (host {t.get('host_ms', 0):.1f}, "
+            f"device {t.get('device_ms', 0):.1f}) "
+            f"{res.pods_scheduled} pods, {res.node_count} nodes, "
+            f"cache hit_rate={cs.get('hit_rate', 0)} hits={cs.get('hits', {})} "
+            f"misses={cs.get('misses', {})}",
+            file=sys.stderr,
+        )
+    churn()
+    pr = cProfile.Profile()
+    pr.enable()
+    solver.solve(pods)
+    pr.disable()
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(45)
     print(s.getvalue())
 
 

@@ -17,14 +17,22 @@ from karpenter_core_tpu.disruption.tpu_repack import (
 from karpenter_core_tpu.solver.pack import ffd_pack
 from karpenter_core_tpu.solver.sharding import (
     make_mesh,
+    shard_map_available,
     sharded_batch_pack,
     sharded_compat,
     sharded_prefix_screen,
 )
 
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
-)
+pytestmark = [
+    pytest.mark.skipif(
+        len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+    ),
+    # explicit, not silent: the sharded pack/screen paths need shard_map
+    # (top-level or jax.experimental); without it the mesh tests can't run
+    pytest.mark.skipif(
+        not shard_map_available(), reason="this jax build has no shard_map"
+    ),
+]
 
 
 def test_sharded_batch_pack_matches_single_device():
