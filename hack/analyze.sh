@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Repo-native static analysis, CI/pre-push shape: per-file rules scope to
+# the files changed vs the git merge base; project rules (the cachesound
+# family) always load their configured cross-file module set, so editing
+# solver.py alone still re-proves the key/read-set and generation-bump
+# invariants against state/cluster.py and the provider. Pass --all for a
+# full-repo run (the tier-1 meta-test shape).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [[ "${1:-}" == "--all" ]]; then
+  shift
+  exec python -m karpenter_core_tpu.analysis "$@"
+fi
+exec python -m karpenter_core_tpu.analysis --changed-only "$@"

@@ -22,6 +22,44 @@ from .engine import (
 from .findings import Baseline
 
 
+def _changed_python_files(root):
+    """Absolute paths of .py files changed vs the merge base with the
+    main branch (committed + staged + working tree + untracked), or None
+    when git is unavailable. The merge base degrades to HEAD on main
+    itself, which scopes the run to uncommitted work — the pre-push
+    shape ``hack/analyze.sh`` wants."""
+    import subprocess
+
+    def git(*argv):
+        try:
+            p = subprocess.run(
+                ["git", *argv], cwd=root, capture_output=True, text=True, timeout=15
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return p.stdout if p.returncode == 0 else None
+
+    if git("rev-parse", "--git-dir") is None:
+        return None
+    base = None
+    for ref in ("origin/main", "main", "origin/master", "master"):
+        out = git("merge-base", "HEAD", ref)
+        if out:
+            base = out.strip()
+            break
+    diff = git("diff", "--name-only", base or "HEAD")
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    names = set()
+    for blob in (diff, untracked):
+        if blob:
+            names.update(line.strip() for line in blob.splitlines() if line.strip())
+    return sorted(
+        os.path.join(root, n.replace("/", os.sep))
+        for n in names
+        if n.endswith(".py") and os.path.exists(os.path.join(root, n))
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m karpenter_core_tpu.analysis",
@@ -50,6 +88,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--rules", default=None, help="comma-separated rule subset (see --list-rules)"
     )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="scope the scan to .py files changed vs the git merge base "
+        "(falls back to uncommitted changes; project rules like cachesound "
+        "still load their configured cross-file module set)",
+    )
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument(
         "--contracts",
@@ -65,11 +110,24 @@ def main(argv=None) -> int:
 
     root = repo_root()
     paths = args.paths or [os.path.join(root, "karpenter_core_tpu")]
+    if args.changed_only:
+        changed = _changed_python_files(root)
+        if changed is None:
+            print("--changed-only: not a git checkout, scanning everything")
+        else:
+            paths = [p for p in changed if p.startswith(os.path.join(root, "karpenter_core_tpu"))]
+            if not paths:
+                print("--changed-only: no changed python files; clean")
+                return 0
     rules = args.rules.split(",") if args.rules else None
     baseline_path = args.baseline or default_baseline_path()
     baseline = None if args.no_baseline else Baseline.load(baseline_path)
 
     report = analyze_paths(paths, root=root, baseline=baseline, rules=rules)
+    if args.changed_only:
+        # a scoped scan cannot see the files grandfathered findings live
+        # in — only the full run may police baseline staleness
+        report.stale_baseline = []
 
     if args.write_baseline:
         merged = report.findings + report.baselined

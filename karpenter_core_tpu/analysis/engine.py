@@ -69,9 +69,63 @@ class AnalysisConfig:
         "prefix_screen_kernel",
         "single_screen_kernel",
     )
+    # modules holding cross-solve memoization (ISSUE 5): the cachesound
+    # family verifies every memo key witnesses its read-set here
+    cache_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/incremental.py",
+        "karpenter_core_tpu/solver/podcache.py",
+        "karpenter_core_tpu/solver/solver.py",
+        "karpenter_core_tpu/solver/encode.py",
+        "karpenter_core_tpu/solver/merge.py",
+    )
+    # informer-state modules whose mutators must bump Cluster.generation()
+    state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)
+    # provider modules whose catalog mutators must bump catalog_generation()
+    provider_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/cloudprovider/fake.py",
+        "karpenter_core_tpu/cloudprovider/types.py",
+    )
+    # modules whose cluster-API reads define the generation-relevant
+    # field set (what the solver's caches can actually observe)
+    cluster_consumer_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/solver.py",
+        "karpenter_core_tpu/solver/incremental.py",
+        "karpenter_core_tpu/provisioning/provisioner.py",
+        "karpenter_core_tpu/scheduler/scheduler.py",
+        "karpenter_core_tpu/disruption/helpers.py",
+    )
 
 
 DEFAULT_CONFIG = AnalysisConfig()
+
+
+# ---------------------------------------------------------------------------
+# shared parse cache: one AST per (path, mtime, size) across every rule
+# family AND every analyze_paths call in the process. The tier-1 meta-
+# tests and the cachesound mutation harness re-analyze near-identical
+# file sets dozens of times; without this each run would re-parse the
+# whole package (solver.py alone is ~4.3k lines).
+
+_PARSE_CACHE: Dict[str, Tuple[Tuple[int, int], str, ast.Module]] = {}
+_PARSE_CACHE_MAX = 1024
+
+
+def parse_file(path: str) -> Tuple[str, ast.Module]:
+    """Source + AST for ``path``, cached on (mtime_ns, size). Raises
+    OSError/SyntaxError/UnicodeDecodeError like open/ast.parse."""
+    ap = os.path.abspath(path)
+    st = os.stat(ap)
+    sig = (st.st_mtime_ns, st.st_size)
+    hit = _PARSE_CACHE.get(ap)
+    if hit is not None and hit[0] == sig:
+        return hit[1], hit[2]
+    with open(ap, "r", encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=ap)
+    if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+        _PARSE_CACHE.clear()  # content-addressed: only costs re-parsing
+    _PARSE_CACHE[ap] = (sig, source, tree)
+    return source, tree
 
 
 @dataclass
@@ -105,9 +159,81 @@ def rule(name: str, description: str):
     return deco
 
 
+@dataclass
+class ProjectContext:
+    """What a project-level rule sees: every file of the run, plus
+    on-demand access (through the shared parse cache) to repo modules the
+    rule needs for cross-file reasoning even when the run was scoped to a
+    subset (``--changed-only``)."""
+
+    files: List[FileContext]
+    root: str
+    config: AnalysisConfig
+
+    def __post_init__(self) -> None:
+        self._by_rel: Dict[str, FileContext] = {f.relpath: f for f in self.files}
+
+    def get(self, relpath: str) -> Optional[FileContext]:
+        """The FileContext for a repo-relative path — from this run's
+        set, or loaded (and cached) from disk under ``root``."""
+        ctx = self._by_rel.get(relpath)
+        if ctx is not None:
+            return ctx
+        path = os.path.join(self.root, relpath.replace("/", os.sep))
+        try:
+            source, tree = parse_file(path)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            return None
+        ctx = FileContext(relpath, source, source.splitlines(), tree, self.config)
+        self._by_rel[relpath] = ctx
+        return ctx
+
+    def matching(self, suffixes: Sequence[str]) -> List[FileContext]:
+        """Files participating in a module-scoped project rule: every
+        loaded file whose relpath ends with a configured suffix, plus
+        the configured modules themselves loaded from the repo root —
+        and, for fixture runs rooted outside the package, every file
+        (snippets opt in by not living under karpenter_core_tpu/)."""
+        out: List[FileContext] = []
+        seen = set()
+        for f in self.files:
+            hit = any(f.relpath.endswith(s) for s in suffixes) or not f.relpath.startswith(
+                "karpenter_core_tpu/"
+            )
+            if hit and f.relpath not in seen:
+                seen.add(f.relpath)
+                out.append(f)
+        for s in suffixes:
+            if s in seen:
+                continue
+            ctx = self.get(s)
+            if ctx is not None and ctx.relpath not in seen:
+                seen.add(ctx.relpath)
+                out.append(ctx)
+        return out
+
+
+ProjectRuleFn = Callable[[ProjectContext], Iterable[Finding]]
+
+_PROJECT_RULES: Dict[str, Tuple[ProjectRuleFn, str]] = {}
+
+
+def project_rule(name: str, description: str):
+    """A rule that reasons across files (call graphs, key/read-set
+    comparisons). Runs once per analysis over the whole file set."""
+
+    def deco(fn: ProjectRuleFn) -> ProjectRuleFn:
+        _PROJECT_RULES[name] = (fn, description)
+        return fn
+
+    return deco
+
+
 def registered_rules() -> Dict[str, str]:
     _load_rules()
-    return {name: desc for name, (_, desc) in sorted(_RULES.items())}
+    out = {name: desc for name, (_, desc) in _RULES.items()}
+    out.update({name: desc for name, (_, desc) in _PROJECT_RULES.items()})
+    return dict(sorted(out.items()))
 
 
 _LOADED = False
@@ -116,7 +242,7 @@ _LOADED = False
 def _load_rules() -> None:
     global _LOADED
     if not _LOADED:
-        from . import hygiene, hostsync, locks, tracersafety  # noqa: F401
+        from . import cachesound, hygiene, hostsync, locks, tracersafety  # noqa: F401
 
         _LOADED = True
 
@@ -275,23 +401,37 @@ def analyze_paths(
     selected = {
         name: fn for name, (fn, _) in _RULES.items() if rules is None or name in rules
     }
+    selected_project = {
+        name: fn
+        for name, (fn, _) in _PROJECT_RULES.items()
+        if rules is None or name in rules
+    }
     report = Report()
     raw: List[Finding] = []
+    contexts: List[FileContext] = []
     for path in iter_python_files(paths):
         rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
         rel = rel.replace(os.sep, "/")
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
-            tree = ast.parse(source, filename=rel)
+            source, tree = parse_file(path)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             report.parse_errors.append(f"{rel}: {e}")
             continue
         ctx = FileContext(rel, source, source.splitlines(), tree, config)
+        contexts.append(ctx)
         report.files_scanned += 1
         for fn in selected.values():
             for finding in fn(ctx):
                 if is_suppressed(finding, ctx.lines):
+                    report.suppressed.append(finding)
+                else:
+                    raw.append(finding)
+    if selected_project:
+        pctx = ProjectContext(contexts, os.path.abspath(root), config)
+        for fn in selected_project.values():
+            for finding in fn(pctx):
+                owner = pctx.get(finding.path)
+                if owner is not None and is_suppressed(finding, owner.lines):
                     report.suppressed.append(finding)
                 else:
                     raw.append(finding)

@@ -26,7 +26,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 SEV_ERROR = "error"
 SEV_WARNING = "warning"
 
-_ALLOW_RE = re.compile(r"#\s*analysis:\s*((?:allow-[a-z0-9-]+[,\s]*)+)")
+_ALLOW_RE = re.compile(r"#\s*analysis:\s*((?:allow-[a-z0-9-]+(?:\([^)]*\))?[,\s]*)+)")
+# one marker token: slug + optional parenthesized scope args. A marker
+# WITH args is *scoped* — it does not blanket-suppress the rule on the
+# line; the owning rule reads the args (scoped_marker_args) and decides
+# per-item (cachesound's allow-cache-key(<inputs>) declares which cache
+# inputs are deliberately excluded from the key, not "ignore this site").
+_ALLOW_TOKEN_RE = re.compile(r"allow-([a-z0-9-]+)(?:\(([^)]*)\))?")
 _NOQA_BLE_RE = re.compile(r"#\s*noqa:.*\bBLE001\b")
 
 #: repo-native comment conventions accepted as rule suppressions, beyond
@@ -66,12 +72,39 @@ def allowed_rules_for_line(lines: Sequence[str], line: int) -> set:
             text = lines[ln - 1]
             m = _ALLOW_RE.search(text)
             if m:
-                for tok in re.findall(r"allow-([a-z0-9-]+)", m.group(1)):
-                    out.add(tok)
+                for tok, args in _ALLOW_TOKEN_RE.findall(m.group(1)):
+                    if not args:  # scoped markers don't blanket-suppress
+                        out.add(tok)
             for rule, pat in _ALIAS_PATTERNS.items():
                 if pat.search(text):
                     out.add(rule)
     return out
+
+
+def scoped_marker_args(
+    lines: Sequence[str], line: int, rule: str
+) -> Optional[List[str]]:
+    """Args of a scoped ``# analysis: allow-<rule>(a, b, ...)`` marker at
+    1-based ``line`` (own line or the line above), or None when the line
+    carries no scoped marker for ``rule``. Args are comma/space-separated
+    identifiers-or-paths; everything after `` — `` in an arg is a free-
+    text reason and is dropped."""
+    found: Optional[List[str]] = None
+    for ln in (line, line - 1):
+        if not (1 <= ln <= len(lines)):
+            continue
+        m = _ALLOW_RE.search(lines[ln - 1])
+        if not m:
+            continue
+        for tok, args in _ALLOW_TOKEN_RE.findall(m.group(1)):
+            if tok != rule or not args:
+                continue
+            out = []
+            for part in re.split(r"[,\s]+", args.strip()):
+                if part:
+                    out.append(part)
+            found = (found or []) + out
+    return found
 
 
 def is_suppressed(finding: Finding, lines: Sequence[str]) -> bool:

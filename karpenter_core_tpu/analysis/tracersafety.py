@@ -21,12 +21,23 @@ Also checked: every ``static_argnames`` entry must name a real
 parameter (a typo silently makes the argument traced), and a static
 parameter must not have a mutable (unhashable) default — jit requires
 hashable statics.
+
+``static_argnums`` on METHODS (ISSUE 5 satellite): positional statics
+count ``self`` as argument 0 when jit wraps the unbound function, the
+classic off-by-one. Three checks: an index out of range (silently pins
+nothing), index 0 on a method (pins ``self`` — unhashable instances
+fail at dispatch, hashable ones silently specialize the compile cache
+per instance), and off-by-one *evidence*: the pinned parameter is used
+like an array (arithmetic/jnp ops) while the parameter one position to
+the right is used only in static contexts (``if``/``while`` tests,
+``len``/``range``) — exactly what a forgotten ``self`` offset looks
+like. Prefer ``static_argnames``: names cannot shift.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Set
+from typing import Iterable, List, Set, Tuple
 
 from .engine import FileContext, jit_decoration, rule
 from .findings import SEV_ERROR, Finding
@@ -158,11 +169,84 @@ def _target_names(t: ast.AST) -> List[str]:
 _MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
 
 
+def _name_uses(fn: ast.AST, param: str) -> List[Tuple[ast.Name, ast.AST]]:
+    """(name node, parent) pairs for every Load of ``param`` in ``fn``."""
+    parents: dict = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return [
+        (n, parents.get(n))
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Name) and n.id == param and isinstance(n.ctx, ast.Load)
+    ]
+
+
+def _used_traced_like(fn: ast.AST, param: str) -> bool:
+    """The parameter flows through array-shaped operations."""
+    for n, parent in _name_uses(fn, param):
+        if isinstance(parent, ast.BinOp):
+            return True
+        if isinstance(parent, ast.Call):
+            f = parent.func
+            dn = ""
+            node = f
+            parts = []
+            while isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+            if isinstance(node, ast.Name):
+                parts.append(node.id)
+                dn = ".".join(reversed(parts))
+            if dn.split(".")[0] in ("jnp", "lax", "jax", "np"):
+                return True
+        if isinstance(parent, ast.Subscript) and parent.value is n:
+            return True
+    return False
+
+
+_STATIC_PARENT_FNS = {"len", "range", "isinstance", "type", "hasattr"}
+
+
+def _used_static_only(fn: ast.AST, param: str) -> bool:
+    """Every use of the parameter is hashable/static-shaped: an
+    ``if``/``while`` test, a ``len``/``range`` argument, a subscript
+    index, or a comparison."""
+    uses = _name_uses(fn, param)
+    if not uses:
+        return False
+    tests = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            tests.update(id(x) for x in ast.walk(node.test))
+    for n, parent in uses:
+        if id(n) in tests:
+            continue
+        if (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id in _STATIC_PARENT_FNS
+        ):
+            continue
+        if isinstance(parent, ast.Subscript) and parent.slice is n:
+            continue
+        if isinstance(parent, ast.Compare):
+            continue
+        return False
+    return True
+
+
 @rule(
     "tracer-safety",
     "no Python control flow on traced values in jit/vmap functions; statics must be real, hashable params",
 )
 def check_tracer_safety(ctx: FileContext):
+    method_ids: Set[int] = set()
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef):
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    method_ids.add(id(item))
     for node in ast.walk(ctx.tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
@@ -188,6 +272,56 @@ def check_tracer_safety(ctx: FileContext):
                     ),
                     severity=SEV_ERROR,
                 )
+        # static_argnums checks (ISSUE 5): range, pinned self, and the
+        # bound-method off-by-one (self occupies position 0)
+        is_method = id(node) in method_ids and params[:1] in (["self"], ["cls"])
+        for i in info["static_nums"]:
+            if i >= len(params) or i < -len(params):
+                yield Finding(
+                    rule="tracer-safety",
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    symbol=symbol,
+                    message=(
+                        f"static_argnums entry {i} is out of range for "
+                        f"'{node.name}' ({len(params)} parameters) — it pins "
+                        f"nothing and the intended argument stays traced"
+                    ),
+                    severity=SEV_ERROR,
+                )
+                continue
+            if is_method and params[i % len(params)] in ("self", "cls"):
+                yield Finding(
+                    rule="tracer-safety",
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    symbol=symbol,
+                    message=(
+                        f"static_argnums={i} on method '{node.name}' pins "
+                        f"'{params[i % len(params)]}' — positional statics "
+                        f"count self as argument 0; use static_argnames"
+                    ),
+                    severity=SEV_ERROR,
+                )
+                continue
+            if is_method and 0 < i < len(params) - 1:
+                pinned, shifted = params[i], params[i + 1]
+                if _used_traced_like(node, pinned) and _used_static_only(
+                    node, shifted
+                ):
+                    yield Finding(
+                        rule="tracer-safety",
+                        path=ctx.relpath,
+                        line=node.lineno,
+                        symbol=symbol,
+                        message=(
+                            f"static_argnums={i} on method '{node.name}' pins "
+                            f"'{pinned}' (used like an array) while "
+                            f"'{shifted}' is used only statically — likely a "
+                            f"self off-by-one; use static_argnames"
+                        ),
+                        severity=SEV_ERROR,
+                    )
         # mutable default on a static param — unhashable at dispatch time
         a = node.args
         pos = a.posonlyargs + a.args

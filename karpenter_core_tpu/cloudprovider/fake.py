@@ -192,6 +192,32 @@ class FakeCloudProvider(CloudProvider):
             self._catalog_generation = (self._catalog_generation or 0) + 1
             return self._catalog_generation
 
+    def _dirty_catalog(self) -> None:
+        # callers hold self._lock. Only advances an ACTIVE generation:
+        # while it is None the solver content-fingerprints every solve,
+        # so plain-attribute mutation by older tests stays sound.
+        if self._catalog_generation is not None:
+            self._catalog_generation += 1
+
+    def set_instance_types(self, instance_types: List[InstanceType]) -> None:
+        """Replace the shared catalog. THE catalog mutator to use once
+        ``bump_catalog_generation()`` activated the trusted-generation
+        fast path: it advances the generation with the mutation, so the
+        solver's catalog cache can never serve pre-mutation tensors
+        (enforced by the cache-invalidation analysis rule)."""
+        with self._lock:
+            self.instance_types = list(instance_types)
+            self._dirty_catalog()
+
+    def set_instance_types_for_nodepool(
+        self, nodepool_name: str, instance_types: List[InstanceType]
+    ) -> None:
+        """Per-pool catalog override, generation-correct like
+        ``set_instance_types``."""
+        with self._lock:
+            self.instance_types_for_nodepool[nodepool_name] = list(instance_types)
+            self._dirty_catalog()
+
     # -- SPI ----------------------------------------------------------------
 
     def create(self, node_claim: NodeClaim) -> NodeClaim:
@@ -269,13 +295,14 @@ class FakeCloudProvider(CloudProvider):
             )
 
     def get_instance_types(self, nodepool: Optional[NodePool]) -> List[InstanceType]:
-        if nodepool is not None:
-            if nodepool.name in self.errors_for_nodepool:
-                raise self.errors_for_nodepool[nodepool.name]
-            if nodepool.name in self.instance_types_for_nodepool:
-                return self.instance_types_for_nodepool[nodepool.name]
-        if self.instance_types:
-            return self.instance_types
+        with self._lock:
+            if nodepool is not None:
+                if nodepool.name in self.errors_for_nodepool:
+                    raise self.errors_for_nodepool[nodepool.name]
+                if nodepool.name in self.instance_types_for_nodepool:
+                    return self.instance_types_for_nodepool[nodepool.name]
+            if self.instance_types:
+                return self.instance_types
         return [
             new_instance_type("default-instance-type"),
             new_instance_type("small-instance-type", {"cpu": 2, "memory": "2Gi"}),

@@ -192,6 +192,10 @@ class _Bucket:
             for k, j in enumerate(missing):
                 self.screen8[j] = sub8[k]
                 if ws is not None and rkeys[j] is not None:
+                    # the only solver state read is _alloc_full's
+                    # content-addressed (enc, daemon) table — both are
+                    # fixed by the record's _rkey (job-key identity)
+                    # analysis: allow-cache-key(solver)
                     ws.screen_rows.put(rkeys[j], sub8[k].copy(), stats)
 
         # requirement fingerprints interned per bucket; the intersects
@@ -260,6 +264,12 @@ class _Bucket:
                 ok = cache.get(key)
                 if ok is None:
                     ok = self.fp_reqs[aid].intersects(req_r) is None
+                    # fp_reqs[i] is the Requirements object interned
+                    # UNDER fps[i] (same index, _intern): the key's
+                    # fingerprints are content addresses of exactly the
+                    # two objects intersected; cl_fp/rid/imat only select
+                    # which interned pair is being resolved
+                    # analysis: allow-cache-key(self.fp_reqs, self.imat, cl_fp, rid)
                     cache[key] = ok
                     cache[(fp_r, self.fps[aid])] = ok
                 v = np.int8(1 if ok else 0)

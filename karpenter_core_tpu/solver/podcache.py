@@ -50,12 +50,12 @@ class PodMemo:
     selector_keys: tuple  # label keys this pod's selectors reference
     requests: dict  # interned request ResourceList (do not mutate)
     req_id: int  # interned request-shape id (monotonic, never reused)
-    # (relevant-label-keys fingerprint, signature tuple, interned sig id) —
+    # (relevant-label-keys stable digest, signature tuple, interned sig id) —
     # one field written/read atomically (single reference assignment under
     # the GIL), so concurrent group_pods calls with different fingerprints
     # (provisioner vs disruption threads) can never observe a torn
     # fp/sig/sig_id triple
-    sig_state: Optional[Tuple[int, tuple, int]] = None
+    sig_state: Optional[Tuple[bytes, tuple, int]] = None
 
 
 _REQ_INTERN: Dict[tuple, Tuple[int, dict]] = {}
@@ -94,7 +94,9 @@ def _selector_keys(pod) -> tuple:
                 collect(t.label_selector)
             for w in pa.preferred:
                 collect(w.pod_affinity_term.label_selector)
-    return tuple(keys)
+    # sorted: the key tuple is memo material feeding signature digests —
+    # raw set iteration order is process-unstable (PYTHONHASHSEED)
+    return tuple(sorted(keys))
 
 
 def _intern_requests(requests: dict) -> Tuple[dict, int]:

@@ -68,6 +68,7 @@ from .encode import (
 )
 from .kernels import allowed_host, allowed_kernel, build_compat_inputs, zone_ct_masks
 from . import devicetime, incremental
+from .stablehash import stable_hash
 from ..tracing import tracer
 from .pack import (
     assign_cheapest_types,
@@ -224,12 +225,15 @@ def _requirements_fingerprint(reqs) -> tuple:
     return reqs.fingerprint()
 
 
-def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
+def _catalog_fingerprint(catalog: List[InstanceType]) -> bytes:
     """Content fingerprint catching mutation of the fields the encoding
     depends on: requirements (by value — an id() check would alias a
     replaced object onto a freed one's recycled id and serve stale
-    masks), capacity, and the full offering tuples."""
-    return hash(
+    masks), capacity, and the full offering tuples. A process-stable
+    digest (stablehash), not builtin ``hash()``: the bench's restart-
+    shaped cold solver and any future checkpointed warm state must
+    reproduce it under a different PYTHONHASHSEED."""
+    return stable_hash(
         tuple(
             (
                 it.name,
@@ -248,7 +252,10 @@ def _catalog_fingerprint(catalog: List[InstanceType]) -> int:
 def _catalog_entry(
     catalog: List[InstanceType], generation: Optional[int] = None, stats=None
 ) -> _CatalogEntry:
-    key = tuple(map(id, catalog))
+    # identity key, never persisted: the entry holds strong refs (no id
+    # recycling while cached) and every lookup revalidates content via
+    # generation or fingerprint below
+    key = tuple(map(id, catalog))  # analysis: allow-cache-determinism(id)
     if generation is not None:
         # trusted-generation fast path: the provider bumps its counter
         # on every catalog mutation, so an unchanged generation skips
@@ -275,6 +282,10 @@ def _catalog_entry(
         axis = build_catalog_axis(catalog)
         enc = encode_instance_types(list(catalog), axis, vocab)
         entry = _CatalogEntry(list(catalog), fp, vocab, axis, enc, generation=generation)
+        # generation is not key material — it is the guard every lookup
+        # above revalidates (entry.generation == generation, else content
+        # fingerprint), stored alongside the value
+        # analysis: allow-cache-key(generation)
         _CATALOG_CACHE[key] = entry
         _CATALOG_CACHE.move_to_end(key)
         while len(_CATALOG_CACHE) > _catalog_cache_max():
@@ -2241,6 +2252,10 @@ class TPUScheduler:
                         self.kube_client, group.exemplar, constraint, self._batch_uids
                     )
                 if skey is not None:
+                    # the kube-visible pod/node state the counts derive
+                    # from is witnessed by the cluster-generation guard
+                    # (state/cluster.py bumps on every informer event)
+                    # analysis: allow-cache-key(self.kube_client)
                     ws.seeds_put(skey, gen, seeds, self._cstats)
             self._seed_cache[key] = seeds
         return seeds
@@ -3611,6 +3626,11 @@ class TPUScheduler:
                     mi += 1
                     skel = self._job_skeleton(meta, node_ids, int(node_count))
                     if keys[i] is not None:
+                        # meta["reqs"] is the job's request matrix (keyed
+                        # by its blake2b digest via the job tuple) and
+                        # meta["alloc"] is _alloc_full(enc, daemon)[viable]
+                        # — every constituent is in the key
+                        # analysis: allow-cache-key(metas.reqs, metas.alloc)
                         ws.jobs.put(keys[i], skel, self._cstats)
                 self._emit_skeleton(
                     meta, skel, keys[i], pods, result, records, merge_all
@@ -3621,6 +3641,10 @@ class TPUScheduler:
         finalize read. Two ticks producing equal keys provably produce
         identical skeletons (the computation is deterministic), which is
         what keeps warm solves plan-identical to cold ones."""
+        # identity lookup, revalidated: _enc_keys maps id(enc) to
+        # (id(entry), entry.fingerprint) captured under _CATALOG_LOCK, and
+        # the fingerprint rides in the key — a recycled id cannot alias
+        # analysis: allow-cache-determinism(id)
         enc_key = self._enc_keys.get(id(meta["enc"])) if hasattr(self, "_enc_keys") else None
         if enc_key is None or self._warm is None:
             return None
@@ -3928,6 +3952,10 @@ class TPUScheduler:
                     else:
                         emitted = (-1, None, None, 0.0, True)
                     if trail is not None:
+                        # the emitted tuple reads back the plan just
+                        # appended to result — an output echo of the
+                        # trail-identified fold, not an independent input
+                        # analysis: allow-cache-key(result)
                         ws.emits.put(trail, emitted, self._cstats)
                 if clusters is not None:
                     if trail is None:
@@ -3935,6 +3963,11 @@ class TPUScheduler:
                     else:
                         clusters.append((trail,) + emitted)
         if mkey is not None and clusters is not None:
+            # the skeleton stores (a) emitted choices read back from
+            # result (output echo, see the emit memo above) and (b) the
+            # absorb count from _merge_stats telemetry — both are
+            # products of the keyed record stream, not inputs to it
+            # analysis: allow-cache-key(result, self._merge_stats)
             ws.merges.put(
                 mkey,
                 incremental.MergeSkeleton(

@@ -1,0 +1,85 @@
+"""Process-stable content digests for cache keys and fingerprints.
+
+Builtin ``hash()`` is salted per interpreter (PYTHONHASHSEED), and
+``id()`` is an address — neither is a content address. Every
+fingerprint that two *processes* must agree on (the solve-trace replay
+comparisons, the bench cold/warm plan-identity oracle which restarts
+the "cold" solver, any future checkpointed warm state) goes through
+``stable_hash`` instead: a blake2b digest over a canonical, type-tagged
+encoding. The `cache-determinism` analysis rule (analysis/cachesound.py)
+flags ``hash()``/``id()``/set-iteration in key construction so new
+fingerprints cannot silently regress to salted hashing.
+
+Normalization rules (the part builtin hashing gets wrong silently):
+
+- floats encode as IEEE-754 big-endian bytes with ``-0.0`` folded onto
+  ``0.0`` and every NaN folded onto one canonical NaN — equal values
+  digest equally, and no float ever round-trips through ``str``;
+- ints encode by value (no word-size/overflow dependence), bools are
+  tagged distinctly from ints (``True`` must not collide with ``1``
+  keying a different computation);
+- sets and dicts are REJECTED (TypeError): iteration order is exactly
+  the instability this module exists to exclude. Callers sort first —
+  ``tuple(sorted(...))`` — which also documents the canonical order at
+  the call site.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+_CANON_NAN = struct.pack(">d", float("nan"))
+
+
+def _feed(h, value) -> None:
+    # bool before int: True is an int subclass but must tag differently
+    if value is None:
+        h.update(b"N")
+    elif value is True:
+        h.update(b"T")
+    elif value is False:
+        h.update(b"F")
+    elif isinstance(value, int):
+        b = str(value).encode()
+        h.update(b"i%d:" % len(b))
+        h.update(b)
+    elif isinstance(value, float):
+        if value != value:  # NaN (any payload) -> one canonical NaN
+            h.update(b"f")
+            h.update(_CANON_NAN)
+        else:
+            if value == 0.0:
+                value = 0.0  # fold -0.0 onto +0.0
+            h.update(b"f")
+            h.update(struct.pack(">d", value))
+    elif isinstance(value, str):
+        b = value.encode("utf-8")
+        h.update(b"s%d:" % len(b))
+        h.update(b)
+    elif isinstance(value, (bytes, bytearray, memoryview)):
+        b = bytes(value)
+        h.update(b"b%d:" % len(b))
+        h.update(b)
+    elif isinstance(value, (tuple, list)):
+        h.update(b"(%d:" % len(value))
+        for item in value:
+            _feed(h, item)
+        h.update(b")")
+    else:
+        # sets/dicts/objects: iteration order or default repr would leak
+        # process-unstable material into the digest — make the caller
+        # normalize (tuple(sorted(...))) so the canonical order is visible
+        raise TypeError(
+            f"stable_hash: unhashable-canonically type {type(value).__name__}; "
+            f"normalize to sorted tuples first"
+        )
+
+
+def stable_hash(value, digest_size: int = 16) -> bytes:
+    """128-bit content digest of a canonical scalar/tuple tree. Equal
+    trees digest equally in every interpreter; unequal trees collide
+    with blake2b probability only."""
+    h = hashlib.blake2b(digest_size=digest_size)
+    _feed(h, value)
+    return h.digest()

@@ -358,5 +358,14 @@ class Cluster:
             return self._consolidation_timestamp
 
     def reset(self) -> None:
-        """Testing support (cluster.go:328)."""
+        """Testing support (cluster.go:328). The generation counter must
+        stay monotonic ACROSS resets: ``__init__`` would restart it at 0,
+        and a warm solver whose seed cache was stamped at generation g
+        would treat a post-reset cluster that mutated back up to g as
+        unchanged — serving seed counts from the pre-reset world. The
+        cache-invalidation analysis rule treats this direct write as the
+        bump it is."""
+        gen = self.generation()
         self.__init__(self.kube_client, self.cloud_provider, self.clock)
+        with self._mu:
+            self._generation = gen + 1

@@ -1,0 +1,596 @@
+"""Tier-1 gate for the cache-soundness analysis family (ISSUE 5).
+
+Four layers:
+
+- per-rule fixture tests: positive snippet -> finding, negative ->
+  clean, scoped ``allow-cache-key(<input>)`` markers exclude exactly the
+  declared inputs (not the whole rule);
+- the MUTATION-KILL meta-test: mutants seeded into copies of the real
+  solver/state/provider sources (one dropped key component per real
+  cache, a deleted ``Cluster.generation()`` bump, a deleted catalog-
+  generation bump, salted/unordered fingerprints) must each be detected
+  as a NEW finding with the correct rule id, with an overall kill rate
+  >= 95%;
+- the full-repo meta-test: the repo analyzes clean with ZERO baseline
+  entries for the cachesound family (the two ``hash()`` fingerprints
+  were fixed, not grandfathered);
+- tracer-safety ``static_argnums`` extensions (self offset).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from karpenter_core_tpu.analysis import analyze_paths, analyze_repo
+from karpenter_core_tpu.analysis.engine import default_baseline_path
+from karpenter_core_tpu.analysis.findings import (
+    Baseline,
+    allowed_rules_for_line,
+    scoped_marker_args,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CACHESOUND = ["cache-key", "cache-invalidation", "cache-determinism"]
+
+
+def run_snippet(tmp_path, code, rules=CACHESOUND, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return analyze_paths([str(p)], root=str(tmp_path), rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# cache-key fixtures
+
+MEMO_CLASS = """
+    class Solver:
+        def __init__(self):
+            self.jobs = LRU("job")
+
+        def compute(self, a, b, stats):
+            key = __KEY__
+            v = self.jobs.get(key, stats)
+            if v is None:
+                v = a.sum() + b.sum()
+                __MARKER__
+                self.jobs.put(key, v, stats)
+            return v
+"""
+
+
+def test_cache_key_positive_unwitnessed_input(tmp_path):
+    code = MEMO_CLASS.replace("__KEY__", "(a.tobytes(),)").replace("__MARKER__", "pass")
+    report = run_snippet(tmp_path, code)
+    msgs = [f for f in report.findings if f.rule == "cache-key"]
+    assert len(msgs) == 1
+    assert "'b'" in msgs[0].message
+    assert msgs[0].symbol == "Solver.compute"
+
+
+def test_cache_key_negative_complete_key(tmp_path):
+    code = MEMO_CLASS.replace("__KEY__", "(a.tobytes(), b.tobytes())").replace(
+        "__MARKER__", "pass"
+    )
+    assert run_snippet(tmp_path, code).findings == []
+
+
+def test_cache_key_scoped_marker_excludes_only_declared_input(tmp_path):
+    # allow-cache-key(b) silences the b finding...
+    code = MEMO_CLASS.replace("__KEY__", "(a.tobytes(),)").replace(
+        "__MARKER__", "# analysis: allow-cache-key(b) — derived from a upstream"
+    )
+    assert run_snippet(tmp_path, code).findings == []
+    # ...but NOT an undeclared one: same marker, extra input c
+    code2 = (
+        MEMO_CLASS.replace("__KEY__", "(a.tobytes(),)")
+        .replace("__MARKER__", "# analysis: allow-cache-key(b) — derived")
+        .replace("v = a.sum() + b.sum()", "v = a.sum() + b.sum() + c.sum()")
+        .replace("def compute(self, a, b, stats):", "def compute(self, a, b, c, stats):")
+    )
+    report = run_snippet(tmp_path, code2)
+    assert [f.message for f in report.findings if "'c'" in f.message]
+    assert not [f for f in report.findings if "'b'" in f.message]
+
+
+def test_cache_key_split_site_drift(tmp_path):
+    code = """
+        class Solver:
+            def __init__(self):
+                self.jobs = LRU("job")
+
+            def compute(self, a, b, stats):
+                v = self.jobs.get((a.tobytes(),), stats)
+                if v is None:
+                    v = a.sum()
+                    self.jobs.put((a.tobytes(), b.tobytes()), v, stats)
+                return v
+    """
+    report = run_snippet(tmp_path, code)
+    drift = [f for f in report.findings if "split-site key drift" in f.message]
+    assert drift and "'b'" in drift[0].message
+
+
+def test_cache_key_generation_guard_witnesses(tmp_path):
+    # the seeds_get/seeds_put accessor pair carries an explicit guard arg
+    code = """
+        class Solver:
+            def seeds(self, ws, constraint, stats):
+                gen = self._cluster_gen
+                key = (constraint.topology_key,)
+                v = ws.seeds_get(key, gen, stats)
+                if v is None:
+                    v = count(constraint)
+                    ws.seeds_put(key, gen, v, stats)
+                return v
+    """
+    assert run_snippet(tmp_path, code).findings == []
+
+
+# ---------------------------------------------------------------------------
+# cache-invalidation fixtures
+
+CLUSTER_FIXTURE = """
+    class Cluster:
+        def __init__(self):
+            self._generation = 0
+            self.nodes = {}
+            self.bindings = {}
+            self._ts = 0.0
+
+        def generation(self):
+            return self._generation
+
+        def _bump(self):
+            self._generation += 1
+
+        def update_node(self, name, n):
+            __BODY__
+
+        def delete_node(self, name):
+            self._bump()
+            self.nodes.pop(name, None)
+
+        def touch(self):
+            self._ts = 1.0  # not cache-observable: no bump required
+
+
+    def consumer(solver):
+        return solver.cluster.nodes, solver.cluster.bindings
+"""
+
+
+def test_cache_invalidation_positive_missing_bump(tmp_path):
+    code = CLUSTER_FIXTURE.replace("__BODY__", "self.nodes[name] = n")
+    report = run_snippet(tmp_path, code, rules=["cache-invalidation"])
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.symbol == "Cluster.update_node"
+    assert "'nodes'" in f.message and "generation()" in f.message
+
+
+def test_cache_invalidation_negative_bumped(tmp_path):
+    code = CLUSTER_FIXTURE.replace(
+        "__BODY__", "self._bump()\n            self.nodes[name] = n"
+    )
+    assert run_snippet(tmp_path, code, rules=["cache-invalidation"]).findings == []
+
+
+def test_cache_invalidation_private_helper_covered_by_callers(tmp_path):
+    code = """
+        class Cluster:
+            def __init__(self):
+                self._generation = 0
+                self.nodes = {}
+
+            def generation(self):
+                return self._generation
+
+            def _bump(self):
+                self._generation += 1
+
+            def update(self, k, v):
+                self._bump()
+                self._store(k, v)
+
+            def _store(self, k, v):
+                self.nodes[k] = v
+
+
+        def consumer(s):
+            return s.cluster.nodes
+    """
+    assert run_snippet(tmp_path, code, rules=["cache-invalidation"]).findings == []
+
+
+def test_cache_invalidation_constant_write_is_reset_not_bump(tmp_path):
+    # re-seating the counter at a constant can repeat past values: a
+    # generation-scoped cache would alias pre/post states
+    code = CLUSTER_FIXTURE.replace(
+        "__BODY__", "self._generation = 7\n            self.nodes[name] = n"
+    )
+    report = run_snippet(tmp_path, code, rules=["cache-invalidation"])
+    assert len(report.findings) == 1
+
+
+def test_cache_invalidation_provider_catalog(tmp_path):
+    code = """
+        class Provider:
+            def __init__(self):
+                self._catalog_generation = None
+                self.instance_types = []
+
+            def catalog_generation(self, nodepool=None):
+                return self._catalog_generation
+
+            def get_instance_types(self, nodepool):
+                return self.instance_types
+
+            def set_instance_types(self, its):
+                self.instance_types = list(its)
+    """
+    report = run_snippet(tmp_path, code, rules=["cache-invalidation"])
+    assert len(report.findings) == 1
+    assert "catalog" in report.findings[0].message
+    fixed = code.replace(
+        "self.instance_types = list(its)",
+        "self.instance_types = list(its)\n"
+        "                self._catalog_generation = (self._catalog_generation or 0) + 1",
+    )
+    assert run_snippet(tmp_path, fixed, rules=["cache-invalidation"]).findings == []
+
+
+# ---------------------------------------------------------------------------
+# cache-determinism fixtures
+
+
+def test_determinism_hash_in_cache_module(tmp_path):
+    report = run_snippet(
+        tmp_path, "def anything(x):\n    return hash(x)\n", rules=["cache-determinism"]
+    )
+    assert len(report.findings) == 1
+    assert "PYTHONHASHSEED" in report.findings[0].message
+
+
+def test_determinism_id_in_key_builder(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        "def make_key(x):\n    return (id(x),)\n",
+        rules=["cache-determinism"],
+    )
+    assert [f for f in report.findings if "id()" in f.message]
+
+
+def test_determinism_set_iteration_and_sorted_fix(tmp_path):
+    bad = "def fingerprint(xs):\n    s = {x for x in xs}\n    return tuple(s)\n"
+    good = "def fingerprint(xs):\n    s = {x for x in xs}\n    return tuple(sorted(s))\n"
+    assert [
+        f
+        for f in run_snippet(tmp_path, bad, rules=["cache-determinism"]).findings
+        if "set iteration" in f.message
+    ]
+    assert run_snippet(tmp_path, good, rules=["cache-determinism"]).findings == []
+
+
+def test_determinism_repr_in_key(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        "def route_key(g):\n    return (repr(g),)\n",
+        rules=["cache-determinism"],
+    )
+    assert [f for f in report.findings if "repr()" in f.message]
+
+
+def test_determinism_float_str_in_digest(tmp_path):
+    report = run_snippet(
+        tmp_path,
+        "def job_digest(h, price):\n    h.update(str(price / 3.0).encode())\n"
+        "    return h.digest()\n",
+        rules=["cache-determinism"],
+    )
+    assert [f for f in report.findings if "float" in f.message]
+
+
+def test_determinism_traced_value_into_key(tmp_path):
+    # ffd_pack is a configured device producer: its result in a key is a
+    # tracer leak AND a soundness bug
+    code = """
+        class Solver:
+            def __init__(self):
+                self.jobs = LRU("job")
+
+            def compute(self, a, stats):
+                key = (ffd_pack(a),)
+                v = self.jobs.get(key, stats)
+                if v is None:
+                    v = a.sum()
+                    self.jobs.put(key, v, stats)
+                return v
+    """
+    report = run_snippet(tmp_path, code, rules=["cache-determinism"])
+    assert [f for f in report.findings if "traced" in f.message]
+
+
+def test_determinism_scoped_id_marker(tmp_path):
+    code = (
+        "def make_key(x):\n"
+        "    return (id(x),)  # analysis: allow-cache-determinism(id) — strong ref held\n"
+    )
+    assert run_snippet(tmp_path, code, rules=["cache-determinism"]).findings == []
+
+
+# ---------------------------------------------------------------------------
+# scoped marker mechanics (findings.py)
+
+
+def test_scoped_marker_not_blanket_suppression():
+    lines = ["x = f()  # analysis: allow-cache-key(b, meta.alloc) — why"]
+    assert "cache-key" not in allowed_rules_for_line(lines, 1)
+    assert scoped_marker_args(lines, 1, "cache-key") == ["b", "meta.alloc"]
+    assert scoped_marker_args(lines, 1, "cache-determinism") is None
+    bare = ["x = f()  # analysis: allow-cache-key — site-wide"]
+    assert "cache-key" in allowed_rules_for_line(bare, 1)
+
+
+# ---------------------------------------------------------------------------
+# tracer-safety static_argnums extensions
+
+
+def test_static_argnums_pins_self_on_method(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+
+        class K:
+            @partial(jax.jit, static_argnums=(0,))
+            def run(self, x, n):
+                return x * n
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert [f for f in report.findings if "pins 'self'" in f.message]
+
+
+def test_static_argnums_out_of_range(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(5,))
+        def run(x, n):
+            return x * n
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert [f for f in report.findings if "out of range" in f.message]
+
+
+def test_static_argnums_self_offset_evidence(tmp_path):
+    # intent: pin n (static). Written as 1, which pins x (the array)
+    # because self occupies position 0 — n stays traced.
+    code = """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        class K:
+            @partial(jax.jit, static_argnums=(1,))
+            def run(self, x, n):
+                y = jnp.exp(x) + x
+                if n > 4:
+                    return y
+                return y * 2
+    """
+    report = run_snippet(tmp_path, code, rules=["tracer-safety"])
+    assert [f for f in report.findings if "off-by-one" in f.message]
+    # correctly pinned via names: clean
+    good = code.replace('static_argnums=(1,)', 'static_argnames="n"')
+    assert run_snippet(tmp_path, good, rules=["tracer-safety"]).findings == []
+
+
+# ---------------------------------------------------------------------------
+# mutation-kill harness: the analyzer must detect realistic regressions
+# seeded into copies of the REAL sources
+
+_MUT_FILES = [
+    "karpenter_core_tpu/solver/incremental.py",
+    "karpenter_core_tpu/solver/podcache.py",
+    "karpenter_core_tpu/solver/solver.py",
+    "karpenter_core_tpu/solver/encode.py",
+    "karpenter_core_tpu/solver/merge.py",
+    "karpenter_core_tpu/state/cluster.py",
+    "karpenter_core_tpu/cloudprovider/fake.py",
+    "karpenter_core_tpu/cloudprovider/types.py",
+    "karpenter_core_tpu/provisioning/provisioner.py",
+    "karpenter_core_tpu/scheduler/scheduler.py",
+    "karpenter_core_tpu/disruption/helpers.py",
+]
+
+# (name, file, old, new, expected-rule). One dropped key component per
+# real cache in solver/incremental.py — route, compat, job, merge, emit,
+# mergerow, seed, intersects — plus the pod-memo rv guard, deleted
+# generation bumps (cluster + catalog), and determinism regressions.
+_MUTANTS = [
+    ("route-key-drop", "karpenter_core_tpu/solver/solver.py",
+     "key = incremental.route_key(groups) if ws is not None else None",
+     "key = () if ws is not None else None", "cache-key"),
+    ("job-key-drop-viable", "karpenter_core_tpu/solver/solver.py",
+     '            meta["viable_idx"].tobytes(),\n', "", "cache-key"),
+    ("merge-key-drop-stream", "karpenter_core_tpu/solver/solver.py",
+     '                tuple(r["_rkey"] for r in records),\n', "", "cache-key"),
+    ("emit-key-drop-trail", "karpenter_core_tpu/solver/solver.py",
+     "trail = trails[ci] if trails is not None else None",
+     "trail = ci if trails is not None else None", "cache-key"),
+    ("seed-key-drop-exclusion", "karpenter_core_tpu/solver/solver.py",
+     "skey = key + (self._seed_exclusion_key(),)", "skey = key", "cache-key"),
+    ("compat-key-drop-poolfp", "karpenter_core_tpu/solver/solver.py",
+     "(pool_fp, sid),", "(sid,),", "cache-key"),
+    ("mergerow-key-drop-rkey", "karpenter_core_tpu/solver/merge.py",
+     'rkeys = [records[i].get("_rkey") for i in idxs]',
+     "rkeys = [i for i in idxs]", "cache-key"),
+    ("intersects-key-drop-side", "karpenter_core_tpu/solver/solver.py",
+     'ikey = (m["merged"].fingerprint(), r["merged"].fingerprint())',
+     'ikey = (m["merged"].fingerprint(),)', "cache-key"),
+    ("podmemo-rv-drop", "karpenter_core_tpu/solver/podcache.py",
+     'd["_karp_memo"] = (rv, memo)', 'd["_karp_memo"] = (0, memo)', "cache-key"),
+    ("cluster-bump-del-update-node", "karpenter_core_tpu/state/cluster.py",
+     "def update_node(self, node: Node) -> None:\n        with self._mu:\n            self._bump()",
+     "def update_node(self, node: Node) -> None:\n        with self._mu:",
+     "cache-invalidation"),
+    ("cluster-bump-del-update-pod", "karpenter_core_tpu/state/cluster.py",
+     "def update_pod(self, pod: Pod) -> None:\n        with self._mu:\n            self._bump()",
+     "def update_pod(self, pod: Pod) -> None:\n        with self._mu:",
+     "cache-invalidation"),
+    ("cluster-bump-del-mark-deletion", "karpenter_core_tpu/state/cluster.py",
+     "def mark_for_deletion(self, *provider_ids: str) -> None:\n        with self._mu:\n            self._bump()",
+     "def mark_for_deletion(self, *provider_ids: str) -> None:\n        with self._mu:",
+     "cache-invalidation"),
+    ("catalog-bump-del-set-types", "karpenter_core_tpu/cloudprovider/fake.py",
+     "self.instance_types = list(instance_types)\n            self._dirty_catalog()",
+     "self.instance_types = list(instance_types)", "cache-invalidation"),
+    ("catalog-bump-noop-dirty", "karpenter_core_tpu/cloudprovider/fake.py",
+     "if self._catalog_generation is not None:\n            self._catalog_generation += 1",
+     "if self._catalog_generation is not None:\n            pass",
+     "cache-invalidation"),
+    ("hash-sig-fingerprint", "karpenter_core_tpu/solver/encode.py",
+     "fp = stable_hash(tuple(sorted(relevant)))",
+     "fp = hash(tuple(sorted(relevant)))", "cache-determinism"),
+    ("hash-catalog-fingerprint", "karpenter_core_tpu/solver/solver.py",
+     "    return stable_hash(\n        tuple(",
+     "    return hash(\n        tuple(", "cache-determinism"),
+    ("set-iter-pool-fingerprint", "karpenter_core_tpu/solver/incremental.py",
+     "tuple(\n            sorted((t.key, t.value, t.effect) for t in np_.spec.template.taints)\n        ),",
+     "tuple({(t.key, t.value, t.effect) for t in np_.spec.template.taints}),",
+     "cache-determinism"),
+    ("repr-route-key", "karpenter_core_tpu/solver/incremental.py",
+     "key = tuple(g.sig_id for g in groups)",
+     "key = tuple(repr(g) for g in groups)", "cache-determinism"),
+    ("id-into-job-digest", "karpenter_core_tpu/solver/incremental.py",
+     "    h.update(reqs.tobytes())",
+     "    h.update(reqs.tobytes())\n    h.update(str(id(reqs)).encode())",
+     "cache-determinism"),
+    ("float-str-into-job-digest", "karpenter_core_tpu/solver/incremental.py",
+     "    h.update(str(reqs.shape).encode())",
+     "    h.update(str(float(reqs.sum()) / 3.0).encode())", "cache-determinism"),
+    ("set-iter-selector-keys", "karpenter_core_tpu/solver/podcache.py",
+     "return tuple(sorted(keys))", "return tuple(keys)", "cache-determinism"),
+]
+
+#: acceptance-critical mutant classes: each must be killed individually
+_MANDATORY = {
+    "route-key-drop", "job-key-drop-viable", "merge-key-drop-stream",
+    "emit-key-drop-trail", "seed-key-drop-exclusion", "compat-key-drop-poolfp",
+    "mergerow-key-drop-rkey",
+    "cluster-bump-del-update-node", "catalog-bump-del-set-types",
+}
+
+
+def _build_tree(root):
+    for rel in _MUT_FILES:
+        dst = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copyfile(os.path.join(REPO, rel), dst)
+
+
+def _analyze_tree(root):
+    return analyze_paths(
+        [os.path.join(root, "karpenter_core_tpu")], root=str(root), rules=CACHESOUND
+    )
+
+
+def test_unmutated_sources_are_clean(tmp_path):
+    _build_tree(str(tmp_path))
+    report = _analyze_tree(str(tmp_path))
+    assert report.findings == [], [f.format() for f in report.findings]
+
+
+def test_mutation_kill_rate(tmp_path):
+    killed, missed = [], []
+    for i, (name, rel, old, new, rule) in enumerate(_MUTANTS):
+        root = str(tmp_path / f"m{i}")
+        _build_tree(root)
+        p = os.path.join(root, rel)
+        with open(p, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert old in src, f"mutant {name}: anchor drifted — update the harness"
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(src.replace(old, new, 1))
+        report = _analyze_tree(root)
+        # a NEW finding with the expected rule id (the clean tree has none)
+        if any(f.rule == rule for f in report.findings):
+            killed.append(name)
+        else:
+            missed.append(name)
+    assert not (_MANDATORY & set(missed)), f"mandatory mutants survived: {missed}"
+    rate = len(killed) / len(_MUTANTS)
+    assert rate >= 0.95, f"kill rate {rate:.2f}; survivors: {missed}"
+
+
+# ---------------------------------------------------------------------------
+# full-repo meta-tests
+
+
+def test_repo_is_cachesound_clean():
+    report = analyze_repo(rules=CACHESOUND)
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.parse_errors == []
+
+
+def test_baseline_has_zero_cachesound_entries():
+    # the two hash() fingerprints were FIXED, not grandfathered
+    baseline = Baseline.load(default_baseline_path())
+    family = [e for e in baseline.entries if e["rule"].startswith("cache-")]
+    assert family == []
+
+
+def test_every_incremental_cache_has_a_detected_site():
+    """The site detector must keep covering every LRU the incremental
+    module constructs — a cache added without detection would silently
+    fall outside the gate."""
+    from karpenter_core_tpu.analysis.cachesound import (
+        _shared_analyzer,
+        _shared_sites,
+    )
+    from karpenter_core_tpu.analysis.engine import (
+        DEFAULT_CONFIG,
+        ProjectContext,
+        repo_root,
+    )
+
+    pctx = ProjectContext([], repo_root(), DEFAULT_CONFIG)
+    an = _shared_analyzer(pctx)
+    covered = {site.spec.name for site in _shared_sites(an).values()}
+    declared = set(an.registry.attrs[a].name for a in an.registry.attrs)
+    # every discovered LRU cache name must appear at >= 1 site
+    import re
+
+    inc = open(
+        os.path.join(REPO, "karpenter_core_tpu/solver/incremental.py"),
+        encoding="utf-8",
+    ).read()
+    lru_names = set(re.findall(r'LRU\("([a-z]+)"\)', inc))
+    assert lru_names  # sanity: the discovery source still exists
+    missing = {n for n in lru_names if n not in covered and n != "seeds"}
+    # the seed LRU is reached through the seeds_get/seeds_put accessors,
+    # detected under the 'seeds' accessor spec
+    assert "seeds" in covered
+    assert not missing, f"caches without detected sites: {missing} (declared {declared})"
+
+
+def test_changed_only_cli_smoke(tmp_path):
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "karpenter_core_tpu.analysis", "--list-rules"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert out.returncode == 0
+    for rule in CACHESOUND:
+        assert rule in out.stdout
+    assert os.access(os.path.join(REPO, "hack", "analyze.sh"), os.X_OK)
