@@ -49,6 +49,7 @@ class AnalysisConfig:
         "karpenter_core_tpu/utils/",
         "karpenter_core_tpu/cloudprovider/",
         "karpenter_core_tpu/tracing/",
+        "karpenter_core_tpu/serving/",
     )
     # cross-module device-array-returning functions (jit-decorated
     # functions in the SAME module are detected automatically)
@@ -85,6 +86,9 @@ class AnalysisConfig:
         "karpenter_core_tpu/cloudprovider/fake.py",
         "karpenter_core_tpu/cloudprovider/types.py",
     )
+    # serving-pipeline modules: multi-threaded by design, held to the
+    # pipeline-safety rule (lock-guarded or queue-handed-off sharing)
+    serving_prefixes: Tuple[str, ...] = ("karpenter_core_tpu/serving/",)
     # modules whose cluster-API reads define the generation-relevant
     # field set (what the solver's caches can actually observe)
     cluster_consumer_modules: Tuple[str, ...] = (
@@ -242,7 +246,14 @@ _LOADED = False
 def _load_rules() -> None:
     global _LOADED
     if not _LOADED:
-        from . import cachesound, hygiene, hostsync, locks, tracersafety  # noqa: F401
+        from . import (  # noqa: F401
+            cachesound,
+            hygiene,
+            hostsync,
+            locks,
+            pipelinesafety,
+            tracersafety,
+        )
 
         _LOADED = True
 

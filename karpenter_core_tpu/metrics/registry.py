@@ -248,6 +248,23 @@ class Metrics:
             "Cross-solve solver cache evictions (LRU caps, env-tunable)",
             ["cache"],
         )
+        # serving pipeline (serving/pipeline.py): the decision-latency
+        # SLO (pod-pending → plan emitted), per-stage durations, and
+        # stage-queue depths (backpressure visibility)
+        self.serving_decision_latency = r.histogram(
+            f"{ns}_serving_decision_latency_seconds",
+            "Pod-pending to plan-emitted decision latency (serving SLO)",
+        )
+        self.serving_stage_duration = r.histogram(
+            f"{ns}_serving_stage_duration_seconds",
+            "Serving pipeline stage wall time (batch_wait | plan)",
+            labels=["stage"],
+        )
+        self.serving_queue_depth = r.gauge(
+            f"{ns}_serving_queue_depth",
+            "Serving pipeline stage-queue depth (caps are env-tunable, KARPENTER_TPU_SERVING_*_CAP)",
+            ["stage"],
+        )
         # node/nodepool/pod scrapers (metrics/{node,nodepool,pod})
         self.node_allocatable = r.gauge(f"{ns}_nodes_allocatable", "Node allocatable", ["node", "resource"])
         self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])

@@ -118,6 +118,21 @@ class Operator:
         self.metrics_store = MetricsStore(self.metrics)
         self.elector = None
         self.http = None
+        # staged async serving pipeline (serving/pipeline.py): when
+        # enabled it owns provisioning — the tick-shaped provisioner
+        # controller below degrades to a no-op safety net
+        self.serving = None
+        if self.options.use_serving_pipeline:
+            from ..serving import PipelineConfig, ServingPipeline
+
+            self.serving = ServingPipeline(
+                self.provisioner,
+                metrics=self.metrics,
+                config=PipelineConfig(
+                    idle_seconds=self.options.batch_idle_duration,
+                    max_seconds=self.options.batch_max_duration,
+                ),
+            )
 
         # the reconcile surface, mirroring controllers.go:47-82
         self.controllers: List[SingletonController] = [
@@ -154,6 +169,8 @@ class Operator:
         return None
 
     def _reconcile_provisioner(self) -> None:
+        if self.serving is not None:
+            return None  # the serving pipeline owns provisioning ticks
         with self.metrics.scheduling_duration.time():
             _, reason = self.provisioner.reconcile(wait_for_batch=self._batching)
         if reason:
@@ -213,6 +230,9 @@ class Operator:
                 probe_port=self.options.health_probe_port,
                 enable_profiling=self.options.enable_profiling,
                 logger=self.logger,
+                serving_state=(
+                    self.serving.debug_state if self.serving is not None else None
+                ),
             )
             self.http.start()
         # start/stop symmetry: re-register the config-logging watch a
@@ -225,11 +245,18 @@ class Operator:
         # (provisioning/controller.go:58)
         from ..utils import pod as podutils
 
-        def on_pod(event, pod):
-            if event != "DELETED" and podutils.is_provisionable(pod):
-                self.provisioner.trigger()
+        if self.serving is not None:
+            # the pipeline's ingest stage replaces the trigger controller
+            self.serving.attach_watch()
+            self.serving.start()
+            self._pod_watch_unsub = None
+        else:
 
-        self._pod_watch_unsub = self.kube_client.watch("Pod", on_pod)
+            def on_pod(event, pod):
+                if event != "DELETED" and podutils.is_provisionable(pod):
+                    self.provisioner.trigger()
+
+            self._pod_watch_unsub = self.kube_client.watch("Pod", on_pod)
         self._batching = True
         for c in self.controllers:
             c.start()
@@ -238,6 +265,8 @@ class Operator:
     def stop(self) -> None:
         for c in self.controllers:
             c.stop()
+        if self.serving is not None:
+            self.serving.stop()
         unsub = getattr(self, "_pod_watch_unsub", None)
         if unsub is not None:
             unsub()

@@ -68,6 +68,10 @@ class Options:
     # TPU-native knobs
     use_tpu_solver: bool = True
     tpu_consolidation_screen: bool = True
+    # serving pipeline (serving/pipeline.py): replace the tick-shaped
+    # provisioner reconcile loop with the staged async pipeline
+    # (overlapped batching/encode/dispatch/emit + /debug/serving)
+    use_serving_pipeline: bool = False
 
     @classmethod
     def from_env(cls) -> "Options":
@@ -87,6 +91,7 @@ class Options:
         opts.disable_webhook = _env("DISABLE_WEBHOOK", opts.disable_webhook)
         opts.use_tpu_solver = _env("USE_TPU_SOLVER", opts.use_tpu_solver)
         opts.tpu_consolidation_screen = _env("TPU_CONSOLIDATION_SCREEN", opts.tpu_consolidation_screen)
+        opts.use_serving_pipeline = _env("USE_SERVING_PIPELINE", opts.use_serving_pipeline)
         return opts
 
     @classmethod

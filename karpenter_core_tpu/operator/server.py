@@ -145,6 +145,7 @@ class OperationalServer:
         probe_port: int = 8081,
         enable_profiling: bool = False,
         logger=None,
+        serving_state: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.ready_check = ready_check
@@ -152,6 +153,8 @@ class OperationalServer:
         self._probe_port = probe_port
         self.enable_profiling = enable_profiling
         self.logger = logger
+        # serving-pipeline introspection hook (ServingPipeline.debug_state)
+        self.serving_state = serving_state
         self._metrics_server: Optional[_Server] = None
         self._probe_server: Optional[_Server] = None
 
@@ -168,6 +171,19 @@ class OperationalServer:
         if self.ready_check():
             return 200, "text/plain", "ok\n"
         return 503, "text/plain", "caches not synced\n"
+
+    def _serving(self, _query) -> Tuple[int, str, str]:
+        """Serving-pipeline state: queue depths/backpressure, tick log,
+        prewarm traffic, decision-latency percentiles."""
+        import json
+
+        if self.serving_state is None:
+            return 404, "text/plain", "serving pipeline not running\n"
+        try:
+            payload = json.dumps(self.serving_state(), default=str)
+        except Exception as err:  # noqa: BLE001 — a debug route must not 500 the server
+            return 500, "text/plain", f"serving state unavailable: {err}\n"
+        return 200, "application/json", payload
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -200,6 +216,8 @@ class OperationalServer:
             "/debug/traces": _traces,
             "/debug/traces/last": _traces_last,
         }
+        if self.serving_state is not None:
+            metrics_routes["/debug/serving"] = self._serving
         if self.enable_profiling:
             metrics_routes["/debug/pprof/"] = _stack_dump
             metrics_routes["/debug/pprof/profile"] = _collapsed_profile

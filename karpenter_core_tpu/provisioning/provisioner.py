@@ -70,6 +70,10 @@ class Provisioner:
         # but reuse keeps pool ordering/filtering off the tick path and
         # `last_timings`/`last_cache_stats` continuous for debugging
         self._tpu_solver = None  # (nodepool key, TPUScheduler)
+        # serving double-buffer hook, forwarded to the live TPUScheduler:
+        # fires when the authoritative encode hands off to device pack
+        # (serving/pipeline.py overlaps the next batch's prewarm with it)
+        self.encode_done_listener = None
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -81,21 +85,33 @@ class Provisioner:
         The pass runs under one trace root (batch → schedule → solve →
         claim creation); the trace is buffered only when a solve ran, so
         idle reconciles can't evict real solve traces."""
+        names, reason, _results = self.reconcile_with_results(wait_for_batch)
+        return names, reason
+
+    def reconcile_with_results(
+        self, wait_for_batch: bool = False
+    ) -> Tuple[List[str], Optional[str], Optional[Results]]:
+        """``reconcile`` with the scheduling Results exposed — the
+        serving pipeline (serving/pipeline.py) reads per-plan pod
+        membership off them for decision-latency accounting and the
+        traffic simulator's kubelet binder. Each successfully created
+        claim's name is stamped on its plan/claim object as
+        ``created_claim_name``."""
         import time as _time
 
         batch_t0 = _time.perf_counter()
         if wait_for_batch:
             if not self.batcher.wait():
-                return [], None
+                return [], None, None
         batch_wait_ms = round((_time.perf_counter() - batch_t0) * 1000.0, 3)
         if not self.cluster.synced():
-            return [], "waiting on cluster sync"
+            return [], "waiting on cluster sync", None
         with tracer.trace_root(
             "provisioner.reconcile", buffer_if="solve", batch_wait_ms=batch_wait_ms
         ):
             results = self.schedule()
             if results is None:
-                return [], None
+                return [], None, None
             names: List[str] = []
             create_errors: List[str] = []
             opts = LaunchOptions(record_pod_nomination=True, reason="provisioning")
@@ -106,12 +122,14 @@ class Provisioner:
                     create_errors.extend(errs)
                 for plan in getattr(results, "tpu_plans", []):
                     try:
-                        names.append(self.create_from_plan(plan, opts))
+                        name = self.create_from_plan(plan, opts)
+                        plan.created_claim_name = name
+                        names.append(name)
                     except Exception as e:  # noqa: BLE001 — one failed plan must not skip the rest
                         create_errors.append(f"creating node claim from plan, {e}")
         # surface failures instead of looking like "nothing to do"
         reason = "; ".join(create_errors[:5]) if create_errors else None
-        return names, reason
+        return names, reason, results
 
     # -- pod discovery (provisioner.go:155-178) ----------------------------
 
@@ -209,6 +227,7 @@ class Provisioner:
             )
             # the held nodepool list keeps the key's id()s stable
             self._tpu_solver = (key, solver, list(nodepools))
+        solver.encode_done_listener = self.encode_done_listener
         sr = solver.solve(
             pods,
             state_nodes=state_nodes,
@@ -341,6 +360,9 @@ class Provisioner:
             raise LimitsExceededError(err)
         node_claim = claim.to_node_claim(latest)
         self.kube_client.create(node_claim)
+        # serving-layer correlation: which stored claim came from this
+        # scheduling claim (oracle claims lose the association otherwise)
+        claim.created_claim_name = node_claim.name
         if self.metrics is not None:
             self.metrics.nodeclaims_created.inc(
                 reason=options.reason, nodepool=claim.nodepool_name

@@ -1,0 +1,28 @@
+"""Async serving pipeline (ISSUE 6): staged overlap of watch-event
+ingestion → batching/encode → device dispatch → finalize/emit, plus the
+production traffic simulator that measures it.
+
+Overlap-safety invariant (the PR-4 rule extended): **overlap is
+scheduling, never reordering of observable state.** Every observable
+state transition — NodeClaim creation, nominations, events — happens on
+the single authoritative plan thread in tick order; concurrent stages
+only form batches, warm content-addressed caches (whose soundness the
+cache-key analysis family proves), and drain telemetry. The pipeline's
+plans are therefore byte-identical to the equivalent sequential
+reconcile by construction, which `tests/test_serving.py` and bench
+config 8 verify against the sequential loop on every scenario.
+"""
+
+from .latency import DecisionLatencyTracker, percentiles_ms
+from .pipeline import PipelineConfig, SequentialLoop, ServingPipeline
+from .queues import Closed, StageQueue
+
+__all__ = [
+    "Closed",
+    "DecisionLatencyTracker",
+    "PipelineConfig",
+    "SequentialLoop",
+    "ServingPipeline",
+    "StageQueue",
+    "percentiles_ms",
+]

@@ -1,0 +1,623 @@
+"""Staged async serving pipeline (ISSUE 6 tentpole).
+
+The provisioner was tick-shaped: batch pending pods, solve, emit —
+serially, with a polling batcher in front. Production traffic is a
+stream. This module overlaps the stages:
+
+    watch events ──► ingest (observe_pod_event: stamp arrival, trigger window)
+                          │
+                 batch former thread: condition-variable window
+                 (idle/max), runs WHILE the current solve is in flight
+                          │  bounded solve queue (backpressure)
+                 plan thread: the single AUTHORITATIVE stage —
+                 pending-pod listing → solve (encode → device dispatch →
+                 finalize) → NodeClaim emit, strictly in tick order
+                          │  bounded telemetry queue
+                 telemetry thread: latency histograms, queue gauges,
+                 per-stage attribution off the solve trace
+    prewarm thread: double buffer — while tick N's pack is in flight on
+    device, tick N+1's accumulating batch runs `encode_prewarm` on the
+    host (pod memos, signature grouping, compat kernel rows), so the
+    authoritative solve is warm by construction.
+
+Overlap-safety invariant: **overlap is scheduling, never reordering of
+observable state.** Only the plan thread mutates observable state
+(claims, nominations, events), in tick order — concurrent stages form
+batches, warm content-addressed caches (sound by the cache-key analysis
+family), and drain telemetry. Hence pipeline plans are byte-identical
+to the equivalent sequential reconcile; `SequentialLoop` below IS that
+reconcile (same decision step, no overlap), and bench config 8 + the
+seeded-interleaving test assert the identity on every traffic scenario.
+
+Every stage boundary is a `StageQueue` (lock-free sharing is banned in
+this package by the pipeline-safety analysis rule); knobs are
+env-tunable (`KARPENTER_TPU_SERVING_*`, see `PipelineConfig`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..provisioning.batcher import Batcher
+from ..tracing import tracer
+from ..utils import pod as podutils
+from .latency import DecisionLatencyTracker
+from .queues import Closed, StageQueue, queue_cap
+
+log = logging.getLogger("karpenter.serving")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class PipelineConfig:
+    """Serving knobs. Queue caps bound each stage's buffering — a full
+    queue blocks the producer (backpressure), it never drops work."""
+
+    idle_seconds: float = field(
+        default_factory=lambda: _env_float("KARPENTER_TPU_SERVING_IDLE_S", 1.0)
+    )
+    max_seconds: float = field(
+        default_factory=lambda: _env_float("KARPENTER_TPU_SERVING_MAX_S", 10.0)
+    )
+    # batch tokens in flight: 1 = the window former may run exactly one
+    # window ahead of the solve (the double buffer); raising it deepens
+    # lookahead without changing plan identity (emits stay serialized)
+    solve_queue_cap: int = field(default_factory=lambda: queue_cap("SOLVE", 1))
+    telemetry_queue_cap: int = field(default_factory=lambda: queue_cap("TELEMETRY", 1024))
+    prewarm: bool = field(
+        default_factory=lambda: os.environ.get("KARPENTER_TPU_SERVING_PREWARM", "1") != "0"
+    )
+
+    def to_dict(self) -> dict:
+        return {
+            "idle_seconds": self.idle_seconds,
+            "max_seconds": self.max_seconds,
+            "solve_queue_cap": self.solve_queue_cap,
+            "telemetry_queue_cap": self.telemetry_queue_cap,
+            "prewarm": self.prewarm,
+        }
+
+
+class _DecisionStep:
+    """The shared authoritative decision step: one sequential reconcile
+    (pending listing → solve → emit), plus decision-latency marking and
+    the optional on_decision hook (the traffic simulator's kubelet
+    binder). Both the pipeline's plan thread and `SequentialLoop` run
+    EXACTLY this code, which is what makes 'byte-identical to the
+    sequential reconcile' hold by construction."""
+
+    def __init__(self, provisioner, latency: DecisionLatencyTracker, on_decision=None):
+        self.provisioner = provisioner
+        self.latency = latency
+        self.on_decision = on_decision
+
+    def run(self, tick: int) -> dict:
+        t0 = time.perf_counter()
+        names, reason, results = self.provisioner.reconcile_with_results()
+        decided: List[str] = []
+        errored: List[str] = []
+        if results is not None:
+            for plan in getattr(results, "tpu_plans", []) or []:
+                if getattr(plan, "created_claim_name", None):
+                    decided.extend(p.uid for p in plan.pods)
+            for claim in results.new_node_claims:
+                if getattr(claim, "created_claim_name", None):
+                    decided.extend(p.uid for p in claim.pods)
+            for plan in getattr(results, "existing_plans", []) or []:
+                decided.extend(p.uid for p in getattr(plan, "pods", []) or [])
+            for ex in results.existing_nodes:
+                decided.extend(p.uid for p in ex.pods)
+            errored.extend(results.pod_errors.keys())
+        # decision point: the plan (or terminal error) is emitted
+        self.latency.pods_decided(decided, tick)
+        self.latency.pods_decided(errored, tick, error=True)
+        if self.on_decision is not None and results is not None:
+            # simulator hook (kubelet binder) — runs ON the authoritative
+            # thread, before the next tick's listing, in both modes
+            self.on_decision(tick, results)
+        solver = None
+        cached = getattr(self.provisioner, "_tpu_solver", None)
+        if cached is not None:
+            solver = cached[1]
+        timings = getattr(solver, "last_timings", None) if solver is not None else None
+        return {
+            "tick": tick,
+            "step_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+            "created": len(names),
+            "decided": len(decided),
+            "errors": len(errored),
+            "reason": reason,
+            "trace_id": (timings or {}).get("trace_id"),
+            "solve_host_ms": round((timings or {}).get("host_ms", 0.0), 3),
+            "solve_device_ms": round((timings or {}).get("device_ms", 0.0), 3),
+        }
+
+
+class ServingPipeline:
+    """The staged pipeline. Wire `observe_pod_event` into the kube pod
+    watch, then `start()`. `hold()`/`release()` gate batch formation
+    (used by the lockstep identity harness and operational pause);
+    `quiesce()` waits for the decision stream to drain."""
+
+    def __init__(
+        self,
+        provisioner,
+        metrics=None,
+        config: Optional[PipelineConfig] = None,
+        latency: Optional[DecisionLatencyTracker] = None,
+        on_decision: Optional[Callable] = None,
+    ):
+        self.provisioner = provisioner
+        self.kube_client = provisioner.kube_client
+        self.cluster = provisioner.cluster
+        self.metrics = metrics
+        self.config = config or PipelineConfig()
+        self.latency = latency or DecisionLatencyTracker(
+            histogram=getattr(metrics, "serving_decision_latency", None)
+        )
+        self.batcher = Batcher(
+            idle_seconds=self.config.idle_seconds, max_seconds=self.config.max_seconds
+        )
+        depth_gauge = getattr(metrics, "serving_queue_depth", None)
+        self.solve_q = StageQueue("solve", self.config.solve_queue_cap, depth_gauge)
+        self.telemetry_q = StageQueue(
+            "telemetry", self.config.telemetry_queue_cap, depth_gauge
+        )
+        self._step = _DecisionStep(provisioner, self.latency, on_decision)
+        self._stop_evt = threading.Event()
+        self._new_pods_evt = threading.Event()
+        # the double-buffer handshake: set by the live solver the moment
+        # its encode phase hands off to device pack (the host is idle
+        # while the pack is in flight — exactly the prewarm slot);
+        # cleared by the plan thread before each authoritative step
+        self._encode_done_evt = threading.Event()
+        self._encode_done_evt.set()
+        provisioner.encode_done_listener = self._encode_done_evt.set
+        self._gate_cv = threading.Condition()
+        self._gate_held = False
+        self._mu = threading.Lock()
+        self._ticks = 0
+        self._step_inflight = False
+        self._ingested = 0
+        # ingest → prewarm handoff: pods seen pending since the last
+        # prewarm pass. Only NEW pods can have cold memos/signature
+        # rows, so the speculative encode walks the delta, never the
+        # whole pending set — at steady state the buffer is empty and
+        # prewarm costs nothing (GIL included). Dropping entries would
+        # only skip speculation, but the cap is far above any burst.
+        self._prewarm_buf: deque = deque(maxlen=100_000)
+        # bounded memory of recently-pending pods: after a catalog
+        # event the fresh catalog entry starts with empty compat rows
+        # and a fresh vocab, so prewarm replays these to rebuild rows,
+        # masks, and the kernels' compiled shapes off the hot path
+        self._recent_pods: "OrderedDict[str, object]" = OrderedDict()
+        self._catalog_dirty = False
+        self._tick_log: deque = deque(maxlen=64)
+        self._prewarm_stats: dict = {}
+        self._prewarm_runs = 0
+        self._catalog_prewarms = 0
+        self._prewarm_solver = None  # (nodepool key, TPUScheduler)
+        self._threads: List[threading.Thread] = []
+        self._watch_unsub = None
+
+    # -- ingest stage (watch-callback context) ------------------------------
+
+    def attach_watch(self) -> None:
+        """Subscribe the ingest stage to the kube pod watch."""
+        self._watch_unsub = self.kube_client.watch("Pod", self.observe_pod_event)
+
+    def observe_pod_event(self, event: str, pod) -> None:
+        """Ingest: stamp first-pending arrival (the SLO clock starts
+        here) and nudge the batch window. Runs on whatever thread wrote
+        the pod — the cheap, nonblocking edge of the pipeline."""
+        if event == "DELETED":
+            self.latency.forget(pod.uid)
+            return
+        if podutils.is_provisionable(pod):
+            self.latency.pod_pending(pod.uid)
+            with self._mu:
+                self._ingested += 1
+                self._prewarm_buf.append(pod)
+            self.batcher.trigger()
+            self._new_pods_evt.set()
+
+    def observe_catalog_event(self) -> None:
+        """Ingest for provider-side catalog/price changes (spot price
+        storms, offering updates). These arrive asynchronously to pod
+        traffic, and re-tensorizing the catalog is the most expensive
+        single encode step — the prewarm stage absorbs it into idle
+        time, where the tick-shaped loop pays it on its first
+        post-event solve."""
+        with self._mu:
+            self._catalog_dirty = True
+        self._new_pods_evt.set()
+
+    # -- batch former stage --------------------------------------------------
+
+    def _batch_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            if not self.batcher.wait():
+                continue  # max window elapsed with no trigger — re-check stop
+            token = {"formed_at": time.perf_counter()}
+            try:
+                # blocks while a solve is in flight and one batch is
+                # already queued: backpressure, the next window keeps
+                # absorbing triggers meanwhile
+                self.solve_q.put(token)
+            except Closed:
+                return
+
+    # -- plan stage (the authoritative thread) -------------------------------
+
+    def _plan_loop(self) -> None:
+        while True:
+            try:
+                token = self.solve_q.get(timeout=0.2)
+            except Closed:
+                return
+            if token is None:
+                if self._stop_evt.is_set():
+                    return
+                continue
+            # the hold gate sits HERE, not in the window former: a batch's
+            # content is determined by the pending listing at solve time,
+            # so gating the authoritative step is what makes a lockstep
+            # driver's injections atomic w.r.t. decisions (tokens formed
+            # early just wait; an extra token solves an empty batch)
+            with self._gate_cv:
+                while self._gate_held and not self._stop_evt.is_set():
+                    self._gate_cv.wait(timeout=0.2)
+            if self._stop_evt.is_set():
+                return
+            queue_wait_ms = round(
+                (time.perf_counter() - token["formed_at"]) * 1000.0, 3
+            )
+            with self._mu:
+                self._ticks += 1
+                tick = self._ticks
+                self._step_inflight = True
+            self._encode_done_evt.clear()
+            try:
+                rec = self._step.run(tick)
+            except Exception:  # noqa: BLE001 — one failed tick must not kill serving
+                log.exception("serving tick %d failed", tick)
+                rec = {"tick": tick, "error": True}
+            finally:
+                with self._mu:
+                    self._step_inflight = False
+                self._encode_done_evt.set()
+            rec["queue_wait_ms"] = queue_wait_ms
+            try:
+                self.telemetry_q.put(rec, timeout=1.0)
+            except Closed:
+                return
+
+    # -- telemetry stage -----------------------------------------------------
+
+    def _telemetry_loop(self) -> None:
+        while True:
+            try:
+                rec = self.telemetry_q.get(timeout=0.2)
+            except Closed:
+                return
+            if rec is None:
+                if self._stop_evt.is_set() and self.telemetry_q.depth() == 0:
+                    return
+                continue
+            self._record_telemetry(rec)
+
+    def _record_telemetry(self, rec: dict) -> None:
+        trace_id = rec.get("trace_id")
+        if trace_id:
+            trace = tracer.RING.get(trace_id)
+            if trace is not None:
+                rec["phase_breakdown_ms"] = {
+                    k: round(v, 2) for k, v in sorted(trace.phase_breakdown_ms().items())
+                }
+        if self.metrics is not None and "step_ms" in rec:
+            self.metrics.serving_stage_duration.observe(
+                rec["step_ms"] / 1000.0, stage="plan"
+            )
+            self.metrics.serving_stage_duration.observe(
+                rec.get("queue_wait_ms", 0.0) / 1000.0, stage="batch_wait"
+            )
+        with self._mu:
+            self._tick_log.append(rec)
+
+    # -- prewarm stage (the double buffer) -----------------------------------
+
+    def _prewarm_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            if not self._new_pods_evt.wait(timeout=0.25):
+                continue
+            # debounce: let a create burst accumulate (and give the
+            # ingesting thread the GIL back) before walking the delta
+            time.sleep(0.01)
+            self._new_pods_evt.clear()
+            if not self.config.prewarm or self._stop_evt.is_set():
+                continue
+            # the speculative encode shares the catalog lock (and the
+            # GIL) with the authoritative encode — running during THAT
+            # phase would make the step wait on speculation. The prewarm
+            # slot is everything else: the gap between ticks, and — the
+            # double buffer — the in-flight step's pack/finalize, which
+            # the solver signals via encode_done_listener the moment its
+            # encode hands off to device (tick N's pack runs on device
+            # while tick N+1's delta encodes on the host).
+            if not self._encode_done_evt.wait(timeout=0.05):
+                self._new_pods_evt.set()
+                continue
+            try:
+                self._prewarm_once()
+            except Exception:  # noqa: BLE001 — speculation must never break serving
+                log.debug("serving prewarm failed", exc_info=True)
+
+    def _prewarm_once(self) -> None:
+        """Speculatively encode the newly arrived pods on a dedicated
+        solver instance. Warms only content-addressed caches shared by
+        construction (see TPUScheduler.encode_prewarm) — safe to race
+        the authoritative solve, even on a stale batch guess. Walks the
+        ingest delta only: pods already prewarmed (or already decided)
+        have warm memos and signature rows, and re-walking the whole
+        pending set would steal the GIL from the authoritative stages
+        for no cache effect."""
+        with self._mu:
+            if not self._prewarm_buf and not self._catalog_dirty:
+                return
+            delta = list(self._prewarm_buf)
+            self._prewarm_buf.clear()
+            catalog_dirty = self._catalog_dirty
+            self._catalog_dirty = False
+        if catalog_dirty:
+            solver = self._prewarm_scheduler()
+            if solver is not None:
+                stats = solver.prewarm_catalog()
+                with self._mu:
+                    self._catalog_prewarms += 1
+                    self._prewarm_stats = stats
+                # the fresh entry has no compat rows and a fresh vocab:
+                # replay the recent workload through the encode so row
+                # rebuilds and kernel recompiles happen HERE, not on the
+                # first post-event authoritative solve
+                with self._mu:
+                    recent = list(self._recent_pods.values())
+                if recent:
+                    stats = solver.encode_prewarm(
+                        recent, daemonset_pods=self.cluster.get_daemonset_pods()
+                    )
+                    with self._mu:
+                        self._prewarm_stats = stats
+        seen = set()
+        pods = []
+        for pod in delta:
+            if pod.uid not in seen and podutils.is_provisionable(pod):
+                seen.add(pod.uid)
+                pods.append(pod)
+        with self._mu:
+            for pod in pods:
+                self._recent_pods[pod.uid] = pod
+                self._recent_pods.move_to_end(pod.uid)
+            while len(self._recent_pods) > 4096:
+                self._recent_pods.popitem(last=False)
+        if not pods:
+            return
+        solver = self._prewarm_scheduler()
+        if solver is None:
+            return
+        stats = solver.encode_prewarm(
+            pods, daemonset_pods=self.cluster.get_daemonset_pods()
+        )
+        with self._mu:
+            self._prewarm_runs += 1
+            self._prewarm_stats = stats
+
+    def _prewarm_scheduler(self):
+        """A prewarm-only TPUScheduler (no kube/cluster: it must read no
+        authoritative state), rebuilt when the nodepool set changes —
+        same reuse discipline as the provisioner's live solver."""
+        nodepools = [
+            np_
+            for np_ in self.kube_client.list("NodePool")
+            if np_.metadata.deletion_timestamp is None
+        ]
+        if not nodepools:
+            return None
+        key = tuple((id(np_), np_.metadata.resource_version) for np_ in nodepools)
+        with self._mu:
+            cached = self._prewarm_solver
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from ..solver import TPUScheduler
+
+        solver = TPUScheduler(nodepools, self.provisioner.cloud_provider)
+        with self._mu:
+            self._prewarm_solver = (key, solver, list(nodepools))
+        return solver
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop_evt.clear()
+        self.solve_q.reopen()
+        self.telemetry_q.reopen()
+        self._threads = [
+            threading.Thread(target=self._batch_loop, name="serve-batch", daemon=True),
+            threading.Thread(target=self._plan_loop, name="serve-plan", daemon=True),
+            threading.Thread(
+                target=self._telemetry_loop, name="serve-telemetry", daemon=True
+            ),
+            threading.Thread(target=self._prewarm_loop, name="serve-prewarm", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        with self._gate_cv:
+            self._gate_cv.notify_all()
+        self.batcher.trigger()  # wake a waiting window former
+        # closing the queues unblocks any stage parked on put/get; an
+        # in-flight authoritative tick still completes first (the plan
+        # thread only sees Closed at its next queue operation)
+        self.solve_q.close()
+        self.telemetry_q.close()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._threads = []
+        if self._watch_unsub is not None:
+            self._watch_unsub()
+            self._watch_unsub = None
+
+    # -- gating / quiescence (lockstep harness + operational pause) ----------
+
+    def hold(self) -> None:
+        """Pause batch formation (in-flight ticks finish; triggers keep
+        accumulating in the window)."""
+        with self._gate_cv:
+            self._gate_held = True
+
+    def release(self) -> None:
+        with self._gate_cv:
+            self._gate_held = False
+            self._gate_cv.notify_all()
+
+    def ticks(self) -> int:
+        with self._mu:
+            return self._ticks
+
+    def quiesce(self, timeout: float = 30.0, require_empty: bool = True) -> bool:
+        """Wait until the decision stream drains: no queued batches, no
+        in-flight step, and (require_empty) no undecided pending pods.
+        Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                busy = self._step_inflight
+            if (
+                not busy
+                and self.solve_q.depth() == 0
+                and (not require_empty or self.latency.pending_count() == 0)
+            ):
+                return True
+            time.sleep(0.002)
+        return False
+
+    # -- observability -------------------------------------------------------
+
+    def debug_state(self) -> dict:
+        """The /debug/serving payload: config, queue stats, tick log
+        tail, prewarm traffic, SLO percentiles."""
+        with self._mu:
+            ticks = self._ticks
+            ingested = self._ingested
+            tick_log = list(self._tick_log)[-8:]
+            prewarm = {
+                "runs": self._prewarm_runs,
+                "catalog_prewarms": self._catalog_prewarms,
+                **self._prewarm_stats,
+            }
+        return {
+            "config": self.config.to_dict(),
+            "ticks": ticks,
+            "pods_ingested": ingested,
+            "pods_decided": self.latency.decided_count(),
+            "pods_pending": self.latency.pending_count(),
+            "decision_latency_ms": self.latency.percentiles(),
+            "queues": {
+                "solve": self.solve_q.stats(),
+                "telemetry": self.telemetry_q.stats(),
+            },
+            "prewarm": prewarm,
+            "last_ticks": tick_log,
+        }
+
+
+class SequentialLoop:
+    """The tick-shaped baseline: the same authoritative decision step,
+    no overlap — window, then solve, then emit, serially on one thread.
+    This is the 'equivalent sequential reconcile' the pipeline's plans
+    must be byte-identical to, and the latency baseline config 8's SLO
+    gate compares against."""
+
+    def __init__(
+        self,
+        provisioner,
+        metrics=None,
+        config: Optional[PipelineConfig] = None,
+        latency: Optional[DecisionLatencyTracker] = None,
+        on_decision: Optional[Callable] = None,
+    ):
+        self.provisioner = provisioner
+        self.kube_client = provisioner.kube_client
+        self.metrics = metrics
+        self.config = config or PipelineConfig()
+        self.latency = latency or DecisionLatencyTracker(
+            histogram=getattr(metrics, "serving_decision_latency", None)
+        )
+        self.batcher = Batcher(
+            idle_seconds=self.config.idle_seconds, max_seconds=self.config.max_seconds
+        )
+        self._step = _DecisionStep(provisioner, self.latency, on_decision)
+        self._stop_evt = threading.Event()
+        self._mu = threading.Lock()
+        self._ticks = 0
+        self._thread: Optional[threading.Thread] = None
+        self._watch_unsub = None
+
+    def attach_watch(self) -> None:
+        self._watch_unsub = self.kube_client.watch("Pod", self.observe_pod_event)
+
+    def observe_pod_event(self, event: str, pod) -> None:
+        if event == "DELETED":
+            self.latency.forget(pod.uid)
+            return
+        if podutils.is_provisionable(pod):
+            self.latency.pod_pending(pod.uid)
+            self.batcher.trigger()
+
+    def step_once(self) -> dict:
+        """One synchronous decision tick (the lockstep driver's entry)."""
+        with self._mu:
+            self._ticks += 1
+            tick = self._ticks
+        return self._step.run(tick)
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            if not self.batcher.wait():
+                continue
+            if self._stop_evt.is_set():
+                return
+            self.step_once()
+
+    def start(self) -> None:
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._loop, name="seq-loop", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        self.batcher.trigger()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        if self._watch_unsub is not None:
+            self._watch_unsub()
+            self._watch_unsub = None
+
+    def ticks(self) -> int:
+        with self._mu:
+            return self._ticks

@@ -1,0 +1,130 @@
+"""Bounded stage queues — the only legal way mutable work items cross a
+pipeline stage boundary (enforced by the pipeline-safety analysis rule:
+shared state is either lock-guarded or handed off through one of these).
+
+``put`` blocks when the queue is full: backpressure propagates upstream
+instead of buffering unboundedly (a slow solve stage slows batch
+formation, which slows ingest, which blocks the watch callback — the
+producer feels the pipeline's true capacity). Caps are env-tunable via
+``KARPENTER_TPU_SERVING_<NAME>_CAP``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class Closed(Exception):
+    """Raised by put()/get() once the queue is closed (and drained, for
+    get)."""
+
+
+def queue_cap(name: str, default: int) -> int:
+    """Env-tunable stage-queue capacity:
+    ``KARPENTER_TPU_SERVING_<NAME>_CAP`` (min 1)."""
+    try:
+        return max(1, int(os.environ.get(f"KARPENTER_TPU_SERVING_{name.upper()}_CAP", default)))
+    except ValueError:
+        return default
+
+
+class StageQueue:
+    """Bounded FIFO handoff between two pipeline stages.
+
+    Ownership discipline: an item belongs to the producer until ``put``
+    returns, to the consumer after ``get`` returns — neither side
+    touches it in between, so items need no locks of their own.
+    """
+
+    def __init__(self, name: str, maxsize: int, depth_gauge=None):
+        self.name = name
+        self.maxsize = max(1, int(maxsize))
+        self._cv = threading.Condition()
+        self._items: deque = deque()
+        self._closed = False
+        self._high_water = 0
+        self._blocked_puts = 0  # backpressure events (puts that had to wait)
+        self._total_puts = 0
+        # optional metrics Gauge, labeled by stage name
+        self._depth_gauge = depth_gauge
+
+    def _set_gauge(self, depth: int) -> None:
+        # callers hold self._cv
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(float(depth), stage=self.name)
+
+    def put(self, item, timeout: Optional[float] = None) -> bool:
+        """Enqueue, blocking while full (backpressure). Returns False on
+        timeout, True otherwise. Raises Closed after close()."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            blocked = False
+            while len(self._items) >= self.maxsize and not self._closed:
+                if not blocked:
+                    blocked = True
+                    self._blocked_puts += 1
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(timeout=remaining)
+            if self._closed:
+                raise Closed(self.name)
+            self._items.append(item)
+            self._total_puts += 1
+            depth = len(self._items)
+            if depth > self._high_water:
+                self._high_water = depth
+            self._set_gauge(depth)
+            self._cv.notify_all()
+            return True
+
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue, blocking while empty. Returns the item, or None on
+        timeout (stages enqueue only non-None work items). Raises
+        Closed once the queue is closed AND drained."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not self._items:
+                if self._closed:
+                    raise Closed(self.name)
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(timeout=remaining)
+            item = self._items.popleft()
+            self._set_gauge(len(self._items))
+            self._cv.notify_all()
+            return item
+
+    def close(self) -> None:
+        """Wake every waiter; subsequent puts raise, gets drain then
+        raise."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def reopen(self) -> None:
+        """Reset after close() (pipeline restart); drops undrained
+        items."""
+        with self._cv:
+            self._closed = False
+            self._items.clear()
+            self._set_gauge(0)
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "depth": len(self._items),
+                "cap": self.maxsize,
+                "high_water": self._high_water,
+                "blocked_puts": self._blocked_puts,
+                "total_puts": self._total_puts,
+            }

@@ -36,7 +36,7 @@ import os
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -517,6 +517,11 @@ class TPUScheduler:
         self.metrics = metrics
         # device/host wall-time split of the most recent solve
         self.last_timings: Optional[Dict[str, float]] = None
+        # serving double-buffer hook: called (no args) the moment the
+        # authoritative encode phase hands off to device pack — the
+        # pipeline's prewarm stage uses it to start speculatively
+        # encoding the NEXT batch while this pack is in flight
+        self.encode_done_listener: Optional[Callable[[], None]] = None
         # cross-group merge observability: engine, merge_ms, and the
         # screened/applied counters (reset per solve; bench.py reads
         # last_merge_stats per config)
@@ -1326,6 +1331,125 @@ class TPUScheduler:
 
     # ------------------------------------------------------------------
 
+    def _build_pools(self) -> Tuple[List[PoolEncoding], List[List[InstanceType]]]:
+        """Per-pool template encoding + catalog fetch, shared by the
+        authoritative tensor pass and the serving pipeline's speculative
+        ``encode_prewarm`` (the pool list is a pure function of the
+        nodepool specs and the provider catalog)."""
+        pools: List[PoolEncoding] = []
+        pool_catalogs: List[List[InstanceType]] = []
+        with tracer.span("encode.pool_templates"):
+            for np_ in self.nodepools:
+                try:
+                    its = self.cloud_provider.get_instance_types(np_)
+                except Exception as e:  # noqa: BLE001 — one bad pool must not stop the solve
+                    log.debug(
+                        "skipping nodepool %s: instance-type fetch failed: %s",
+                        np_.name,
+                        e,
+                    )
+                    continue
+                if not its:
+                    continue
+                template_reqs = node_selector_requirements(np_.spec.template.requirements)
+                from ..scheduling.requirements import label_requirements
+
+                template_reqs.add(
+                    *label_requirements(
+                        {**np_.spec.template.metadata.labels, wk.NODEPOOL_LABEL_KEY: np_.name}
+                    ).values_list()
+                )
+                pools.append(
+                    PoolEncoding(np_, template_reqs, Taints(np_.spec.template.taints))
+                )
+                pool_catalogs.append(its)
+        return pools, pool_catalogs
+
+    # -- staged serving entry point (serving/pipeline.py) -------------------
+
+    def encode_prewarm(
+        self, pods: List[Pod], daemonset_pods: Optional[List[Pod]] = None
+    ) -> dict:
+        """Speculative encode stage for the serving pipeline's double
+        buffer: run the host-side encode (pod memos, signature grouping,
+        route split, catalog tensorization, per-(pool, signature) compat
+        kernel rows) for a batch that has not been authoritatively
+        scheduled yet, then discard the outputs.
+
+        Overlap-safety invariant: this method only *warms* the
+        content-addressed cross-solve caches (podcache interning, the
+        catalog entries and their ``sig_rows`` under ``_CATALOG_LOCK``,
+        the route LRU) whose soundness the cache-key analysis family
+        proves — reuse is memoization, never approximation, so running
+        it concurrently with an authoritative solve on another thread
+        (and even on a stale guess of the next batch) can change
+        timings, never plans. It reads no cluster state and emits
+        nothing.
+
+        Call it on a dedicated ``TPUScheduler`` instance: per-instance
+        scratch state (``_cstats``, ``_req_map``, ...) is not shared
+        between a prewarm and a live solve, only the module-level caches
+        are. Returns the prewarm's cache-traffic stats."""
+        import time as _time
+
+        from . import podcache
+
+        t0 = _time.perf_counter()
+        self._cstats = incremental.CacheStats()
+        self._warm = ws = incremental.warm_state_for(self)
+        with tracer.trace_root("encode_prewarm", buffer_if="never", pods=len(pods)):
+            with tracer.span("pod_memos"):
+                memos, _rvs = podcache.get_memos_rvs(pods)
+            self._all_requests = [m.requests for m in memos]
+            self._req_ids = np.fromiter(
+                (m.req_id for m in memos), dtype=np.int64, count=len(memos)
+            )
+            self._req_map = {m.req_id: m.requests for m in memos}
+            self._batch_pods = pods
+            self._batch_uids_cache = None
+            self._intersects_cache = ws.intersects_cache() if ws is not None else {}
+            with tracer.span("group_pods"):
+                groups = group_pods(pods, memos=memos)
+            with tracer.span("group_routing"):
+                tensor_groups, parked, _oracle_pods = self._route_groups(pods, groups)
+            encode_groups = list(tensor_groups) + list(parked)
+            pools, pool_catalogs = self._build_pools()
+            if encode_groups and pools:
+                with tracer.span("encode"):
+                    self._encode_phase(
+                        encode_groups, pools, pool_catalogs, list(daemonset_pods or ())
+                    )
+        stats = self._cstats.to_dict()
+        stats["groups"] = len(groups)
+        stats["prewarm_ms"] = round((_time.perf_counter() - t0) * 1000.0, 3)
+        self.last_prewarm_stats = stats
+        return stats
+
+    def prewarm_catalog(self) -> dict:
+        """Speculative catalog re-tensorization for the serving
+        pipeline: after a provider catalog/price event, re-encode each
+        pool's catalog entry off the authoritative path (the shared
+        ``_CATALOG_CACHE`` under ``_CATALOG_LOCK`` — same key, same
+        guard, so the next authoritative solve hits it warm). The
+        tick-shaped loop pays this on its first post-event solve; the
+        pipeline's prewarm stage absorbs it into idle time."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._cstats = incremental.CacheStats()
+        pools, pool_catalogs = self._build_pools()
+        cg = getattr(self.cloud_provider, "catalog_generation", None)
+        with tracer.trace_root("prewarm_catalog", buffer_if="never", pools=len(pools)):
+            with _CATALOG_LOCK:
+                with tracer.span("encode.catalog"):
+                    for pool, cat in zip(pools, pool_catalogs):
+                        gen = cg(pool.nodepool) if callable(cg) else None
+                        _catalog_entry(cat, generation=gen, stats=self._cstats)
+        stats = self._cstats.to_dict()
+        stats["pools"] = len(pools)
+        stats["prewarm_ms"] = round((_time.perf_counter() - t0) * 1000.0, 3)
+        return stats
+
     def _solve_tensor(
         self,
         pods: List[Pod],
@@ -1378,33 +1502,7 @@ class TPUScheduler:
                 return
 
         # --- encode catalog per pool -----------------------------------
-        pools: List[PoolEncoding] = []
-        pool_catalogs: List[List[InstanceType]] = []
-        with tracer.span("encode.pool_templates"):
-            for np_ in self.nodepools:
-                try:
-                    its = self.cloud_provider.get_instance_types(np_)
-                except Exception as e:  # noqa: BLE001 — one bad pool must not stop the solve
-                    log.debug(
-                        "skipping nodepool %s: instance-type fetch failed: %s",
-                        np_.name,
-                        e,
-                    )
-                    continue
-                if not its:
-                    continue
-                template_reqs = node_selector_requirements(np_.spec.template.requirements)
-                from ..scheduling.requirements import label_requirements
-
-                template_reqs.add(
-                    *label_requirements(
-                        {**np_.spec.template.metadata.labels, wk.NODEPOOL_LABEL_KEY: np_.name}
-                    ).values_list()
-                )
-                pools.append(
-                    PoolEncoding(np_, template_reqs, Taints(np_.spec.template.taints))
-                )
-                pool_catalogs.append(its)
+        pools, pool_catalogs = self._build_pools()
         if not pools:
             for gi in range(parked_from):
                 for i in leftover[gi]:
@@ -1416,6 +1514,12 @@ class TPUScheduler:
 
         with tracer.span("encode"):
             ctx = self._encode_phase(groups, pools, pool_catalogs, daemonset_pods)
+        listener = self.encode_done_listener
+        if listener is not None:
+            try:
+                listener()
+            except Exception:  # noqa: BLE001 — a listener bug must not fail the solve
+                log.debug("encode_done_listener failed", exc_info=True)
         with tracer.span("pack"):
             self._pack_phase(
                 pods, groups, parked_from, pools, leftover, state_nodes, result, ctx

@@ -1,6 +1,7 @@
 """Dev-only: profile the config-3 warm solve (cProfile + phase timers).
 
 Usage: python profile_solve.py [pods] [types] [--ticks N] [--churn RATE]
+       python profile_solve.py --stream SCENARIO [--scale N] [--pace S]
 
 With --ticks, drives N repeated solves through the steady-state
 incremental path (solver/incremental.py) over a churning batch —
@@ -8,6 +9,14 @@ RATE (default 0.05) of the pods are swapped each tick — printing each
 tick's host/device split and cache hit counts, then cProfile of one
 steady-state warm tick. Without --ticks, the original single-solve
 profile runs.
+
+With --stream, drives a traffic-generator scenario (serving/trafficgen
+.py: rollout, spot_storm, cascade, diurnal, churn10x) through the async
+serving pipeline under cProfile — the same path bench config 8 and the
+operator's USE_SERVING_PIPELINE mode run, so slow-solve capture
+(KARPENTER_TPU_TRACE_SLOW_MS + KARPENTER_TPU_TRACE_DIR) and
+/debug/traces work identically in streaming mode. Prints the run summary (decision-latency SLO,
+per-stage attribution, queue stats) then the profile.
 
 Env: BENCH_BACKEND=cpu to force the CPU fallback for comparison;
 KARPENTER_TPU_INCREMENTAL=0 to profile the cold pipeline tick over tick.
@@ -36,6 +45,16 @@ def _parse_args():
                     help="steady-state mode: repeated solves with churn")
     ap.add_argument("--churn", type=float, default=0.05,
                     help="fraction of pods swapped per tick (with --ticks)")
+    ap.add_argument("--stream", metavar="SCENARIO", default=None,
+                    help="streaming mode: profile a trafficgen scenario "
+                         "through the serving pipeline")
+    ap.add_argument("--scale", type=int, default=400,
+                    help="scenario base-fleet size (with --stream)")
+    ap.add_argument("--pace", type=float, default=0.1,
+                    help="seconds between scenario steps (with --stream)")
+    ap.add_argument("--mode", default="pipeline",
+                    choices=("pipeline", "sequential"),
+                    help="serving mode to profile (with --stream)")
     return ap.parse_args()
 
 
@@ -44,6 +63,9 @@ def main():
     out = {}
     backend = bench.resolve_backend(out)
     print("backend:", backend, file=sys.stderr)
+    if args.stream:
+        _stream_mode(args)
+        return
 
     from karpenter_core_tpu.apis import labels as wk
     from karpenter_core_tpu.apis.nodepool import NodePool
@@ -113,6 +135,41 @@ def main():
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)
+    print(s.getvalue())
+
+
+def _stream_mode(args):
+    """--stream SCENARIO: one traffic measurement through the serving
+    pipeline under cProfile. The profile covers every stage thread
+    (cProfile hooks threads started after enable()), so prewarm and
+    window-former costs show up next to the authoritative solve."""
+    import json
+    import threading
+
+    from karpenter_core_tpu.serving import trafficgen as tg
+
+    pr = cProfile.Profile()
+
+    def _enable_for_stage_threads(*_a):
+        # each serving stage thread turns the shared profiler on for
+        # itself at its first call event (the GIL serializes the
+        # callbacks; dev-only). Foreign pool threads (XLA, informers)
+        # stay unprofiled — profiling them crawls the whole process.
+        name = threading.current_thread().name
+        if name.startswith(("serve-", "seq-")):
+            threading.setprofile(None)
+            pr.enable()
+
+    threading.setprofile(_enable_for_stage_threads)
+    pr.enable()
+    summary = tg.run_measurement(
+        args.stream, args.mode, "free", args.scale, args.pace
+    )
+    pr.disable()
+    threading.setprofile(None)
+    print(json.dumps(summary, indent=1), file=sys.stderr)
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(45)
     print(s.getvalue())
 
 

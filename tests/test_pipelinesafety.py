@@ -1,0 +1,141 @@
+"""pipeline-safety rule (analysis/pipelinesafety.py, ISSUE 6).
+
+The serving package is the one place in the repo that is multi-threaded
+by design, and its discipline — mutable state crosses stage-thread
+boundaries only under a lock or through a handoff queue — is enforced
+statically. Fixtures cover: an unguarded cross-context field (finding),
+the same field lock-guarded (clean), handoff via StageQueue/Event
+(clean), thread-private state (clean), the suppression marker, and the
+full-repo meta-test that keeps `serving/` itself clean in tier-1.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from karpenter_core_tpu.analysis import analyze_paths
+
+
+def run_snippet(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return analyze_paths([str(p)], root=str(tmp_path), rules=["pipeline-safety"])
+
+
+STAGE_CLASS = """
+    import threading
+
+    class Stage:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self.ticks = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                __LOOP_BODY__
+
+        def snapshot(self):
+            __READ_BODY__
+"""
+
+
+def test_unguarded_cross_context_field_flagged(tmp_path):
+    code = STAGE_CLASS.replace("__LOOP_BODY__", "self.ticks += 1").replace(
+        "__READ_BODY__", "return self.ticks"
+    )
+    report = run_snippet(tmp_path, code)
+    assert {f.rule for f in report.findings} == {"pipeline-safety"}
+    # both the thread-context write and the external read are flagged
+    lines = {f.line for f in report.findings}
+    assert len(lines) == 2
+    assert all("'ticks'" in f.message for f in report.findings)
+
+
+def test_lock_guarded_cross_context_field_clean(tmp_path):
+    code = STAGE_CLASS.replace(
+        "__LOOP_BODY__",
+        "with self._mu:\n                    self.ticks += 1",
+    ).replace(
+        "__READ_BODY__",
+        "with self._mu:\n                return self.ticks",
+    )
+    assert run_snippet(tmp_path, code).findings == []
+
+
+def test_handoff_queue_and_event_fields_exempt(tmp_path):
+    code = """
+        import threading
+        from karpenter_core_tpu.serving.queues import StageQueue
+
+        class Stage:
+            def __init__(self):
+                self.q = StageQueue("work", 4)
+                self.evt = threading.Event()
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._loop)
+                self._thread.start()
+
+            def _loop(self):
+                while True:
+                    item = self.q.get(timeout=0.1)
+                    self.evt.set()
+
+            def submit(self, item):
+                self.q.put(item)
+                self.evt.clear()
+    """
+    assert run_snippet(tmp_path, code).findings == []
+
+
+def test_thread_private_state_clean(tmp_path):
+    # a field only one context touches is not stage-crossing state
+    code = STAGE_CLASS.replace("__LOOP_BODY__", "self.ticks += 1").replace(
+        "__READ_BODY__", "return 0"
+    )
+    assert run_snippet(tmp_path, code).findings == []
+
+
+def test_non_threading_class_out_of_scope(tmp_path):
+    code = """
+        class Plain:
+            def __init__(self):
+                self.count = 0
+
+            def bump(self):
+                self.count += 1
+
+            def read(self):
+                return self.count
+    """
+    assert run_snippet(tmp_path, code).findings == []
+
+
+def test_suppression_marker(tmp_path):
+    code = STAGE_CLASS.replace(
+        "__LOOP_BODY__",
+        "self.ticks += 1  # analysis: allow-pipeline-safety",
+    ).replace(
+        "__READ_BODY__",
+        "return self.ticks  # analysis: allow-pipeline-safety",
+    )
+    report = run_snippet(tmp_path, code)
+    assert report.findings == []
+    assert len(report.suppressed) >= 2
+
+
+def test_serving_package_is_clean():
+    import glob
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = sorted(glob.glob(os.path.join(repo, "karpenter_core_tpu/serving/*.py")))
+    assert files, "serving package must exist"
+    report = analyze_paths(files, root=repo, rules=["pipeline-safety"])
+    assert report.findings == [], [str(f) for f in report.findings]

@@ -1,0 +1,340 @@
+"""Serving pipeline (serving/, ISSUE 6).
+
+The load-bearing invariant: **overlap is scheduling, never reordering
+of observable state** — the staged pipeline's emitted plan stream is
+byte-identical to the equivalent sequential reconcile of the same
+traffic, and per-pod decisions are monotonic in tick order. The
+seeded-schedule test drives the same deterministic traffic traces
+through both modes with full stage concurrency (window former, prewarm
+and telemetry threads racing the authoritative solves) and compares
+the canonical streams.
+
+Also covered: the stage-queue backpressure contract, the
+decision-latency tracker's first-wins semantics, the condition-variable
+batch window (satellite: no polling floor on the idle path), and the
+solver's encode-done double-buffer handshake.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+from karpenter_core_tpu.provisioning.batcher import Batcher
+from karpenter_core_tpu.serving import (
+    Closed,
+    DecisionLatencyTracker,
+    PipelineConfig,
+    StageQueue,
+    percentiles_ms,
+)
+from karpenter_core_tpu.serving import trafficgen as tg
+from karpenter_core_tpu.solver import TPUScheduler, incremental
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warm_state():
+    incremental.reset()
+    yield
+    incremental.reset()
+
+
+# ---------------------------------------------------------------------------
+# stage queues: the only legal stage-boundary crossing
+
+
+def test_stage_queue_fifo_and_stats():
+    q = StageQueue("t", maxsize=4)
+    for i in range(3):
+        q.put(i)
+    assert [q.get(), q.get(), q.get()] == [0, 1, 2]
+    s = q.stats()
+    assert s["total_puts"] == 3
+    assert s["high_water"] == 3
+    assert s["depth"] == 0
+
+
+def test_stage_queue_backpressure_blocks_producer():
+    q = StageQueue("t", maxsize=1)
+    q.put("a")
+    # a full queue times the producer out instead of buffering
+    t0 = time.monotonic()
+    assert q.put("b", timeout=0.05) is False
+    assert time.monotonic() - t0 >= 0.04
+    assert q.stats()["blocked_puts"] == 1
+    # a consumer frees the slot and unblocks a waiting producer
+    done = []
+
+    def producer():
+        q.put("b")
+        done.append(True)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    assert q.get(timeout=1.0) == "a"
+    t.join(timeout=1.0)
+    assert done == [True]
+
+
+def test_stage_queue_close_unblocks_and_drains():
+    q = StageQueue("t", maxsize=2)
+    q.put("x")
+    q.close()
+    with pytest.raises(Closed):
+        q.put("y")
+    # close drains queued items first, then raises
+    assert q.get() == "x"
+    with pytest.raises(Closed):
+        q.get()
+    q.reopen()
+    q.put("z")
+    assert q.get() == "z"
+
+
+def test_stage_queue_get_timeout_returns_none():
+    q = StageQueue("t", maxsize=1)
+    assert q.get(timeout=0.01) is None
+
+
+# ---------------------------------------------------------------------------
+# decision-latency tracker: the SLO clock
+
+
+def test_latency_first_pending_and_first_decision_win():
+    clk = [0.0]
+    tr = DecisionLatencyTracker(clock=lambda: clk[0])
+    tr.pod_pending("a")
+    clk[0] = 5.0
+    tr.pod_pending("a")  # re-list must not move arrival
+    clk[0] = 10.0
+    tr.pods_decided(["a"], tick=1)
+    tr.pods_decided(["a"], tick=2)  # re-plan must not extend latency
+    assert tr.samples_ms() == [10_000.0]
+    assert tr.decided_count() == 1
+    assert tr.pending_count() == 0
+    assert tr.decision_log() == [(1, "a")]
+
+
+def test_latency_forget_deleted_pod_is_not_a_sample():
+    tr = DecisionLatencyTracker()
+    tr.pod_pending("gone")
+    tr.forget("gone")
+    tr.pods_decided(["gone"], tick=1)
+    assert tr.samples_ms() == []
+    assert tr.pending_count() == 0
+
+
+def test_percentiles_ms_interpolation():
+    out = percentiles_ms([10.0, 20.0, 30.0, 40.0])
+    assert out["p50"] == 25.0
+    assert out["p99"] == pytest.approx(39.7, abs=0.01)
+    assert percentiles_ms([]) == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# condition-variable batch window (satellite: no 50 ms polling floor)
+
+
+def test_batcher_idle_close_is_event_driven():
+    b = Batcher(idle_seconds=0.03, max_seconds=5.0)
+    b.trigger()
+    t0 = time.monotonic()
+    assert b.wait() is True
+    elapsed = time.monotonic() - t0
+    # closes after the idle window, NOT a 50 ms poll multiple: the old
+    # polling loop had a hard floor at poll=0.05
+    assert elapsed >= 0.025
+    assert elapsed < 2.0
+
+
+def test_batcher_untriggered_nonblocking_and_timeout():
+    b = Batcher(idle_seconds=0.01, max_seconds=0.05)
+    assert b.wait(blocking=False) is False
+    t0 = time.monotonic()
+    assert b.wait() is False  # blocking wait gives up after max window
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_batcher_trigger_during_window_extends_idle():
+    b = Batcher(idle_seconds=0.08, max_seconds=1.0)
+    b.trigger()
+    stop = time.monotonic() + 0.15
+
+    def late_triggers():
+        while time.monotonic() < stop:
+            b.trigger()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=late_triggers)
+    t.start()
+    t0 = time.monotonic()
+    assert b.wait() is True
+    # the window must outlive the trigger stream by ~idle
+    assert time.monotonic() - t0 >= 0.15
+    t.join()
+
+
+def test_batcher_trigger_wakes_blocked_waiter_immediately():
+    b = Batcher(idle_seconds=0.01, max_seconds=10.0)
+    got = []
+
+    def waiter():
+        got.append(b.wait())
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    b.trigger()
+    t.join(timeout=2.0)
+    assert got == [True]
+
+
+# ---------------------------------------------------------------------------
+# solver handshake: encode-done fires between encode and pack
+
+
+def test_encode_done_listener_fires_once_per_tensor_solve():
+    provider = FakeCloudProvider()
+    provider.instance_types = [
+        new_instance_type("it-a", {"cpu": "8", "memory": "16Gi", "pods": "110"})
+    ]
+    solver = TPUScheduler([make_nodepool()], provider)
+    fired = []
+    solver.encode_done_listener = lambda: fired.append(True)
+    solver.solve([make_pod(requests={"cpu": "1", "memory": "1Gi"}) for _ in range(4)])
+    assert fired == [True]
+
+
+# ---------------------------------------------------------------------------
+# the seeded-schedule identity gate: pipeline == sequential, bytewise
+
+
+@pytest.mark.parametrize("scenario,seed", [("cascade", 7), ("churn10x", 11)])
+def test_lockstep_plan_identity_and_monotonic_order(scenario, seed):
+    sc = tg.build_scenario(scenario, scale=60, seed=seed)
+    incremental.reset()
+    seq = tg.run_lockstep(sc, mode="sequential")
+    incremental.reset()
+    pipe = tg.run_lockstep(sc, mode="pipeline")
+    assert pipe.plan_bytes() == seq.plan_bytes()
+    assert tg.monotonic_decision_order(pipe)
+    assert tg.monotonic_decision_order(seq)
+    # every injected pod reached a decision in both modes
+    assert pipe.pods_decided == seq.pods_decided == sc.total_creates
+    # the pipeline really ran its concurrent stages while matching plans
+    assert pipe.stage_stats["prewarm"]["runs"] >= 1
+
+
+def test_free_running_pipeline_decides_everything():
+    sc = tg.build_scenario("rollout", scale=40, seed=3)
+    rr = tg.run_free(sc, mode="pipeline", pace_s=0.01)
+    # free-running churn can evict a pod before its decision (those are
+    # forgotten, not samples); everything still pending at the end of
+    # injection must drain to a decision
+    assert 40 <= rr.pods_decided <= sc.total_creates
+    assert tg.monotonic_decision_order(rr)
+    assert rr.latency_ms["p50"] > 0.0
+    q = rr.stage_stats["queues"]["solve"]
+    assert q["cap"] == 1 and q["total_puts"] == rr.ticks
+
+
+# ---------------------------------------------------------------------------
+# pipeline lifecycle and observability
+
+
+def test_pipeline_debug_state_shape_and_quiesce():
+    harness = tg.TrafficHarness(teams=4)
+    from karpenter_core_tpu.serving import ServingPipeline
+
+    pipe = ServingPipeline(
+        harness.provisioner,
+        metrics=harness.metrics,
+        config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2),
+        on_decision=harness.bind,
+    )
+    pipe.attach_watch()
+    pipe.start()
+    try:
+        step = tg.Step(
+            creates=[tg.PodSpecLite(f"dbg-{i}", "250m", "256Mi", None, i % 4) for i in range(6)]
+        )
+        harness.inject_step(step, 0)
+        assert pipe.quiesce(timeout=30.0)
+        state = pipe.debug_state()
+        assert state["ticks"] >= 1
+        assert state["pods_ingested"] == 6
+        assert state["pods_decided"] == 6
+        assert set(state["queues"]) == {"solve", "telemetry"}
+        assert "decision_latency_ms" in state
+        assert state["last_ticks"], "tick log must retain completed ticks"
+        rec = state["last_ticks"][-1]
+        assert {"tick", "step_ms", "queue_wait_ms"} <= set(rec)
+        # decision-latency histogram observed through the metrics bridge
+        hist = harness.metrics.serving_decision_latency
+        assert sum(hist.totals.values()) == 6
+    finally:
+        pipe.stop()
+        harness.close()
+
+
+def test_pipeline_hold_gates_decisions():
+    harness = tg.TrafficHarness(teams=2)
+    from karpenter_core_tpu.serving import ServingPipeline
+
+    pipe = ServingPipeline(
+        harness.provisioner,
+        metrics=harness.metrics,
+        config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2),
+        on_decision=harness.bind,
+    )
+    pipe.attach_watch()
+    pipe.hold()
+    pipe.start()
+    try:
+        step = tg.Step(
+            creates=[tg.PodSpecLite(f"hold-{i}", "100m", "128Mi", None, 0) for i in range(3)]
+        )
+        harness.inject_step(step, 0)
+        time.sleep(0.3)
+        assert pipe.latency.decided_count() == 0, "held pipeline must not decide"
+        pipe.release()
+        assert pipe.quiesce(timeout=30.0)
+        assert pipe.latency.decided_count() == 3
+    finally:
+        pipe.stop()
+        harness.close()
+
+
+def test_catalog_event_triggers_background_prewarm():
+    harness = tg.TrafficHarness(teams=2)
+    from karpenter_core_tpu.serving import ServingPipeline
+
+    pipe = ServingPipeline(
+        harness.provisioner,
+        metrics=harness.metrics,
+        config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2),
+        on_decision=harness.bind,
+    )
+    harness.on_catalog_event = pipe.observe_catalog_event
+    pipe.attach_watch()
+    pipe.start()
+    try:
+        step = tg.Step(
+            creates=[tg.PodSpecLite(f"cat-{i}", "250m", "256Mi", None, 0) for i in range(3)]
+        )
+        harness.inject_step(step, 0)
+        assert pipe.quiesce(timeout=30.0)
+        harness.inject_step(tg.Step(mutate_catalog=True), 1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if pipe.debug_state()["prewarm"].get("catalog_prewarms", 0) >= 1:
+                break
+            time.sleep(0.01)
+        assert pipe.debug_state()["prewarm"]["catalog_prewarms"] >= 1
+    finally:
+        pipe.stop()
+        harness.close()
