@@ -1098,6 +1098,335 @@ def config8() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 9: device-scale disruption engine (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def _disrupt_cmd_key(cmd):
+    """Canonical identity of a disruption command — action, disrupted
+    node set, replacement types — the identity gate's comparison unit."""
+    if cmd is None:
+        return ("noop",)
+    reps = tuple(
+        tuple(sorted(it.name for it in r.instance_type_options))
+        for r in (cmd.replacements or [])
+    )
+    return (
+        cmd.action(),
+        tuple(sorted(c.name() for c in cmd.candidates)),
+        reps,
+    )
+
+
+def disrupt_fleet(n_nodes: int, pods_per_node: int, seed: int = 9):
+    """The config-9 fleet: ``n_nodes`` initialized nodes under one pool
+    (5% disruption budget, mixed spot/on-demand across zones) carrying a
+    trafficgen-shaped bound workload of ``n_nodes*pods_per_node`` pods,
+    plus the rest of the spot_storm scenario as the churn stream.
+
+    Returns (env, scenario, bind_step, mutate_catalog) where
+    ``bind_step(step)`` applies one trafficgen Step to the live cluster
+    (creates bound first-fit, evicts/deletes removed) and
+    ``mutate_catalog()`` applies a price storm."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from helpers import Env
+
+    from karpenter_core_tpu.apis.nodepool import Budget
+    from karpenter_core_tpu.cloudprovider.fake import (
+        new_instance_type,
+        price_from_resources,
+    )
+    from karpenter_core_tpu.cloudprovider.types import Offering
+    from karpenter_core_tpu.kube.quantity import parse_quantity
+    from karpenter_core_tpu.serving import trafficgen as tg
+
+    def catalog(price_factor: float = 1.0):
+        out = []
+        for name, cpu, mem, pods in (
+            # pods capacity == the per-node workload: the base fleet is
+            # pods-full, so the steady-state phase's no-op is decided by
+            # the screen alone (k_hi = 0 proves it); the spot storm then
+            # opens capacity and with it real consolidation decisions
+            ("dx-host", "160", "320Gi", str(pods_per_node)),
+            ("dx-half", "80", "160Gi", str(max(1, pods_per_node // 2))),
+        ):
+            res = {"cpu": cpu, "memory": mem, "pods": pods}
+            price = price_from_resources(
+                {k: parse_quantity(v) for k, v in res.items()}
+            ) * price_factor
+            out.append(
+                new_instance_type(
+                    name,
+                    res,
+                    offerings=[
+                        Offering(ct, z, price * (0.4 if ct == "spot" else 1.0))
+                        for ct in ("spot", "on-demand")
+                        for z in ("test-zone-1", "test-zone-2")
+                    ],
+                )
+            )
+        return out
+
+    env = Env()
+    env.provider.set_instance_types(catalog())
+    env.provisioner.use_tpu_solver = True
+    # the reference's default budget shape: at most 5% of the pool per
+    # pass — which is also what keeps every verification simulation
+    # reference-sized at 500 nodes
+    env.nodepool.spec.disruption.budgets = [Budget(nodes="5%")]
+    env.kube.apply(env.nodepool)
+
+    nodes = []
+    for i in range(n_nodes):
+        node, _ = env.make_initialized_node(
+            instance_type_name="dx-host",
+            zone=f"test-zone-{1 + i % 2}",
+            capacity_type="spot" if i % 10 < 3 else "on-demand",
+        )
+        nodes.append(node)
+    # per-node load ledger for first-fit binding: pods capped at the
+    # type's pods capacity (the base step packs the fleet pods-full),
+    # cpu capped below the type's 160 so no node over-commits
+    cpu_cap_m, pods_cap = 155_000, pods_per_node
+    used = {n.name: [0, 0] for n in nodes}
+    by_name: dict = {}
+
+    def _bind(spec) -> bool:
+        cpu_m = int(str(spec.cpu)[:-1])  # "1300m" -> 1300
+        start = hash(spec.name) % n_nodes
+        for j in range(n_nodes):
+            node = nodes[(start + j) % n_nodes]
+            u = used[node.name]
+            if u[0] + cpu_m <= cpu_cap_m and u[1] < pods_cap:
+                # gpu stripped: the dx fleet is cpu/mem shaped, and a
+                # never-fitting request would just veto consolidation
+                pod = _mk_pod(spec.name, spec.cpu, spec.mem,
+                              labels={"team": f"t{spec.team}"})
+                pod.metadata.name = spec.name
+                pod.spec.node_name = node.name
+                pod.status.phase = "Running"
+                pod.status.conditions = []
+                env.kube.create(pod)
+                u[0] += cpu_m
+                u[1] += 1
+                by_name[spec.name] = (pod, node.name, cpu_m)
+                return True
+        return False
+
+    def bind_step(step, create_fraction: float = 1.0) -> dict:
+        """Apply one trafficgen Step. ``create_fraction`` < 1 models a
+        partial recovery (interrupted workloads that return elsewhere or
+        scale away) — what leaves the fleet consolidatable after the
+        storm, which is the decision the engine exists for."""
+        removed = 0
+        for name in list(step.evicts) + list(step.deletes):
+            ent = by_name.pop(name, None)
+            if ent is None:
+                continue
+            pod, node_name, cpu_m = ent
+            env.kube.delete(pod)
+            used[node_name][0] -= cpu_m
+            used[node_name][1] -= 1
+            removed += 1
+        creates = step.creates[: int(len(step.creates) * create_fraction)]
+        bound = sum(1 for spec in creates if _bind(spec))
+        return {"bound": bound, "dropped": len(creates) - bound,
+                "removed": removed}
+
+    storms = [0]
+
+    def mutate_catalog() -> None:
+        storms[0] += 1
+        env.provider.set_instance_types(catalog(1.0 + 0.1 * (storms[0] % 3)))
+
+    scenario = tg.scenario_spot_storm(
+        scale=n_nodes * pods_per_node, teams=20, seed=seed
+    )
+    return env, scenario, bind_step, mutate_catalog
+
+
+def disrupt_decide(env, mode: str, single: bool = False):
+    """One consolidation decision under ``mode`` (batched | sequential):
+    → (command, decision_ms, engine stats, candidate count). Fresh
+    method instance per call (no consolidated-state latch); the
+    controller-shared engine keeps its cross-pass memos."""
+    from karpenter_core_tpu.disruption.budgets import build_disruption_budgets
+    from karpenter_core_tpu.disruption.helpers import get_candidates
+    from karpenter_core_tpu.disruption.methods import (
+        MultiNodeConsolidation,
+        SingleNodeConsolidation,
+    )
+
+    old = os.environ.get("KARPENTER_TPU_DISRUPT_ENGINE")
+    os.environ["KARPENTER_TPU_DISRUPT_ENGINE"] = mode
+    try:
+        ctx = env.controller.ctx
+        ctx.budgets = build_disruption_budgets(
+            env.cluster, env.kube, env.clock, env.controller.queue
+        )
+        cls = SingleNodeConsolidation if single else MultiNodeConsolidation
+        method = cls(ctx)
+        candidates = get_candidates(
+            env.cluster, env.kube, env.recorder, env.clock, env.provider,
+            method.should_disrupt, env.controller.queue,
+        )
+        t0 = time.perf_counter()
+        cmd = method.compute_command(candidates)
+        dt = (time.perf_counter() - t0) * 1000.0
+        return cmd, dt, (method.last_decision_stats or {}), len(candidates)
+    finally:
+        if old is None:
+            os.environ.pop("KARPENTER_TPU_DISRUPT_ENGINE", None)
+        else:
+            os.environ["KARPENTER_TPU_DISRUPT_ENGINE"] = old
+
+
+def config9() -> dict:
+    """Device-scale disruption engine (ISSUE 7): multi-node
+    consolidation decisions over a 50k-pod / 500-node fleet, driven by
+    the trafficgen spot_storm stream (churn trickles, a 30% spot
+    interruption storm, price storms), with three readings per decision:
+
+      identity — the batched engine's command must equal the sequential
+        oracle path's (prefix screen + bounded verification) on every
+        step, multi- AND single-node.
+      churn latency — decision p50/p99 while the stream mutates the
+        cluster (every decision re-screens: the generation moved).
+      steady state — repeated decisions on the unchanged cluster: the
+        bounds memo hits, so the decision pays one warm verification
+        solve (<100 ms target, the ROADMAP item-1 gate)."""
+    from karpenter_core_tpu.disruption.types import ACTION_NOOP
+
+    n_nodes = _scale(500)
+    pods_per_node = 100
+    env, scenario, bind_step, mutate_catalog = disrupt_fleet(n_nodes, pods_per_node)
+    try:
+        t0 = time.perf_counter()
+        base = bind_step(scenario.steps[0])
+        build_s = time.perf_counter() - t0
+        env.now += 3600.0
+        assert env.cluster.synced()
+
+        identical = 0
+        decisions = 0
+        churn_ms: list = []
+        seq_churn_ms: list = []
+        engine_stats = {}
+        steps_out = []
+        with nogc():
+            # phase A — steady state on the pods-full fleet: the no-op
+            # is screen-proven (k_hi == 0, zero simulations); pass 1
+            # computes the bounds, passes 2+ serve them from the
+            # generation-keyed memo. This is the per-tick cost of
+            # running disruption continuously (serving stage).
+            cmd_b, cold_ms, engine_stats, n_cands = disrupt_decide(env, "batched")
+            cmd_s, cold_seq_ms, _, _ = disrupt_decide(env, "sequential")
+            decisions += 1
+            identical += _disrupt_cmd_key(cmd_b) == _disrupt_cmd_key(cmd_s)
+            noop_steady = cmd_b.action() == ACTION_NOOP
+            steady_ms: list = []
+            seq_steady: list = []
+            for _ in range(5):
+                _, dt, st, _ = disrupt_decide(env, "batched")
+                steady_ms.append(dt)
+            for _ in range(2):
+                _, dt, _, _ = disrupt_decide(env, "sequential")
+                seq_steady.append(dt)
+            # phase B — the churn stream: trickles, the 30% spot storm,
+            # recovery, plus a price storm between waves (catalog
+            # generation moves). Every decision gated on identity.
+            for i, step in enumerate(scenario.steps[1:]):
+                # the storm wave recovers at 70% — spot-interrupted
+                # workloads partially return — so the settled fleet has
+                # real consolidation headroom (phase C verifies it)
+                storm = len(step.evicts) > n_nodes * pods_per_node * 0.1
+                bind_step(step, create_fraction=0.7 if storm else 1.0)
+                if i == 1:
+                    mutate_catalog()
+                env.now += 60.0
+                cmd_b, dt_b, st, _ = disrupt_decide(env, "batched")
+                cmd_s, dt_s, _, _ = disrupt_decide(env, "sequential")
+                decisions += 1
+                same = _disrupt_cmd_key(cmd_b) == _disrupt_cmd_key(cmd_s)
+                identical += same
+                churn_ms.append(dt_b)
+                seq_churn_ms.append(dt_s)
+                steps_out.append(
+                    {
+                        "step": i + 1,
+                        "batched_ms": round(dt_b, 1),
+                        "sequential_ms": round(dt_s, 1),
+                        "identical": bool(same),
+                        "action": cmd_b.action(),
+                        "screen_upper_k": st.get("screen_upper_k"),
+                        "repack_lower_k": st.get("repack_lower_k"),
+                    }
+                )
+                engine_stats = st or engine_stats
+            # single-node identity on the settled cluster
+            cmd_b1, single_ms, _, _ = disrupt_decide(env, "batched", single=True)
+            cmd_s1, _, _, _ = disrupt_decide(env, "sequential", single=True)
+            decisions += 1
+            identical += _disrupt_cmd_key(cmd_b1) == _disrupt_cmd_key(cmd_s1)
+            # phase C — steady verify: repeated decisions on the settled
+            # (consolidatable) cluster. Bounds memo hits; the successful
+            # command re-verifies through one warm simulated solve per
+            # pass (successes are never memoized — they change the world)
+            verify_ms: list = []
+            for _ in range(4):
+                _, dt, st, _ = disrupt_decide(env, "batched")
+                verify_ms.append(dt)
+                engine_stats = st or engine_stats
+            _, verify_seq_ms, _, _ = disrupt_decide(env, "sequential")
+
+        def pct(a, q):
+            return round(float(np.percentile(np.asarray(a), q)), 1) if a else 0.0
+
+        steady_p50 = pct(steady_ms, 50)
+        return {
+            "config": f"9: disruption engine, {base['bound']} pods x {n_nodes} nodes, "
+                      f"spot_storm stream ({len(scenario.steps)} steps)",
+            "build_sec": round(build_s, 1),
+            "candidates_per_pass": n_cands,
+            "budget_capped_to": engine_stats.get("candidates"),
+            "plan_identity": f"{identical}/{decisions}",
+            "plan_identical_all": identical == decisions,
+            "steady_noop_verified": bool(noop_steady),
+            "cold_decision_ms": round(cold_ms, 1),
+            "cold_sequential_ms": round(cold_seq_ms, 1),
+            "steady_decision_ms": {
+                "p50": steady_p50,
+                "p99": pct(steady_ms, 99),
+            },
+            "steady_sequential_ms": {
+                "p50": pct(seq_steady, 50), "p99": pct(seq_steady, 99)
+            },
+            "steady_target_ms": 100,
+            "steady_under_target": steady_p50 < 100,
+            "churn_decision_ms": {"p50": pct(churn_ms, 50), "p99": pct(churn_ms, 99)},
+            "churn_sequential_ms": {
+                "p50": pct(seq_churn_ms, 50), "p99": pct(seq_churn_ms, 99)
+            },
+            "steady_verify_ms": {
+                "p50": pct(verify_ms, 50), "p99": pct(verify_ms, 99)
+            },
+            "steady_verify_sequential_ms": round(verify_seq_ms, 1),
+            "single_node_decision_ms": round(single_ms, 1),
+            "engine": {
+                k: engine_stats.get(k)
+                for k in (
+                    "engine", "candidates", "screen_upper_k", "repack_lower_k",
+                    "subsets_screened", "screen_feasible_subsets",
+                    "subsets_verified", "family_capped", "best_family", "cache",
+                )
+            },
+            "steps": steps_out,
+        }
+    finally:
+        env.stop()
+
+
+# ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
 
@@ -1226,9 +1555,9 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9):
             try:
-                if fn in (config7, config8):  # measure the incremental/serving paths
+                if fn in (config7, config8, config9):  # measure the incremental/serving/disruption paths
                     configs.append(fn())
                 else:
                     with incremental_off():

@@ -78,6 +78,7 @@ class AnalysisConfig:
         "karpenter_core_tpu/solver/solver.py",
         "karpenter_core_tpu/solver/encode.py",
         "karpenter_core_tpu/solver/merge.py",
+        "karpenter_core_tpu/disruption/engine.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)

@@ -10,8 +10,10 @@ from typing import Callable, List, Optional
 from ..apis import labels as wk
 from ..kube.objects import EFFECT_NO_SCHEDULE, Taint
 from ..provisioning.provisioner import LaunchOptions
+from ..tracing import tracer
 from ..utils import pod as podutils
 from .budgets import build_disruption_budgets
+from .engine import BatchedDisruptionEngine
 from .helpers import get_candidates
 from .methods import (
     Drift,
@@ -43,6 +45,9 @@ class DisruptionContext:
     # remaining voluntary disruptions per nodepool, rebuilt each pass
     # (disruption-controls.md); None = budgets not enforced (legacy tests)
     budgets: Optional[dict] = None
+    # the controller-shared batched disruption engine (engine.py);
+    # methods construct one lazily when absent (bare-ctx tests)
+    engine: Optional[object] = None
 
 
 class DisruptionController:
@@ -76,6 +81,10 @@ class DisruptionController:
             clock=clock,
             validation_sleep=validation_sleep,
         )
+        # the controller-shared batched engine (engine.py): one instance
+        # so its delta-keyed bounds/verdict memos persist across passes
+        if use_tpu_screen:
+            self.ctx.engine = BatchedDisruptionEngine(self.ctx)
         # method order is the disruption priority (controller.go:72-85)
         self.methods = [
             Expiration(self.ctx),
@@ -85,11 +94,23 @@ class DisruptionController:
             MultiNodeConsolidation(self.ctx, use_tpu_screen=use_tpu_screen),
             SingleNodeConsolidation(self.ctx, use_tpu_screen=use_tpu_screen),
         ]
+        # per-decision bounds/engine stats of the last pass that computed
+        # any (bench config 9 and /debug/traces read this)
+        self.last_decision_stats: Optional[dict] = None
 
     def reconcile(self) -> Optional[str]:
-        """One pass; returns the executed method name or None."""
+        """One pass; returns the executed method name or None. The pass
+        is span-traced (disrupt.{collect,screen,repack,verify,execute})
+        into the same solver_phase_duration bridge the solve path feeds;
+        passes that ran a simulation land in /debug/traces with the
+        engine's subset/bounds stats as root args."""
         if not self.cluster.synced():
             return None
+        sink = self.metrics.solver_phase_duration if self.metrics is not None else None
+        with tracer.trace_root("disrupt", metrics_sink=sink, buffer_if="solve") as tr:
+            return self._reconcile(tr)
+
+    def _reconcile(self, tr) -> Optional[str]:
         self._cleanup_stale_taints()
         # per-pass remaining disruption allowance per nodepool; methods
         # cap candidate selection against a snapshot of this map
@@ -97,27 +118,61 @@ class DisruptionController:
             self.cluster, self.kube_client, self.clock, self.queue
         )
         for method in self.methods:
-            candidates = get_candidates(
-                self.cluster,
-                self.kube_client,
-                self.ctx.recorder,
-                self.clock,
-                self.ctx.cloud_provider,
-                method.should_disrupt,
-                self.queue,
-            )
+            with tracer.span("disrupt.collect", method=method.type_name):
+                candidates = get_candidates(
+                    self.cluster,
+                    self.kube_client,
+                    self.ctx.recorder,
+                    self.clock,
+                    self.ctx.cloud_provider,
+                    method.should_disrupt,
+                    self.queue,
+                )
             if self.metrics is not None:
                 self.metrics.eligible_nodes.set(
                     len(candidates), method=method.type_name
                 )
             if not candidates:
                 continue
+            t0 = time.perf_counter()
+            method.last_decision_stats = None
             cmd = method.compute_command(candidates)
+            self._observe_decision(method, time.perf_counter() - t0, tr)
             if cmd.action() == ACTION_NOOP:
                 continue
-            self._execute(cmd, method)
+            if tr is not None:
+                tr.contains_solve = True  # executing passes always buffer
+            with tracer.span("disrupt.execute", method=method.type_name):
+                self._execute(cmd, method)
             return method.type_name
         return None
+
+    def _observe_decision(self, method, elapsed: float, tr) -> None:
+        """Surface one decision's screen-bounds sandwich + subset
+        counters (metrics, /debug/traces root args, last_decision_stats)."""
+        if self.metrics is not None:
+            self.metrics.disruption_evaluation_duration.observe(
+                elapsed, method=method.type_name
+            )
+        stats = getattr(method, "last_decision_stats", None)
+        if not stats:
+            return
+        self.last_decision_stats = stats
+        if tr is not None:
+            # a decision ran (screens dispatched, maybe zero sims): the
+            # pass is buffer-worthy even when the screen proved the
+            # no-op without a simulation
+            tr.contains_solve = True
+            tr.args.setdefault("disrupt", {})[
+                getattr(method, "consolidation_type", "") or method.type_name
+            ] = stats
+        if self.metrics is not None:
+            screened = stats.get("subsets_screened")
+            if screened:
+                self.metrics.disruption_subsets.inc(screened, stage="screened")
+            verified = stats.get("subsets_verified")
+            if verified:
+                self.metrics.disruption_subsets.inc(verified, stage="verified")
 
     # -- execute (controller.go:177-213) -----------------------------------
 

@@ -169,6 +169,15 @@ def simulate_scheduling(
     existing-capacity path (native/device first-fit) instead of the
     greedy O(P·M) per-pod loop — the same engine the provisioning path
     uses, so decisions agree by construction."""
+    from ..tracing import tracer
+
+    with tracer.trace_root(
+        "disrupt.simulate", is_solve=True, candidates=len(candidates)
+    ):
+        return _simulate(kube_client, cluster, provisioner, candidates)
+
+
+def _simulate(kube_client, cluster, provisioner, candidates: List[Candidate]) -> Results:
     candidate_names = {c.name() for c in candidates}
     nodes = cluster.deep_copy_nodes()
     deleting = [n for n in nodes if n.marked_for_deletion]
@@ -194,7 +203,8 @@ def simulate_scheduling(
         raise NodePoolsNotFoundError("no nodepools found")
     if getattr(provisioner, "use_tpu_solver", False):
         return _simulate_tpu(
-            kube_client, cluster, provisioner, pods, state_nodes, nodepools
+            kube_client, cluster, provisioner, pods, state_nodes, nodepools,
+            sim_drained=tuple(sorted(c.provider_id() for c in candidates)),
         )
     scheduler = build_scheduler(
         kube_client,
@@ -245,18 +255,47 @@ class PlanReplacementClaim:
         )
 
 
-def _simulate_tpu(
-    kube_client, cluster, provisioner, pods: List[Pod], state_nodes, nodepools
-) -> Results:
-    """TPU-backed simulation: one tensor solve over displaced pods +
-    surviving fleet; NodePlans adapt to replacement claims."""
+def _sim_scheduler(kube_client, cluster, provisioner, nodepools):
+    """The long-lived simulation TPUScheduler, cached on the provisioner
+    while the nodepool set is unchanged (the PR-4 reuse pattern of
+    Provisioner._schedule_tpu, on a separate instance so a probe never
+    races the live solve's per-solve state). Reuse is what makes probes
+    warm: the scheduler's provider-keyed caches (route, compat rows,
+    job, merge, seeds) persist across simulations AND are shared with
+    the live path — content-addressed, so sharing is free."""
     from ..solver import TPUScheduler
 
+    key = (id(kube_client), id(cluster)) + tuple(
+        (id(np_), np_.metadata.resource_version) for np_ in nodepools
+    )
+    cached = getattr(provisioner, "_sim_tpu_solver", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
     solver = TPUScheduler(
         nodepools, provisioner.cloud_provider, kube_client=kube_client, cluster=cluster
     )
+    try:
+        # the held nodepool list keeps the key's id()s stable
+        provisioner._sim_tpu_solver = (key, solver, list(nodepools))
+    except Exception:  # noqa: BLE001 — slotted/fake provisioner: fresh per probe
+        pass
+    return solver
+
+
+def _simulate_tpu(
+    kube_client, cluster, provisioner, pods: List[Pod], state_nodes, nodepools,
+    sim_drained: tuple = (),
+) -> Results:
+    """TPU-backed simulation: one tensor solve over displaced pods +
+    surviving fleet; NodePlans adapt to replacement claims.
+    ``sim_drained`` (sorted drained provider ids) keys the solve's
+    delta-sensitive memos — see TPUScheduler.solve."""
+    solver = _sim_scheduler(kube_client, cluster, provisioner, nodepools)
     sr = solver.solve(
-        pods, state_nodes=state_nodes, daemonset_pods=cluster.get_daemonset_pods()
+        pods,
+        state_nodes=state_nodes,
+        daemonset_pods=cluster.get_daemonset_pods(),
+        sim_drained=sim_drained,
     )
     results = sr.oracle_results or Results()
     results.pod_errors.update(sr.pod_errors)

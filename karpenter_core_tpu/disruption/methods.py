@@ -5,6 +5,7 @@ singlenodeconsolidation,validation}.go)."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, List, Optional
 
@@ -34,6 +35,33 @@ MAX_PARALLEL = 100  # multinodeconsolidation.go:58
 # forced the reference's 100-candidate cap become one batched dispatch;
 # the cap rises to bound only the post-screen oracle verification
 MAX_PARALLEL_TPU_SCREEN = 1000
+
+
+def max_parallel() -> int:
+    """Candidate cap for simulation-per-probe paths (the binary-search
+    fallback and the non-screen engine) — env-tunable, defaulting to the
+    reference's bound. Every path that pays a full scheduling simulation
+    per probe consults THIS cap, including the fallback below a failed
+    screen (the screen cap must not leak into probe sizing)."""
+    try:
+        return max(2, int(os.environ.get("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES", MAX_PARALLEL)))
+    except ValueError:
+        return MAX_PARALLEL
+
+
+def max_parallel_tpu_screen() -> int:
+    """Candidate cap for the one-dispatch screen paths."""
+    try:
+        return max(
+            2,
+            int(
+                os.environ.get(
+                    "KARPENTER_TPU_DISRUPT_MAX_CANDIDATES_TPU", MAX_PARALLEL_TPU_SCREEN
+                )
+            ),
+        )
+    except ValueError:
+        return MAX_PARALLEL_TPU_SCREEN
 
 
 class Method:
@@ -153,6 +181,25 @@ class ConsolidationBase(Method):
         self.ctx = ctx
         self.last_consolidation_state = -1.0
         self._budget_dropped = 0
+        # per-decision observability: the screen/repack bounds sandwich
+        # (and, on the batched engine, the whole family's stats) — read
+        # by the controller, bench config 9, and /debug/traces root args
+        self.last_decision_stats: Optional[dict] = None
+
+    def _engine(self):
+        """The controller-shared batched engine (disruption/engine.py),
+        constructed lazily for tests that build methods from a bare
+        ctx."""
+        eng = getattr(self.ctx, "engine", None)
+        if eng is None:
+            from .engine import BatchedDisruptionEngine
+
+            eng = BatchedDisruptionEngine(self.ctx)
+            try:
+                self.ctx.engine = eng
+            except Exception:  # noqa: BLE001 — frozen/legacy ctx: engine stays local
+                pass
+        return eng
 
     def is_consolidated(self) -> bool:
         return self.last_consolidation_state == self.ctx.cluster.consolidation_state()
@@ -261,12 +308,19 @@ class MultiNodeConsolidation(ConsolidationBase):
         self.use_tpu_screen = use_tpu_screen
 
     def compute_command(self, candidates: List[Candidate]) -> Command:
+        from .engine import engine_mode
+
         if self.is_consolidated():
             return Command()
         candidates = self.sort_and_filter(candidates)
-        cap = MAX_PARALLEL_TPU_SCREEN if self.use_tpu_screen else MAX_PARALLEL
-        max_parallel = min(len(candidates), cap)
-        cmd = self.first_n_consolidation(candidates, max_parallel)
+        cap = max_parallel_tpu_screen() if self.use_tpu_screen else max_parallel()
+        max_n = min(len(candidates), cap)
+        if self.use_tpu_screen and engine_mode() == "batched":
+            engine = self._engine()
+            cmd = engine.multi_command(self, candidates, max_n)
+            self.last_decision_stats = engine.last_engine_stats
+        else:
+            cmd = self.first_n_consolidation(candidates, max_n)
         if cmd.action() == ACTION_NOOP:
             self.mark_consolidated()
             return cmd
@@ -285,15 +339,33 @@ class MultiNodeConsolidation(ConsolidationBase):
 
         order = None
         if self.use_tpu_screen:
-            from .tpu_repack import repack_prefixes, screen_prefixes
+            from ..tracing import tracer
+            from . import tpu_repack
 
             # two one-dispatch bounds bracket the answer: the capacity
             # screen is optimistic (upper), the true batched repack is
             # conservative (lower) — together they replace the
             # reference's O(log N) simulation probes with usually ≤3
             # verification solves
-            k_hi = screen_prefixes(self.ctx, candidates[:max_n])
-            k_lo = repack_prefixes(self.ctx, candidates[:max_n])
+            with tracer.span("disrupt.screen", candidates=max_n):
+                k_hi = tpu_repack.screen_prefixes(self.ctx, candidates[:max_n])
+            with tracer.span("disrupt.repack", candidates=max_n):
+                k_lo = tpu_repack.repack_prefixes(self.ctx, candidates[:max_n])
+            self.last_decision_stats = {
+                "engine": "sequential",
+                "candidates": max_n,
+                "screen_upper_k": k_hi,
+                "repack_lower_k": k_lo,
+            }
+            # the screen is a sound necessary condition (capacity; same
+            # argument the single-node scan uses to prune), and screen
+            # infeasibility is upward-closed — a bigger prefix only adds
+            # load and removes surviving free space. k_hi == 0 therefore
+            # PROVES no multi-node prefix can consolidate: no-op without
+            # a single simulation (unless the differently-quantized
+            # repack bound disagrees — then its prefix is still tried)
+            if k_hi == 0 and k_lo < 2:
+                return Command()
             # descending: the two bounds use different capacity sets, so
             # k_lo can exceed the screen's k_hi — unsorted tries would
             # attempt (and return) a smaller prefix before the largest
@@ -306,8 +378,9 @@ class MultiNodeConsolidation(ConsolidationBase):
         if order is None:
             # no usable screen result: the raised TPU cap would make each
             # binary-search probe a near-1000-candidate simulation — fall
-            # back to the reference's bound (multinodeconsolidation.go:58)
-            return self._binary_search(candidates, min(max_n, MAX_PARALLEL), deadline)
+            # back to the simulation-sized cap (env-tunable; defaults to
+            # the reference's bound, multinodeconsolidation.go:58)
+            return self._binary_search(candidates, min(max_n, max_parallel()), deadline)
 
         attempted_min = order[0]
         for k in order[:4]:  # bounded verification attempts
@@ -319,9 +392,10 @@ class MultiNodeConsolidation(ConsolidationBase):
             attempted_min = min(attempted_min, k)
         # both bounds over-estimated; binary search the untried sizes
         # below the smallest prefix we actually attempted, capped so each
-        # probe's simulation stays reference-sized
+        # probe's simulation stays reference-sized (env cap: raising
+        # KARPENTER_TPU_DISRUPT_MAX_CANDIDATES raises probe sizing too)
         return self._binary_search(
-            candidates, min(max_n, attempted_min - 1, MAX_PARALLEL), deadline
+            candidates, min(max_n, attempted_min - 1, max_parallel()), deadline
         )
 
     def _attempt(self, prefix: List[Candidate]) -> Optional[Command]:
@@ -364,9 +438,16 @@ class SingleNodeConsolidation(ConsolidationBase):
         self.use_tpu_screen = use_tpu_screen
 
     def compute_command(self, candidates: List[Candidate]) -> Command:
+        from .engine import engine_mode
+
         if self.is_consolidated():
             return Command()
         candidates = self.sort_and_filter(candidates)
+        if self.use_tpu_screen and engine_mode() == "batched":
+            engine = self._engine()
+            cmd = engine.single_command(self, candidates)
+            self.last_decision_stats = engine.last_engine_stats
+            return cmd
         if self.use_tpu_screen and len(candidates) > 1:
             # capacity screen for ALL candidates in one device dispatch;
             # screen-infeasible ones cannot consolidate, so the linear

@@ -62,6 +62,38 @@ def prefix_screen_kernel(
 
 
 @jax.jit
+def subset_screen_kernel(
+    subset_masks: jnp.ndarray,  # (S, N) bool/float — candidate membership per subset
+    candidate_loads: jnp.ndarray,  # (N, R) int32 — per-candidate pod request sums
+    candidate_free: jnp.ndarray,  # (N, R) int32 — per-candidate free capacity
+    fleet_free: jnp.ndarray,  # (R,) int32 — free capacity of non-candidate fleet
+    new_node_cap: jnp.ndarray,  # (R,) int32 — largest launchable instance
+) -> jnp.ndarray:
+    """→ (S,) bool: removing exactly the masked candidates is
+    capacity-feasible — the arbitrary-subset generalization of
+    ``prefix_screen_kernel`` (ISSUE 7): the subset's displaced load must
+    fit the non-candidate fleet plus the NOT-removed candidates' free
+    space plus one replacement node. One dispatch screens the whole
+    subset family (every prefix of every sort order, per-pool/per-zone
+    prefixes, cross-pool merges) as two (S,N)×(N,R) contractions.
+
+    Feasibility is downward-closed: growing a subset only adds load and
+    removes surviving free space, so an infeasible subset proves every
+    superset infeasible — which is what lets the engine prune."""
+    loads = candidate_loads.astype(jnp.float32)
+    free = candidate_free.astype(jnp.float32)
+    m = subset_masks.astype(jnp.float32)
+    subset_load = m @ loads  # (S, R)
+    surviving_candidate_free = (1.0 - m) @ free  # (S, R)
+    headroom = (
+        fleet_free.astype(jnp.float32)[None, :]
+        + surviving_candidate_free
+        + new_node_cap.astype(jnp.float32)[None, :]
+    )
+    return jnp.all(subset_load <= headroom, axis=-1)
+
+
+@jax.jit
 def single_screen_kernel(
     candidate_loads: jnp.ndarray,  # (N, R) int32 — per-candidate pod request sums
     candidate_free: jnp.ndarray,  # (N, R) int32 — per-candidate free capacity
@@ -135,6 +167,30 @@ def screen_singles(ctx, candidates: List[Candidate]) -> np.ndarray:
     )
 
 
+def screen_subsets(ctx, candidates: List[Candidate], masks: np.ndarray) -> np.ndarray:
+    """(S,) bool capacity screen for arbitrary candidate subsets.
+    ``masks`` is (S, N) membership over ``candidates``; one device
+    dispatch evaluates every subset (see subset_screen_kernel)."""
+    masks = np.asarray(masks)
+    if not len(candidates) or masks.size == 0:
+        return np.zeros(masks.shape[0] if masks.ndim == 2 else 0, dtype=bool)
+    from ..solver.backend import default_backend
+
+    default_backend()  # see screen_singles: resolve before any jnp op
+    candidate_names, axis, loads, free = _encode_candidates(candidates)
+    fleet_free = _fleet_free(ctx, axis, candidate_names)
+    new_node_cap = _largest_launchable(ctx, axis)
+    return np.asarray(
+        subset_screen_kernel(
+            jnp.asarray(masks.astype(np.float32)),
+            jnp.asarray(loads),
+            jnp.asarray(free),
+            jnp.asarray(fleet_free),
+            jnp.asarray(new_node_cap),
+        )
+    )
+
+
 def _fleet_free(ctx, axis, candidate_names) -> np.ndarray:
     fleet_free = np.zeros(axis.count, dtype=np.int64)
     for node in ctx.cluster.deep_copy_nodes():
@@ -161,11 +217,23 @@ def _largest_launchable(ctx, axis) -> np.ndarray:
 
 
 def repack_prefixes(ctx, candidates: List[Candidate]) -> int:
-    """Largest prefix whose displaced pods actually PACK — a true
-    first-fit against per-node free capacity and label/taint
-    admissibility, not a capacity sum — onto the non-candidate fleet
-    plus one replacement node (SURVEY §7.7's "evaluate candidate
-    prefixes in one batched solve").
+    """Largest prefix size whose displaced pods actually pack (see
+    repack_feasible; 0 when none does)."""
+    feasible = repack_feasible(ctx, candidates)
+    if not feasible.any():
+        return 0
+    return int(np.max(np.flatnonzero(feasible))) + 1
+
+
+def repack_feasible(ctx, candidates: List[Candidate]) -> np.ndarray:
+    """(N,) bool — per-prefix repack feasibility: entry k-1 is True when
+    prefix k's displaced pods actually PACK — a true first-fit against
+    per-node free capacity and label/taint admissibility, not a
+    capacity sum — onto the non-candidate fleet plus one replacement
+    node (SURVEY §7.7's "evaluate candidate prefixes in one batched
+    solve"). Called with a reordered candidate list this prices every
+    prefix of ANY sort order in one pack — the batched-repack lower
+    bound the disruption engine uses per family order.
 
     One native/device pack prices every prefix at once: pods are packed
     in candidate order, bins only ever fill, so prefix k's pack state is
@@ -181,7 +249,7 @@ def repack_prefixes(ctx, candidates: List[Candidate]) -> int:
     from ..utils import pod as podutils
 
     if len(candidates) < 2:
-        return 0
+        return np.zeros(len(candidates), dtype=bool)
     from ..solver.backend import default_backend
 
     default_backend()  # see screen_singles: resolve before any device op
@@ -248,9 +316,7 @@ def repack_prefixes(ctx, candidates: List[Candidate]) -> int:
     else:
         feasible = np.ones(N, dtype=bool)  # nothing displaced: all delete
 
-    if not feasible.any():
-        return 0
-    return int(np.max(np.flatnonzero(feasible))) + 1
+    return feasible
 
 
 def screen_prefixes(ctx, candidates: List[Candidate]) -> int:

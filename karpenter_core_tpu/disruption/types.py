@@ -23,7 +23,15 @@ POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
 
 def pod_eviction_cost(pod: Pod) -> float:
     """helpers.go GetPodEvictionCost: base 1.0, scaled by the deletion-cost
-    annotation and pod priority."""
+    annotation and pod priority. Memoized on the pod object behind its
+    resource_version (the podcache ``_karp_memo`` rv-guard pattern):
+    candidate collection evaluates this for every bound pod of every
+    candidate on every pass — 50k calls per decision at config-9 scale —
+    and any annotation/priority edit moves the rv."""
+    cached = getattr(pod, "_karp_evict", None)
+    rv = pod.metadata.resource_version
+    if cached is not None and cached[0] == rv:
+        return cached[1]
     cost = 1.0
     deletion_cost = pod.metadata.annotations.get(POD_DELETION_COST_ANNOTATION)
     if deletion_cost:
@@ -34,6 +42,7 @@ def pod_eviction_cost(pod: Pod) -> float:
             pass
     if pod.spec.priority is not None:
         cost += float(pod.spec.priority) / 1e6
+    pod._karp_evict = (rv, cost)
     return cost
 
 

@@ -212,6 +212,11 @@ class Metrics:
         self.eligible_nodes = r.gauge(
             f"{ns}_disruption_eligible_nodes", "Disruption-eligible nodes", ["method"]
         )
+        self.disruption_subsets = r.counter(
+            f"{ns}_disruption_subsets_total",
+            "Candidate node subsets processed by the disruption engine, by stage (screened | verified)",
+            ["stage"],
+        )
         self.consistency_errors = r.counter(f"{ns}_nodeclaims_consistency_errors", "Consistency errors")
         self.cloudprovider_duration = r.histogram(
             f"{ns}_cloudprovider_duration_seconds", "Cloud provider method duration", labels=["method", "provider"]

@@ -132,6 +132,10 @@ class Operator:
                     idle_seconds=self.options.batch_idle_duration,
                     max_seconds=self.options.batch_max_duration,
                 ),
+                # continuous disruption (KARPENTER_TPU_SERVING_DISRUPT_EVERY
+                # > 0): the pass runs as a plan-thread stage; the 10 s
+                # singleton below stays as the safety net either way
+                disruption=self.disruption,
             )
 
         # the reconcile surface, mirroring controllers.go:47-82
@@ -178,6 +182,10 @@ class Operator:
         return None
 
     def _reconcile_disruption(self) -> None:
+        if self.serving is not None and self.serving.config.disrupt_every > 0:
+            # the serving pipeline owns disruption passes (plan-thread
+            # stage): running them here too would race its mutations
+            return None
         self.disruption.reconcile()
         return None
 

@@ -111,7 +111,10 @@ class VolumeUsage:
 
     def copy(self) -> "VolumeUsage":
         out = VolumeUsage(dict(self.csi_limits))
-        out.pod_volumes = {k: v.union(Volumes()) for k, v in self.pod_volumes.items()}
+        # share the per-pod Volumes values (add() assigns them whole and
+        # insert/union only read them); the aggregate is rebuilt fresh
+        # because insert() mutates its sets in place
+        out.pod_volumes = dict(self.pod_volumes)
         for v in out.pod_volumes.values():
             out.volumes.insert(v)
         return out

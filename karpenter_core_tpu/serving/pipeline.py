@@ -79,6 +79,18 @@ class PipelineConfig:
     prewarm: bool = field(
         default_factory=lambda: os.environ.get("KARPENTER_TPU_SERVING_PREWARM", "1") != "0"
     )
+    # run the disruption pass as a pipeline stage every N plan ticks
+    # (0 = off). It executes ON the plan thread, after the provisioning
+    # step — disruption mutates claims/taints/cluster marks, and the
+    # overlap-safety invariant says only the plan thread mutates
+    # observable state. The batched engine's cross-pass memos
+    # (disruption/engine.py) make the steady-state pass cheap, which is
+    # what lets it ride the serving cadence instead of a 10 s timer.
+    disrupt_every: int = field(
+        default_factory=lambda: int(
+            _env_float("KARPENTER_TPU_SERVING_DISRUPT_EVERY", 0)
+        )
+    )
 
     def to_dict(self) -> dict:
         return {
@@ -87,6 +99,7 @@ class PipelineConfig:
             "solve_queue_cap": self.solve_queue_cap,
             "telemetry_queue_cap": self.telemetry_queue_cap,
             "prewarm": self.prewarm,
+            "disrupt_every": self.disrupt_every,
         }
 
 
@@ -158,6 +171,7 @@ class ServingPipeline:
         config: Optional[PipelineConfig] = None,
         latency: Optional[DecisionLatencyTracker] = None,
         on_decision: Optional[Callable] = None,
+        disruption=None,
     ):
         self.provisioner = provisioner
         self.kube_client = provisioner.kube_client
@@ -176,6 +190,12 @@ class ServingPipeline:
             "telemetry", self.config.telemetry_queue_cap, depth_gauge
         )
         self._step = _DecisionStep(provisioner, self.latency, on_decision)
+        # optional continuous-disruption stage (DisruptionController):
+        # reconciled on the plan thread every `disrupt_every` ticks, so
+        # the single-writer invariant holds for disruption's mutations
+        # (taints, claims, deletion marks) exactly as for provisioning's
+        self.disruption = disruption
+        self._disrupt_log: deque = deque(maxlen=32)
         self._stop_evt = threading.Event()
         self._new_pods_evt = threading.Event()
         # the double-buffer handshake: set by the live solver the moment
@@ -298,11 +318,46 @@ class ServingPipeline:
                 with self._mu:
                     self._step_inflight = False
                 self._encode_done_evt.set()
+            self._maybe_disrupt(tick, rec)
             rec["queue_wait_ms"] = queue_wait_ms
             try:
                 self.telemetry_q.put(rec, timeout=1.0)
             except Closed:
                 return
+
+    def _maybe_disrupt(self, tick: int, rec: dict) -> None:
+        """Continuous-disruption stage: one DisruptionController pass on
+        the plan thread every `disrupt_every` ticks (0 = off). Runs
+        after the provisioning step so the pass sees this tick's
+        nominations; the engine's cross-pass memos make a no-change pass
+        nearly free, which is what makes per-tick cadence viable."""
+        if self.disruption is None or self.config.disrupt_every <= 0:
+            return
+        if tick % self.config.disrupt_every != 0:
+            return
+        t0 = time.perf_counter()
+        try:
+            executed = self.disruption.reconcile()
+        except Exception:  # noqa: BLE001 — a failed pass must not kill serving
+            log.exception("serving disruption pass at tick %d failed", tick)
+            return
+        rec["disrupt_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+        if executed:
+            rec["disrupt_method"] = executed
+        stats = getattr(self.disruption, "last_decision_stats", None)
+        with self._mu:
+            self._disrupt_log.append(
+                {
+                    "tick": tick,
+                    "ms": rec["disrupt_ms"],
+                    "executed": executed,
+                    "stats": stats,
+                }
+            )
+        if self.metrics is not None:
+            self.metrics.serving_stage_duration.observe(
+                rec["disrupt_ms"] / 1000.0, stage="disrupt"
+            )
 
     # -- telemetry stage -----------------------------------------------------
 
@@ -529,6 +584,7 @@ class ServingPipeline:
                 "catalog_prewarms": self._catalog_prewarms,
                 **self._prewarm_stats,
             }
+            disrupt_log = list(self._disrupt_log)[-4:]
         return {
             "config": self.config.to_dict(),
             "ticks": ticks,
@@ -542,6 +598,11 @@ class ServingPipeline:
             },
             "prewarm": prewarm,
             "last_ticks": tick_log,
+            "disrupt": {
+                "every": self.config.disrupt_every,
+                "attached": self.disruption is not None,
+                "last_passes": disrupt_log,
+            },
         }
 
 

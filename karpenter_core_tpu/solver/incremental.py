@@ -72,6 +72,10 @@ _CAPS = {
     "emit": ("KARPENTER_TPU_EMIT_CACHE_MAX", 2048),
     "mergerow": ("KARPENTER_TPU_MERGEROW_CACHE_MAX", 2048),
     "seeds": ("KARPENTER_TPU_SEED_CACHE_MAX", 256),
+    # disruption-engine memos (disruption/engine.py): family bounds per
+    # candidate set, negative drain verdicts per drained subset
+    "disruptbounds": ("KARPENTER_TPU_DISRUPT_BOUNDS_CACHE_MAX", 64),
+    "disruptverify": ("KARPENTER_TPU_DISRUPT_VERIFY_CACHE_MAX", 4096),
 }
 _INTERSECTS_MAX = 4096  # content-addressed; clearing only costs re-derivation
 

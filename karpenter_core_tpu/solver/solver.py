@@ -541,6 +541,7 @@ class TPUScheduler:
         self._ledger_selectors: List[tuple] = []
         self._postpass_matrix = None
         self._postpass_remaining: Optional[Dict[str, dict]] = None
+        self._sim_drained: Optional[tuple] = None
 
     # ------------------------------------------------------------------
 
@@ -549,12 +550,25 @@ class TPUScheduler:
         pods: List[Pod],
         state_nodes=None,
         daemonset_pods: Optional[List[Pod]] = None,
+        sim_drained: Optional[tuple] = None,
     ) -> SolverResult:
         """One batched solve, span-traced end to end (tracing/ — SURVEY
         §5's tracing obligation; the reference's --enable-profiling
         pprof, operator.go:144-160). With KARPENTER_TPU_PROFILE_DIR set,
         the whole solve additionally runs under jax.profiler.trace so
-        device dispatches land in an xprof-readable trace."""
+        device dispatches land in an xprof-readable trace.
+
+        ``sim_drained`` marks a disruption simulation ("what if we drain
+        these nodes") and carries the sorted provider-id tuple of the
+        drained candidates. It rides every cross-solve memo key the
+        simulated world could shift (the topology seed cache) so a
+        drained-node solve can never alias the undrained one, and it
+        suppresses the whole-solve replay snapshot — a simulation must
+        not evict the provisioner's recorded tick. The content caches
+        (route, compat rows, job, merge, intersects) stay shared: they
+        are keyed by the exact inputs of their computation, so a warm
+        simulation probe reuses the live path's work by construction
+        (ISSUE 7: a probe is a warm solve, not a cold pipeline)."""
         import time as _time
 
         profile_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
@@ -569,8 +583,10 @@ class TPUScheduler:
                     import jax
 
                     with jax.profiler.trace(profile_dir):
-                        return self._solve(pods, state_nodes, daemonset_pods)
-                return self._solve(pods, state_nodes, daemonset_pods)
+                        return self._solve(
+                            pods, state_nodes, daemonset_pods, sim_drained
+                        )
+                return self._solve(pods, state_nodes, daemonset_pods, sim_drained)
             finally:
                 total = _time.perf_counter() - t0
                 device = devicetime.seconds()
@@ -614,8 +630,13 @@ class TPUScheduler:
         pods: List[Pod],
         state_nodes=None,
         daemonset_pods: Optional[List[Pod]] = None,
+        sim_drained: Optional[tuple] = None,
     ) -> SolverResult:
         result = SolverResult()
+        # drained-node delta of a disruption simulation (None = live
+        # solve); a component of every memo key whose result the
+        # simulated world could shift — see solve()
+        self._sim_drained = tuple(sim_drained) if sim_drained is not None else None
         self._merge_stats = {
             "merge_ms": 0.0,
             "merge_records": 0,
@@ -709,7 +730,11 @@ class TPUScheduler:
             self._commit_existing_plans(pods, result)
             with tracer.span("oracle_fallback", pods=len(oracle_pods)):
                 self._solve_oracle(oracle_pods, state_nodes, daemonset_pods, result)
-        if ws is not None:
+        if ws is not None and self._sim_drained is None:
+            # simulations never record: clearing the snapshot here would
+            # evict the provisioner's replayable tick every time a
+            # disruption probe runs in between (the probe reads nothing
+            # the snapshot keys miss — it just must not write)
             ws.record(
                 self, pods, state_nodes, daemonset_pods, result, self._replay_ctx
             )
@@ -2348,7 +2373,10 @@ class TPUScheduler:
             gen = getattr(self, "_cluster_gen", None)
             skey = None
             if ws is not None and gen is not None:
-                skey = key + (self._seed_exclusion_key(),)
+                # the drained-node delta keeps a disruption simulation's
+                # seed counts from aliasing the undrained solve's (and
+                # different drain subsets from aliasing each other)
+                skey = key + (self._seed_exclusion_key(), self._sim_drained)
                 seeds = ws.seeds_get(skey, gen, self._cstats)
             if seeds is None:
                 with tracer.span("pack.spread_seeds"):

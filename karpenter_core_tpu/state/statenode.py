@@ -278,10 +278,16 @@ class StateNode:
 
     def deep_copy(self) -> "StateNode":
         out = StateNode(_clone_node(self.node), _clone_node_claim(self.node_claim))
-        out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
-        out.pod_limits = {k: dict(v) for k, v in self.pod_limits.items()}
-        out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}
-        out.daemonset_limits = {k: dict(v) for k, v in self.daemonset_limits.items()}
+        # flat copies sharing the VALUE dicts: every writer replaces a
+        # key's value whole (update_for_pod assigns fresh ResourceLists,
+        # cleanup_pod pops) and every reader merges/subtracts into new
+        # dicts — values are immutable by discipline, so copying them
+        # per node was pure waste (it dominated deep_copy_nodes at 500
+        # nodes × 100 pods: ~200 ms/call before, ISSUE 7 profile)
+        out.pod_requests = dict(self.pod_requests)
+        out.pod_limits = dict(self.pod_limits)
+        out.daemonset_requests = dict(self.daemonset_requests)
+        out.daemonset_limits = dict(self.daemonset_limits)
         out.host_port_usage = self.host_port_usage.copy()
         out.volume_usage = self.volume_usage.copy()
         out.marked_for_deletion = self.marked_for_deletion

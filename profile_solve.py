@@ -2,6 +2,14 @@
 
 Usage: python profile_solve.py [pods] [types] [--ticks N] [--churn RATE]
        python profile_solve.py --stream SCENARIO [--scale N] [--pace S]
+       python profile_solve.py --disrupt [--nodes N] [--pods-per-node K]
+
+With --disrupt, builds the config-9 consolidation fleet (bench.py
+disrupt_fleet: N nodes, N*K bound pods, 5% budget), runs one cold
+batched decision, prints warm decision timings + the engine's
+bounds/subset stats, then cProfile of one warm decision — the
+disruption analogue of the solve profiles, through the same batched
+engine the DisruptionController runs (disruption/engine.py).
 
 With --ticks, drives N repeated solves through the steady-state
 incremental path (solver/incremental.py) over a churning batch —
@@ -55,6 +63,16 @@ def _parse_args():
     ap.add_argument("--mode", default="pipeline",
                     choices=("pipeline", "sequential"),
                     help="serving mode to profile (with --stream)")
+    ap.add_argument("--disrupt", action="store_true",
+                    help="disruption mode: profile a batched "
+                         "consolidation decision over the config-9 fleet")
+    ap.add_argument("--nodes", type=int, default=500,
+                    help="fleet size (with --disrupt)")
+    ap.add_argument("--pods-per-node", type=int, default=100,
+                    help="bound pods per node (with --disrupt)")
+    ap.add_argument("--engine", default="batched",
+                    choices=("batched", "sequential"),
+                    help="disruption engine to profile (with --disrupt)")
     return ap.parse_args()
 
 
@@ -65,6 +83,9 @@ def main():
     print("backend:", backend, file=sys.stderr)
     if args.stream:
         _stream_mode(args)
+        return
+    if args.disrupt:
+        _disrupt_mode(args)
         return
 
     from karpenter_core_tpu.apis import labels as wk
@@ -171,6 +192,40 @@ def _stream_mode(args):
     s = io.StringIO()
     pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(45)
     print(s.getvalue())
+
+
+def _disrupt_mode(args):
+    """--disrupt: cold + warm batched consolidation decisions over the
+    config-9 fleet, engine stats, then cProfile of one warm decision
+    (bounds memo hot: the profile shows the verification solve and the
+    decision's host overhead, not the one-time family screen)."""
+    import json
+
+    env, scenario, bind_step, _mutate = bench.disrupt_fleet(
+        args.nodes, args.pods_per_node
+    )
+    try:
+        base = bind_step(scenario.steps[0])
+        env.now += 3600.0
+        print(f"fleet: {args.nodes} nodes, {base['bound']} bound pods "
+              f"({base['dropped']} dropped)", file=sys.stderr)
+        _, cold_ms, stats, n_cands = bench.disrupt_decide(env, args.engine)
+        print(f"cold decision: {cold_ms:.1f} ms over {n_cands} candidates",
+              file=sys.stderr)
+        for i in range(3):
+            _, dt, stats, _ = bench.disrupt_decide(env, args.engine)
+            print(f"warm decision {i}: {dt:.1f} ms", file=sys.stderr)
+        print("engine stats:", json.dumps(stats, indent=1, default=str),
+              file=sys.stderr)
+        pr = cProfile.Profile()
+        pr.enable()
+        bench.disrupt_decide(env, args.engine)
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(45)
+        print(s.getvalue())
+    finally:
+        env.stop()
 
 
 def _tick_mode(args, solver, pods, make_pod, rng):

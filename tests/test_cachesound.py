@@ -406,6 +406,7 @@ _MUT_FILES = [
     "karpenter_core_tpu/provisioning/provisioner.py",
     "karpenter_core_tpu/scheduler/scheduler.py",
     "karpenter_core_tpu/disruption/helpers.py",
+    "karpenter_core_tpu/disruption/engine.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -424,7 +425,8 @@ _MUTANTS = [
      "trail = trails[ci] if trails is not None else None",
      "trail = ci if trails is not None else None", "cache-key"),
     ("seed-key-drop-exclusion", "karpenter_core_tpu/solver/solver.py",
-     "skey = key + (self._seed_exclusion_key(),)", "skey = key", "cache-key"),
+     "skey = key + (self._seed_exclusion_key(), self._sim_drained)",
+     "skey = key + (self._sim_drained,)", "cache-key"),
     ("compat-key-drop-poolfp", "karpenter_core_tpu/solver/solver.py",
      "(pool_fp, sid),", "(sid,),", "cache-key"),
     ("mergerow-key-drop-rkey", "karpenter_core_tpu/solver/merge.py",
@@ -476,6 +478,20 @@ _MUTANTS = [
      "    h.update(str(float(reqs.sum()) / 3.0).encode())", "cache-determinism"),
     ("set-iter-selector-keys", "karpenter_core_tpu/solver/podcache.py",
      "return tuple(sorted(keys))", "return tuple(keys)", "cache-determinism"),
+    # ISSUE 7: the delta-keyed simulation memos — a drained-node probe
+    # must never alias the undrained solve or another drained subset.
+    # (The solver-side sim_drained seed-key component and the verdict
+    # generation guard are defense-in-depth the read-set rule cannot
+    # witness — the cached computations never READ them — so those two
+    # invariants are held by behavior tests instead:
+    # tests/test_disrupt_engine.py TestSimDrainedDelta +
+    # TestVerdictMemoInvalidation.)
+    ("verdict-key-drop-subset", "karpenter_core_tpu/disruption/engine.py",
+     'vkey = (\n                "multi",\n                gen,\n                world,\n                tuple(sorted(c.provider_id() for c in subset)),\n            )',
+     'vkey = (\n                "multi",\n                gen,\n                world,\n            )', "cache-key"),
+    ("bounds-key-drop-candidates", "karpenter_core_tpu/disruption/engine.py",
+     "key = (gen, world, tuple(c.provider_id() for c in cands))",
+     "key = (gen, world)", "cache-key"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -484,6 +500,8 @@ _MANDATORY = {
     "emit-key-drop-trail", "seed-key-drop-exclusion", "compat-key-drop-poolfp",
     "mergerow-key-drop-rkey",
     "cluster-bump-del-update-node", "catalog-bump-del-set-types",
+    # ISSUE 7 acceptance: the drained-subset delta keys must be witnessed
+    "verdict-key-drop-subset", "bounds-key-drop-candidates",
 }
 
 

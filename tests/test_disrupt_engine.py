@@ -1,0 +1,533 @@
+"""Batched disruption engine (ISSUE 7): plan identity vs the sequential
+oracle path, delta-keyed memo invalidation, tracing, and env caps."""
+
+import os
+
+import numpy as np
+import pytest
+
+from helpers import Env, running_pod
+
+from karpenter_core_tpu.disruption import engine as engine_mod
+from karpenter_core_tpu.disruption.engine import BatchedDisruptionEngine, engine_mode
+from karpenter_core_tpu.disruption.helpers import get_candidates
+from karpenter_core_tpu.disruption.methods import (
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+    max_parallel,
+    max_parallel_tpu_screen,
+)
+from karpenter_core_tpu.disruption.types import ACTION_NOOP
+
+
+def cmd_key(cmd):
+    """Canonical command identity (action, node set, replacement types)."""
+    if cmd is None:
+        return ("none",)
+    reps = tuple(
+        tuple(sorted(it.name for it in r.instance_type_options))
+        for r in (cmd.replacements or [])
+    )
+    return (cmd.action(), tuple(sorted(c.name() for c in cmd.candidates)), reps)
+
+
+def seeded_env(seed: int) -> Env:
+    """A randomized consolidatable cluster: mixed types/zones/capacity
+    types, loads from empty to full, several spare nodes."""
+    rng = np.random.RandomState(seed)
+    env = Env()
+    for _ in range(int(rng.randint(6, 12))):
+        n_pods = int(rng.randint(0, 6))
+        pods = [
+            running_pod(cpu=f"{int(rng.choice([100, 200, 400]))}m")
+            for _ in range(n_pods)
+        ]
+        env.make_initialized_node(
+            instance_type_name=f"fake-it-{int(rng.randint(3, 9))}",
+            zone=f"test-zone-{1 + int(rng.randint(2))}",
+            capacity_type="spot" if rng.rand() < 0.3 else "on-demand",
+            pods=pods,
+        )
+    env.now += 3600.0
+    assert env.cluster.synced()
+    return env
+
+
+def decide(env, mode, monkeypatch, single=False):
+    monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", mode)
+    cls = SingleNodeConsolidation if single else MultiNodeConsolidation
+    method = cls(env.controller.ctx)
+    candidates = get_candidates(
+        env.cluster, env.kube, env.recorder, env.clock, env.provider,
+        method.should_disrupt, env.controller.queue,
+    )
+    return method.compute_command(candidates), method
+
+
+class TestEngineMode:
+    def test_default_is_batched(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_DISRUPT_ENGINE", raising=False)
+        assert engine_mode() == "batched"
+
+    def test_sequential_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", "sequential")
+        assert engine_mode() == "sequential"
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", "bogus")
+        assert engine_mode() == "batched"
+
+    def test_caps_env_tunable(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES", raising=False)
+        monkeypatch.delenv("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES_TPU", raising=False)
+        assert max_parallel() == 100
+        assert max_parallel_tpu_screen() == 1000
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES", "7")
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES_TPU", "33")
+        assert max_parallel() == 7
+        assert max_parallel_tpu_screen() == 33
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES", "junk")
+        assert max_parallel() == 100
+
+    def test_fallback_cap_follows_env_not_screen_cap(self, monkeypatch):
+        """The binary-search fallback sizes probes by the simulation cap
+        (env-tunable), never by the raised TPU screen cap."""
+        env = seeded_env(31)
+        try:
+            monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", "sequential")
+            monkeypatch.setenv("KARPENTER_TPU_DISRUPT_MAX_CANDIDATES", "3")
+            method = MultiNodeConsolidation(env.controller.ctx)
+            seen = []
+            orig = method._binary_search
+
+            def spy(candidates, max_n, deadline):
+                seen.append(max_n)
+                return orig(candidates, max_n, deadline)
+
+            method._binary_search = spy
+            # force the no-screen path so the fallback runs
+            method.use_tpu_screen = False
+            candidates = get_candidates(
+                env.cluster, env.kube, env.recorder, env.clock, env.provider,
+                method.should_disrupt, env.controller.queue,
+            )
+            method.compute_command(candidates)
+            assert seen and all(n <= 3 for n in seen)
+        finally:
+            env.stop()
+
+
+class TestPlanIdentity:
+    """The acceptance gate: the batched engine's command equals the
+    sequential oracle path's on seeded clusters × 3 seeds."""
+
+    @pytest.mark.parametrize("seed", [11, 22, 33])
+    def test_multi_node_identity(self, seed, monkeypatch):
+        env = seeded_env(seed)
+        try:
+            cmd_b, m_b = decide(env, "batched", monkeypatch)
+            cmd_s, _ = decide(env, "sequential", monkeypatch)
+            assert cmd_key(cmd_b) == cmd_key(cmd_s)
+            if cmd_b.action() != ACTION_NOOP:
+                stats = m_b.last_decision_stats
+                assert stats and stats["engine"] == "batched"
+        finally:
+            env.stop()
+
+    @pytest.mark.parametrize("seed", [11, 22, 33])
+    def test_single_node_identity(self, seed, monkeypatch):
+        env = seeded_env(seed)
+        try:
+            cmd_b, _ = decide(env, "batched", monkeypatch, single=True)
+            cmd_s, _ = decide(env, "sequential", monkeypatch, single=True)
+            assert cmd_key(cmd_b) == cmd_key(cmd_s)
+        finally:
+            env.stop()
+
+    @pytest.mark.parametrize("seed", [11, 22])
+    def test_identity_survives_warm_memos(self, seed, monkeypatch):
+        """Second decision (bounds + verdict memos warm) still equals a
+        fresh sequential decision — memoized reuse is never
+        approximation."""
+        env = seeded_env(seed)
+        try:
+            decide(env, "batched", monkeypatch)
+            cmd_b2, _ = decide(env, "batched", monkeypatch)
+            cmd_s, _ = decide(env, "sequential", monkeypatch)
+            assert cmd_key(cmd_b2) == cmd_key(cmd_s)
+        finally:
+            env.stop()
+
+
+class TestEngineStats:
+    def test_bounds_sandwich_surfaced(self, monkeypatch):
+        env = seeded_env(44)
+        try:
+            cmd, method = decide(env, "batched", monkeypatch)
+            stats = method.last_decision_stats
+            assert stats is not None
+            assert stats["engine"] == "batched"
+            assert "screen_upper_k" in stats and "repack_lower_k" in stats
+            assert stats["subsets_screened"] >= 1
+            assert "subsets_verified" in stats
+            assert "decision_ms" in stats
+            assert "cache" in stats
+            # per-order family report includes the canonical order
+            assert "cost" in stats.get("orders", {})
+        finally:
+            env.stop()
+
+    def test_sequential_path_surfaces_bounds_too(self, monkeypatch):
+        env = seeded_env(44)
+        try:
+            _, method = decide(env, "sequential", monkeypatch)
+            stats = method.last_decision_stats
+            assert stats is not None and stats["engine"] == "sequential"
+            assert "screen_upper_k" in stats and "repack_lower_k" in stats
+        finally:
+            env.stop()
+
+    def test_controller_stats_and_subset_counters(self, monkeypatch):
+        from karpenter_core_tpu.metrics.registry import Metrics
+
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", "batched")
+        env = seeded_env(55)
+        try:
+            metrics = Metrics()
+            env.controller.metrics = metrics
+            env.controller.reconcile()
+            stats = env.controller.last_decision_stats
+            # the consolidation methods ran: any pass that computed a
+            # consolidation decision surfaces its stats
+            if stats is not None:
+                assert stats["engine"] in ("batched", "sequential")
+                screened = stats.get("subsets_screened", 0)
+                if screened:
+                    assert metrics.disruption_subsets.get(stage="screened") > 0
+        finally:
+            env.stop()
+
+
+class TestDisruptTracing:
+    def test_reconcile_emits_disrupt_spans(self, monkeypatch):
+        from karpenter_core_tpu.tracing import tracer
+
+        monkeypatch.setenv("KARPENTER_TPU_TRACE", "1")
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", "batched")
+        # no empty nodes: the pass must fall through to the
+        # consolidation methods (whose decisions run the screens)
+        env = Env()
+        for _ in range(3):
+            env.make_initialized_node(
+                instance_type_name="fake-it-4",
+                pods=[running_pod(cpu="200m")],
+            )
+        env.now += 3600.0
+        assert env.cluster.synced()
+        try:
+            tracer.RING.clear()
+            env.controller.reconcile()
+            traces = tracer.RING.all()
+            disrupt = [t for t in traces if t.name == "disrupt"]
+            assert disrupt, [t.name for t in traces]
+            names = {s.name for t in disrupt for s in t.spans}
+            assert "disrupt.collect" in names
+            # a consolidation decision ran its screens under the root
+            assert {"disrupt.screen", "disrupt.repack"} & names
+            # engine stats ride the trace root args for /debug/traces
+            assert any("disrupt" in (t.args or {}) for t in disrupt)
+        finally:
+            env.stop()
+            tracer.RING.clear()
+
+
+class TestVerdictMemoInvalidation:
+    """A drained-node verdict must be scoped to (generation, world,
+    drained subset) — never aliasing the undrained solve or another
+    subset, always invalidated by cluster/catalog events."""
+
+    def _engine_and_method(self, env, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", "batched")
+        method = MultiNodeConsolidation(env.controller.ctx)
+        eng = method._engine()
+        candidates = get_candidates(
+            env.cluster, env.kube, env.recorder, env.clock, env.provider,
+            method.should_disrupt, env.controller.queue,
+        )
+        candidates = method.sort_and_filter(candidates)
+        return eng, method, candidates
+
+    def _spy_attempts(self, method):
+        calls = []
+        orig = method._attempt
+
+        def spy(prefix):
+            calls.append(tuple(sorted(c.name() for c in prefix)))
+            return orig(prefix)
+
+        method._attempt = spy
+        return calls
+
+    def test_failed_attempt_memoized_within_generation(self, monkeypatch):
+        env = Env()
+        try:
+            # two nodes so full their pods cannot move: every drain fails
+            for _ in range(2):
+                env.make_initialized_node(
+                    instance_type_name="fake-it-0",
+                    pods=[running_pod(cpu="900m")],
+                )
+            env.now += 3600.0
+            assert env.cluster.synced()
+            eng, method, cands = self._engine_and_method(env, monkeypatch)
+            calls = self._spy_attempts(method)
+            assert eng._attempt_multi(method, cands, 2) is None
+            assert len(calls) == 1
+            # memoized: same generation, same subset -> no new simulation
+            assert eng._attempt_multi(method, cands, 2) is None
+            assert len(calls) == 1
+        finally:
+            env.stop()
+
+    def test_subsets_never_alias(self, monkeypatch):
+        env = Env()
+        try:
+            for _ in range(3):
+                env.make_initialized_node(
+                    instance_type_name="fake-it-0",
+                    pods=[running_pod(cpu="900m")],
+                )
+            env.now += 3600.0
+            eng, method, cands = self._engine_and_method(env, monkeypatch)
+            calls = self._spy_attempts(method)
+            eng._attempt_multi(method, cands, 2)
+            # a different drained subset is a different key
+            eng._attempt_multi(method, cands, 3)
+            assert len(calls) == 2
+            assert calls[0] != calls[1]
+        finally:
+            env.stop()
+
+    def test_generation_bump_invalidates(self, monkeypatch):
+        env = Env()
+        try:
+            for _ in range(2):
+                env.make_initialized_node(
+                    instance_type_name="fake-it-0",
+                    pods=[running_pod(cpu="900m")],
+                )
+            env.now += 3600.0
+            eng, method, cands = self._engine_and_method(env, monkeypatch)
+            calls = self._spy_attempts(method)
+            eng._attempt_multi(method, cands, 2)
+            # any informer event moves Cluster.generation()
+            env.make_initialized_node(instance_type_name="fake-it-5")
+            eng2, method2, cands2 = self._engine_and_method(env, monkeypatch)
+            calls2 = self._spy_attempts(method2)
+            eng2._attempt_multi(method2, [c for c in cands2 if c.name() in calls[0]][:2], 2)
+            assert len(calls2) == 1  # re-simulated, not served from memo
+        finally:
+            env.stop()
+
+    def test_catalog_mutation_invalidates(self, monkeypatch):
+        from karpenter_core_tpu.cloudprovider.fake import instance_types
+
+        env = Env()
+        try:
+            for _ in range(2):
+                env.make_initialized_node(
+                    instance_type_name="fake-it-0",
+                    pods=[running_pod(cpu="900m")],
+                )
+            env.now += 3600.0
+            eng, method, cands = self._engine_and_method(env, monkeypatch)
+            calls = self._spy_attempts(method)
+            eng._attempt_multi(method, cands, 2)
+            assert len(calls) == 1
+            # a CONTENT-identical catalog reload keeps the world key —
+            # reuse is sound, no re-simulation
+            env.provider.set_instance_types(instance_types(10))
+            eng._attempt_multi(method, cands, 2)
+            assert len(calls) == 1
+            # a content CHANGE moves the world key and invalidates
+            env.provider.set_instance_types(instance_types(9))
+            eng._attempt_multi(method, cands, 2)
+            assert len(calls) == 2
+        finally:
+            env.stop()
+
+    def test_bounds_memo_hits_then_invalidates(self, monkeypatch):
+        env = seeded_env(77)
+        try:
+            eng, method, cands = self._engine_and_method(env, monkeypatch)
+            fb1 = eng._bounds(cands)
+            assert eng._bounds(cands) is fb1  # generation-stable hit
+            env.make_initialized_node(instance_type_name="fake-it-5")
+            eng2, method2, cands2 = self._engine_and_method(env, monkeypatch)
+            same = [c for c in cands2 if c.name() in {x.name() for x in cands}]
+            fb2 = eng2._bounds(same)
+            assert fb2 is not fb1
+        finally:
+            env.stop()
+
+
+class TestSimDrainedDelta:
+    """The solver-side half of the invariant: a simulation solve carries
+    its drained-node delta into the seed-cache key and never clears the
+    provisioner's replay snapshot."""
+
+    def _spread_pod(self, i):
+        from karpenter_core_tpu.apis import labels as wk
+        from helpers import make_pod, spread
+
+        return make_pod(
+            name=f"sp-{i}",
+            requests={"cpu": "100m"},
+            labels={"app": "sp"},
+            topology_spread=[spread(wk.LABEL_TOPOLOGY_ZONE, labels={"app": "sp"})],
+        )
+
+    def test_seed_key_carries_sim_drained(self, monkeypatch):
+        from karpenter_core_tpu.solver import TPUScheduler, incremental
+
+        monkeypatch.setenv("KARPENTER_TPU_INCREMENTAL", "1")
+        incremental.reset()
+        env = Env()
+        try:
+            env.make_initialized_node(instance_type_name="fake-it-5")
+            env.now += 3600.0
+            pods = [self._spread_pod(i) for i in range(3)]
+            solver = TPUScheduler(
+                [env.nodepool], env.provider, kube_client=env.kube, cluster=env.cluster
+            )
+            ws = incremental.warm_state_for(solver)
+            keys = []
+            orig_put = ws.seeds_put
+
+            def spy_put(key, generation, seeds, stats):
+                keys.append(key)
+                return orig_put(key, generation, seeds, stats)
+
+            monkeypatch.setattr(ws, "seeds_put", spy_put)
+            solver.solve(pods, sim_drained=("fake:///node-a",))
+            solver.solve(pods, sim_drained=("fake:///node-b",))
+            solver.solve(pods)  # live solve: delta component is None
+            assert len(keys) >= 3
+            deltas = {k[-1] for k in keys}
+            assert ("fake:///node-a",) in deltas
+            assert ("fake:///node-b",) in deltas
+            assert None in deltas  # the undrained solve never aliases
+        finally:
+            env.stop()
+            incremental.reset()
+
+    def test_simulation_does_not_clear_replay_snapshot(self, monkeypatch):
+        from karpenter_core_tpu.apis.nodepool import NodePool
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_core_tpu.solver import TPUScheduler, incremental
+        from helpers import make_pod
+
+        monkeypatch.setenv("KARPENTER_TPU_INCREMENTAL", "1")
+        incremental.reset()
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(5)
+        nodepool = NodePool()
+        nodepool.metadata.name = "np"
+        solver = TPUScheduler([nodepool], provider)
+        pods = [make_pod(name=f"p-{i}", requests={"cpu": "100m"}) for i in range(4)]
+        solver.solve(pods)
+        ws = incremental.warm_state_for(solver)
+        assert ws is not None and ws.snapshot is not None
+        # a disruption simulation in between must not evict the
+        # provisioner's replayable tick
+        sim_pods = [make_pod(name="sim-0", requests={"cpu": "100m"})]
+        solver.solve(sim_pods, sim_drained=("fake:///gone",))
+        assert ws.snapshot is not None
+        replayed = solver.solve(pods)
+        assert replayed is not None
+        cs = solver.last_cache_stats
+        assert cs["hits"].get("warmstart", 0) >= 1
+        incremental.reset()
+
+
+class TestEngineCaches:
+    def test_lru_caps_env_tunable(self, monkeypatch):
+        from karpenter_core_tpu.solver import incremental
+
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_BOUNDS_CACHE_MAX", "2")
+        lru = incremental.LRU("disruptbounds")
+        for i in range(5):
+            lru.put(("k", i), i)
+        assert len(lru) == 2
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_VERIFY_CACHE_MAX", "3")
+        lru2 = incremental.LRU("disruptverify")
+        for i in range(9):
+            lru2.put(("k", i), i)
+        assert len(lru2) == 3
+
+    def test_engine_is_controller_shared(self):
+        env = Env()
+        try:
+            assert isinstance(env.controller.ctx.engine, BatchedDisruptionEngine)
+            m = MultiNodeConsolidation(env.controller.ctx)
+            assert m._engine() is env.controller.ctx.engine
+        finally:
+            env.stop()
+
+
+class TestSubsetScreenKernel:
+    def test_subset_generalizes_prefix(self):
+        """Prefix masks through subset_screen_kernel == the prefix
+        kernel's verdicts (the subset kernel is a strict
+        generalization)."""
+        import jax.numpy as jnp
+
+        from karpenter_core_tpu.disruption.tpu_repack import (
+            prefix_screen_kernel,
+            subset_screen_kernel,
+        )
+
+        rng = np.random.RandomState(5)
+        N, R = 6, 3
+        loads = rng.randint(0, 50, (N, R)).astype(np.int32)
+        free = rng.randint(0, 30, (N, R)).astype(np.int32)
+        fleet = rng.randint(10, 100, (R,)).astype(np.int32)
+        cap = rng.randint(20, 60, (R,)).astype(np.int32)
+        masks = np.tril(np.ones((N, N), dtype=bool))
+        pref = np.asarray(
+            prefix_screen_kernel(
+                jnp.asarray(loads), jnp.asarray(free), jnp.asarray(fleet), jnp.asarray(cap)
+            )
+        )
+        sub = np.asarray(
+            subset_screen_kernel(
+                jnp.asarray(masks.astype(np.float32)),
+                jnp.asarray(loads), jnp.asarray(free), jnp.asarray(fleet), jnp.asarray(cap),
+            )
+        )
+        assert (pref == sub).all()
+
+    def test_family_masks_cover_orders(self):
+        env = seeded_env(88)
+        try:
+            eng = env.controller.ctx.engine
+            method = MultiNodeConsolidation(env.controller.ctx)
+            cands = method.sort_and_filter(
+                get_candidates(
+                    env.cluster, env.kube, env.recorder, env.clock, env.provider,
+                    method.should_disrupt, env.controller.queue,
+                )
+            )
+            if len(cands) < 2:
+                pytest.skip("seed produced too few candidates")
+            orders = eng._orders(cands)
+            labels = [label for label, _ in orders]
+            assert labels[0] == "cost"
+            masks, descr, dropped = eng._family_masks(len(cands), orders)
+            assert len(masks) == len(descr)
+            # every order's full prefix is in the family
+            for label, order in orders:
+                assert (label, len(order)) in descr
+            # prefix masks are cumulative within an order
+            for (label, k), m in zip(descr, masks):
+                assert int(m.sum()) == k
+        finally:
+            env.stop()

@@ -338,3 +338,89 @@ def test_catalog_event_triggers_background_prewarm():
     finally:
         pipe.stop()
         harness.close()
+
+
+def test_disruption_stage_runs_on_plan_thread():
+    """ISSUE 7: the continuous-disruption stage reconciles on the plan
+    thread every `disrupt_every` ticks, surfaces its passes in the tick
+    log and debug state, and swallows pass failures."""
+    import threading
+
+    harness = tg.TrafficHarness(teams=2)
+    from karpenter_core_tpu.serving import ServingPipeline
+
+    passes = []
+
+    class FakeDisruption:
+        last_decision_stats = {"engine": "batched", "subsets_screened": 3}
+
+        def reconcile(self):
+            passes.append(threading.current_thread().name)
+            return None
+
+    pipe = ServingPipeline(
+        harness.provisioner,
+        metrics=harness.metrics,
+        config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2, disrupt_every=1),
+        on_decision=harness.bind,
+        disruption=FakeDisruption(),
+    )
+    pipe.attach_watch()
+    pipe.start()
+    try:
+        step = tg.Step(
+            creates=[tg.PodSpecLite(f"dis-{i}", "250m", "256Mi", None, 0) for i in range(4)]
+        )
+        harness.inject_step(step, 0)
+        assert pipe.quiesce(timeout=30.0)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not passes:
+            time.sleep(0.01)
+        assert passes, "disruption stage never ran"
+        # single-writer invariant: disruption mutations happen on the
+        # authoritative plan thread, same as provisioning's
+        assert all(name.startswith("serve-plan") for name in passes), passes
+        state = pipe.debug_state()
+        assert state["disrupt"]["attached"] is True
+        assert state["disrupt"]["every"] == 1
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not state["disrupt"]["last_passes"]:
+            time.sleep(0.01)
+            state = pipe.debug_state()
+        assert state["disrupt"]["last_passes"]
+        last = state["disrupt"]["last_passes"][-1]
+        assert last["stats"]["subsets_screened"] == 3
+    finally:
+        pipe.stop()
+        harness.close()
+
+
+def test_disruption_stage_off_by_default():
+    harness = tg.TrafficHarness(teams=2)
+    from karpenter_core_tpu.serving import ServingPipeline
+
+    calls = []
+
+    class FakeDisruption:
+        def reconcile(self):
+            calls.append(1)
+
+    pipe = ServingPipeline(
+        harness.provisioner,
+        metrics=harness.metrics,
+        config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2),
+        on_decision=harness.bind,
+        disruption=FakeDisruption(),
+    )
+    pipe.attach_watch()
+    pipe.start()
+    try:
+        step = tg.Step(
+            creates=[tg.PodSpecLite(f"off-{i}", "250m", "256Mi", None, 0) for i in range(3)]
+        )
+        harness.inject_step(step, 0)
+        assert pipe.quiesce(timeout=30.0)
+        assert not calls  # disrupt_every defaults to 0 = off
+    finally:
+        pipe.stop()
+        harness.close()
