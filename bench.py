@@ -274,6 +274,11 @@ def _split(solver) -> dict:
         out["cache_misses"] = dict(cs.get("misses", {}))
         if "hit_rate" in cs:
             out["cache_hit_rate"] = cs["hit_rate"]
+    ps = getattr(solver, "last_pack_stats", None)
+    if ps and ps.get("backend") not in (None, "ffd"):
+        # plan-quality pack backend (ISSUE 8): which engine partitioned
+        # the jobs and what the LP guard won on this solve
+        out["pack_backend"] = dict(ps)
     ms = getattr(solver, "last_merge_stats", None)
     if ms:
         # cross-group merge observability (ISSUE 2): wall time of the
@@ -303,6 +308,18 @@ def _split(solver) -> dict:
                 if name != "device_wait"
             ][:3]
     return out
+
+
+def plan_cost_block(res, instance_types) -> dict:
+    """Plan-cost columns (ISSUE 8): $/hr of the emitted fleet, the LP
+    relaxation lower bound, and the optimality gap — benches report what
+    plans COST, not just how many nodes they open."""
+    from karpenter_core_tpu.solver import plancost
+
+    try:
+        return plancost.cost_block(res, instance_types)
+    except Exception:
+        return {"plan_cost_error": traceback.format_exc()[-300:]}
 
 
 def headline(out: dict) -> None:
@@ -366,6 +383,7 @@ def headline(out: dict) -> None:
             "warm_ms": round(warm * 1000.0, 1),
             "pods_scheduled": result.pods_scheduled,
             **{f"packing_{k}": v for k, v in packing_stats(result).items()},
+            **plan_cost_block(result, provider.instance_types),
             **_split(solver),
         }
     )
@@ -462,6 +480,7 @@ def config2() -> dict:
     return {
         "config": "2: 10k mixed cpu/mem/gpu pods x 500 types (TPU)",
         "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
+        **plan_cost_block(res, cat),
         **packing_stats(res),
         **_split(solver),
         **_oracle_parity(pods, provider, nodepool, tpu_result=res),
@@ -666,6 +685,7 @@ def config5() -> dict:
         "pods_per_sec": round(res.pods_scheduled / dt, 1) if dt > 0 else 0.0,
         "total_price_per_hr": round(res.total_price, 2),
         "spot_node_fraction": round(spot_nodes / max(res.node_count, 1), 3),
+        **plan_cost_block(res, cat),
         **packing_stats(res),
         **_split(solver),
         **_oracle_parity(pods, provider, nodepool, tpu_result=res),
@@ -1427,6 +1447,181 @@ def config9() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 10: plan-quality backends (ISSUE 8) — price-adversarial shapes
+# ---------------------------------------------------------------------------
+
+
+def _price_shapes() -> list:
+    """(name, catalog, pods) triples where node-count-greedy FFD
+    provably overpays, plus a linear-price control where the LP guard
+    must tie (identical plans — the parity regime). Each shape is one
+    pool/offering geometry from the ISSUE-8 acceptance list:
+
+      bignode-trap     — superlinear big-type pricing: the dense pack
+                         lands on the expensive mega type; many small
+                         cheap nodes win.
+      midsize-sweetspot— cheapest $/capacity lives in the MIDDLE of the
+                         size ladder; FFD's max-capacity frontier never
+                         looks at it.
+      podcap-trap      — pods-capacity bound: FFD fills to the highest
+                         pod cap, forcing the expensive dense type.
+      hetero-split     — cpu-heavy + mem-heavy mix: mixed nodes need the
+                         pricey generalist; splitting by shape onto
+                         specialists is cheaper.
+      linear-control   — price ∝ capacity: FFD is already cost-optimal
+                         (to granularity), the guard must keep it.
+    """
+    from karpenter_core_tpu.cloudprovider.fake import (
+        instance_types,
+        new_instance_type,
+    )
+    from karpenter_core_tpu.cloudprovider.types import Offering
+
+    def it(name, cpu, mem_gi, pods, price):
+        return new_instance_type(
+            name,
+            {"cpu": str(cpu), "memory": f"{mem_gi}Gi", "pods": str(pods)},
+            offerings=[
+                Offering("on-demand", "test-zone-1", price),
+                Offering("on-demand", "test-zone-2", price),
+            ],
+        )
+
+    rng = np.random.RandomState(17)
+    shapes = []
+
+    cat = [it("huge", 64, 128, 110, 20.0), it("small", 4, 8, 110, 0.8)]
+    pods = [_mk_pod(f"big-{i}", "1", "2Gi") for i in range(256)]
+    shapes.append(("bignode-trap", cat, pods))
+
+    cat = [it("xl", 96, 192, 220, 14.0), it("m", 48, 96, 110, 4.6),
+           it("s", 8, 16, 110, 1.1)]
+    pods = [_mk_pod(f"mid-{i}", "2", "3Gi") for i in range(240)]
+    shapes.append(("midsize-sweetspot", cat, pods))
+
+    cat = [it("dense", 16, 32, 32, 3.2), it("lean", 16, 32, 8, 0.55)]
+    pods = [_mk_pod(f"cap-{i}", "100m", "128Mi") for i in range(256)]
+    shapes.append(("podcap-trap", cat, pods))
+
+    cat = [it("general", 32, 64, 110, 9.9), it("cpuopt", 32, 8, 110, 3.6),
+           it("memopt", 4, 64, 110, 3.4)]
+    pods = [_mk_pod(f"cpuh-{i}", "3", "256Mi") for i in range(96)] + [
+        _mk_pod(f"memh-{i}", "100m", "4Gi") for i in range(96)
+    ]
+    shapes.append(("hetero-split", cat, pods))
+
+    cat = instance_types(20)  # price_from_resources: linear in capacity
+    pods = [
+        _mk_pod(
+            f"lin-{i}",
+            ["250m", "500m", "1", "2"][rng.randint(4)],
+            ["512Mi", "1Gi", "2Gi"][rng.randint(3)],
+        )
+        for i in range(400)
+    ]
+    shapes.append(("linear-control", cat, pods))
+    return shapes
+
+
+def _price_shape_run(name: str, catalog: list, pods: list) -> dict:
+    """Solve one shape under BOTH backends → costs, bound, latency."""
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_core_tpu.solver import TPUScheduler, plancost
+
+    row: dict = {"shape": name, "pods": len(pods), "types": len(catalog)}
+    old = os.environ.get("KARPENTER_TPU_PACK_BACKEND")
+    try:
+        for bk in ("ffd", "lp"):
+            os.environ["KARPENTER_TPU_PACK_BACKEND"] = bk
+            provider = FakeCloudProvider()
+            provider.instance_types = list(catalog)
+            nodepool = NodePool()
+            nodepool.metadata.name = "default"
+            solver = TPUScheduler([nodepool], provider)
+            solver.solve(pods)  # warm: encode + compiles out of the timer
+            times = []
+            with nogc():
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    res = solver.solve(pods)
+                    times.append((time.perf_counter() - t0) * 1000.0)
+            row[bk] = {
+                "plan_cost_per_hr": round(res.total_price, 4),
+                "nodes": res.node_count,
+                "pods_scheduled": res.pods_scheduled,
+                "solve_ms_p50": round(sorted(times)[1], 2),
+            }
+            if bk == "lp":
+                ps = solver.last_pack_stats
+                row["lp_guard"] = {
+                    k: ps.get(k) for k in ("lp_won", "ffd_kept", "lp_saved_per_hr")
+                }
+                bound = plancost.relaxation_lower_bound(res.node_plans, catalog)
+                row["lp_bound_per_hr"] = round(bound, 4)
+                gap = plancost.optimality_gap(res.total_price, bound)
+                row["opt_gap_pct"] = round(gap * 100.0, 2) if gap is not None else None
+                row["bound_le_cost"] = bound <= res.total_price + 1e-6
+    finally:
+        if old is None:
+            os.environ.pop("KARPENTER_TPU_PACK_BACKEND", None)
+        else:
+            os.environ["KARPENTER_TPU_PACK_BACKEND"] = old
+    ffd_cost, lp_cost = row["ffd"]["plan_cost_per_hr"], row["lp"]["plan_cost_per_hr"]
+    row["lp_not_worse"] = lp_cost <= ffd_cost + 1e-6
+    row["saving_pct"] = (
+        round((ffd_cost - lp_cost) / ffd_cost * 100.0, 2) if ffd_cost > 0 else 0.0
+    )
+    row["latency_ratio_p50"] = (
+        round(row["lp"]["solve_ms_p50"] / row["ffd"]["solve_ms_p50"], 2)
+        if row["ffd"]["solve_ms_p50"] > 0
+        else None
+    )
+    row["same_pods_scheduled"] = (
+        row["lp"]["pods_scheduled"] == row["ffd"]["pods_scheduled"]
+    )
+    return row
+
+
+def config10() -> dict:
+    """Plan-quality backends (ISSUE 8): price-adversarial offering
+    shapes solved under BOTH pack backends. Gates: the LP backend's
+    plan cost ≤ FFD's on every shape (the cost guard makes this
+    structural), ≥5% aggregate $/hr saving on the adversarial shapes,
+    p50 solve latency ≤ 2× FFD, relaxation bound ≤ plan cost, and the
+    linear-price control ties (parity regime preserved)."""
+    rows = [_price_shape_run(*shape) for shape in _price_shapes()]
+    adversarial = [r for r in rows if r["shape"] != "linear-control"]
+    ffd_total = sum(r["ffd"]["plan_cost_per_hr"] for r in adversarial)
+    lp_total = sum(r["lp"]["plan_cost_per_hr"] for r in adversarial)
+    control = next(r for r in rows if r["shape"] == "linear-control")
+    return {
+        "config": f"10: plan-quality backends, {len(rows)} price shapes x 2 backends",
+        "shapes": rows,
+        "lp_not_worse_all": all(r["lp_not_worse"] for r in rows),
+        "same_pods_scheduled_all": all(r["same_pods_scheduled"] for r in rows),
+        "bound_le_cost_all": all(r.get("bound_le_cost", True) for r in rows),
+        "adversarial_ffd_cost_per_hr": round(ffd_total, 2),
+        "adversarial_lp_cost_per_hr": round(lp_total, 2),
+        "adversarial_saving_pct": round(
+            (ffd_total - lp_total) / ffd_total * 100.0, 2
+        ) if ffd_total > 0 else 0.0,
+        "saving_target_pct": 5.0,
+        "saving_over_target": ffd_total > 0
+        and (ffd_total - lp_total) / ffd_total >= 0.05,
+        "latency_ratio_p50_max": max(
+            r["latency_ratio_p50"] or 0.0 for r in rows
+        ),
+        "latency_target_ratio": 2.0,
+        "latency_under_target": all(
+            (r["latency_ratio_p50"] or 0.0) <= 2.0 for r in rows
+        ),
+        "control_ties": control["ffd"]["plan_cost_per_hr"]
+        == control["lp"]["plan_cost_per_hr"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
 
@@ -1555,7 +1750,7 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10):
             try:
                 if fn in (config7, config8, config9):  # measure the incremental/serving/disruption paths
                     configs.append(fn())

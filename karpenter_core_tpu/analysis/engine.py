@@ -31,6 +31,7 @@ class AnalysisConfig:
         "karpenter_core_tpu/solver/backend.py",
         "karpenter_core_tpu/solver/kernels.py",
         "karpenter_core_tpu/solver/pallas_kernels.py",
+        "karpenter_core_tpu/solver/backends/lp.py",
     )
     # control-plane packages that must never import jax: a stray jnp op
     # in a controller thread would initialize the backend (and possibly
@@ -79,6 +80,9 @@ class AnalysisConfig:
         "karpenter_core_tpu/solver/encode.py",
         "karpenter_core_tpu/solver/merge.py",
         "karpenter_core_tpu/disruption/engine.py",
+        # plan-quality pack backends (ISSUE 8): the LP relaxation memo
+        "karpenter_core_tpu/solver/backends/__init__.py",
+        "karpenter_core_tpu/solver/backends/lp.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)

@@ -253,6 +253,14 @@ class Metrics:
             "Cross-solve solver cache evictions (LRU caps, env-tunable)",
             ["cache"],
         )
+        # plan-quality pack backends (solver/backends/): per pack job,
+        # whether the LP-relaxation candidate beat FFD on plan cost
+        # (lp_won) or the guard kept the FFD partition (ffd_kept)
+        self.solver_lp_jobs = r.counter(
+            f"{ns}_tpu_solver_lp_jobs",
+            "Pack jobs through the LP-relaxation backend, by guard outcome (lp_won | ffd_kept)",
+            ["outcome"],
+        )
         # serving pipeline (serving/pipeline.py): the decision-latency
         # SLO (pod-pending → plan emitted), per-stage durations, and
         # stage-queue depths (backpressure visibility)

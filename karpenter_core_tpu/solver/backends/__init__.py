@@ -1,0 +1,197 @@
+"""Plan-quality pack backends (ISSUE 8 tentpole).
+
+A ``PackBackend`` turns prepared pack jobs — the ``(requests, frontier,
+max_per_node)`` tuples plus their finalize metadata — into per-job node
+assignments, the contract ``solver._pack_and_finalize`` consumes:
+
+    pack_jobs(jobs, metas, mesh) -> [(node_ids (P,) int32, node_count)]
+
+aligned with ``jobs``. ``node_ids`` indexes the job's size-sorted pod
+order (−1 ⇒ unschedulable) exactly like ``pack.batch_pack``; everything
+downstream (usage, cheapest-fitting-type choice, offering pricing,
+merge, the PR-4 job memo) is backend-agnostic, which is what makes the
+backends interchangeable plan-for-plan: a backend only decides the
+*partition* of pods into nodes, never the pricing or feasibility rules.
+
+Backends:
+
+- ``ffd``  — the existing vmapped/native first-fit-decreasing engine
+  (pack.batch_pack), verbatim. The default, and the node-count parity
+  reference.
+- ``lp``   — the LP-relaxation backend (backends/lp.py): the
+  pod-signature × instance-offering assignment LP solved as a batched
+  dual ascent in pure JAX, rounded through an FFD-kernel repair pass,
+  cost-guarded so its plan never prices above FFD's on the same job.
+- ``auto`` — size-calibrated routing (solver/calibrate.py
+  ``lp_min_job_work``): jobs big enough to amortize the LP dispatch
+  route to ``lp``, the rest stay on ``ffd``.
+
+Selection: ``KARPENTER_TPU_PACK_BACKEND`` (default ``ffd``), read per
+solve — the PR-2 engine-switch pattern (cf. KARPENTER_TPU_MERGE_ENGINE,
+KARPENTER_TPU_DISRUPT_ENGINE). Each job's memo key carries the backend
+token (``job_token``) so switching backends between ticks can never
+alias cached skeletons.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def job_prices(meta: dict) -> np.ndarray:
+    """Per viable type, the cheapest offering price admitted by the
+    job's zone/capacity-type requirements (zone-pinned when set) — the
+    exact price model ``solver._job_skeleton`` prices packed nodes
+    with (solver._job_prices; it lives there so the cachesound
+    read-set analysis sees the job memo's price reads inline)."""
+    from ..solver import _job_prices
+
+    return _job_prices(meta)
+
+
+class PackBackend:
+    """One pack engine behind the multi-backend seam."""
+
+    name: str = "?"
+
+    def __init__(self) -> None:
+        # backends are process-global singletons; concurrent solvers
+        # (e.g. the provisioner's shadow parity solve) hold this around
+        # pack_jobs + the last_stats/last_job_flags reads so one solve's
+        # per-call outputs can't be overwritten by another's mid-read
+        self.lock = threading.Lock()
+
+    def job_token(self) -> tuple:
+        """The backend-identity component of every job's cross-tick
+        memo key: everything about THIS backend's configuration that
+        can change its assignment for fixed job inputs. Deliberately
+        takes NO job arguments — the job's own content is already in
+        the key, and passing it here would widen the key's witness to
+        the whole meta dict (masking the cachesound read-set check)."""
+        raise NotImplementedError
+
+    #: per-job guard flags of the last pack_jobs call (True ⇒ the job's
+    #: partition is cost-guarded downstream); backends that never deviate
+    #: from FFD leave it empty
+    last_job_flags: List[bool] = []
+
+    def pack_jobs(
+        self, jobs: List[tuple], metas: List[dict], mesh=None, stats=None
+    ) -> List[Tuple[np.ndarray, int]]:
+        """→ [(node_ids, node_count)] aligned with ``jobs``."""
+        raise NotImplementedError
+
+
+class FFDBackend(PackBackend):
+    """The existing engine, verbatim: vmapped device scan or the native
+    C++ twin (pack.batch_pack decides)."""
+
+    name = "ffd"
+
+    def job_token(self) -> tuple:
+        return ("ffd",)
+
+    def pack_jobs(
+        self, jobs: List[tuple], metas: List[dict], mesh=None, stats=None
+    ) -> List[Tuple[np.ndarray, int]]:
+        from ..pack import batch_pack
+
+        self.last_job_flags = [False] * len(jobs)
+        return batch_pack(jobs, mesh=mesh)
+
+
+class AutoBackend(PackBackend):
+    """Size-calibrated routing: a job routes to the LP backend when its
+    P·T work clears ``calibrate.lp_min_job_work()`` (the LP's fixed
+    relax-dispatch cost is only worth paying where a better partition
+    can move real dollars), else it stays on FFD."""
+
+    name = "auto"
+
+    def __init__(self) -> None:
+        super().__init__()
+        from .lp import LPBackend
+
+        self._ffd = FFDBackend()
+        self._lp = LPBackend()
+
+    def _route(self, job: tuple, meta: dict) -> PackBackend:
+        from ..calibrate import lp_min_job_work
+
+        work = int(job[0].shape[0]) * int(len(meta["viable_idx"]))
+        return self._lp if work >= lp_min_job_work() else self._ffd
+
+    def job_token(self) -> tuple:
+        # covers BOTH lanes' configuration: the routing threshold decides
+        # which lane a job takes (a pure function of job shape, already
+        # keyed), and the lp iteration budget decides the lp lane's output
+        from ..calibrate import lp_min_job_work
+
+        return ("auto", int(lp_min_job_work()), int(self._lp.iterations))
+
+    def pack_jobs(
+        self, jobs: List[tuple], metas: List[dict], mesh=None, stats=None
+    ) -> List[Tuple[np.ndarray, int]]:
+        lanes = [self._route(j, m) for j, m in zip(jobs, metas)]
+        results: List[Optional[Tuple[np.ndarray, int]]] = [None] * len(jobs)
+        flags = [False] * len(jobs)
+        self.last_stats = {}
+        for backend in (self._ffd, self._lp):
+            idx = [i for i, b in enumerate(lanes) if b is backend]
+            if not idx:
+                continue
+            packed = backend.pack_jobs(
+                [jobs[i] for i in idx], [metas[i] for i in idx], mesh, stats
+            )
+            sub_flags = backend.last_job_flags
+            for slot, (i, r) in enumerate(zip(idx, packed)):
+                results[i] = r
+                if sub_flags:
+                    flags[i] = sub_flags[slot]
+            if backend is self._lp:
+                self.last_stats = dict(backend.last_stats)
+        self.last_job_flags = flags
+        return results
+
+
+_BACKENDS: dict = {}
+
+
+def get_backend(name: str) -> PackBackend:
+    """Process-global backend singletons (the LP backend's relaxation
+    memo and compiled kernels are shared across solvers by design —
+    they are content-addressed)."""
+    b = _BACKENDS.get(name)
+    if b is None:
+        if name == "ffd":
+            b = FFDBackend()
+        elif name == "lp":
+            from .lp import LPBackend
+
+            b = LPBackend()
+        elif name == "auto":
+            b = AutoBackend()
+        else:
+            raise ValueError(f"unknown pack backend: {name!r} (ffd | lp | auto)")
+        _BACKENDS[name] = b
+    return b
+
+
+def active_backend() -> PackBackend:
+    """The per-solve backend selection (env read each solve, PR-2
+    engine-switch pattern). Unknown names fall back to ffd — a typo in
+    an env var must degrade, not fail solves."""
+    name = os.environ.get("KARPENTER_TPU_PACK_BACKEND", "ffd").strip().lower()
+    try:
+        return get_backend(name or "ffd")
+    except ValueError:
+        return get_backend("ffd")
+
+
+def reset_for_tests() -> None:
+    """Drop backend singletons (and with them the LP relax memo)."""
+    _BACKENDS.clear()

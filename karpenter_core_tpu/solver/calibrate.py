@@ -110,6 +110,83 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+# ---------------------------------------------------------------------------
+# LP-backend routing (ISSUE 8: KARPENTER_TPU_PACK_BACKEND=auto)
+
+_LP_MIN_CLAMP = (1 << 10, 1 << 24)
+_LP_MIN_DEFAULT = 1 << 14  # pods × viable-types work below which auto stays on ffd
+
+_LP_CAL: Optional[dict] = None
+
+
+def lp_calibration(force: bool = False) -> dict:
+    """Measure, once per process, what routing a pack job through the
+    LP backend costs over plain FFD:
+
+    - ``lp_relax_floor_ms``  — round-trip of a tiny dual-ascent dispatch
+      (backends/lp.py), the LP's fixed per-job overhead
+    - ``pack_ns_per_unit``   — the FFD engine's cost per pod×frontier
+      work unit on a bench-shaped micro-run
+
+    and derive ``lp_min_job_work``: the pods×types work where a job's
+    own pack time crosses the relax dispatch floor — below it the LP's
+    fixed cost would more than double the job latency for pennies of
+    plan, so ``auto`` keeps the job on ffd; above it the relax
+    amortizes. Env override: KARPENTER_TPU_LP_MIN_WORK."""
+    global _LP_CAL
+    if _LP_CAL is not None and not force:
+        return _LP_CAL
+    out: dict = {}
+    try:
+        from .pack import batch_pack
+        from .backends import lp as lp_mod
+
+        rng = np.random.RandomState(11)
+        jobs = []
+        for _ in range(8):
+            reqs = rng.randint(1, 200, size=(256, 4)).astype(np.int32)
+            frontier = np.sort(
+                rng.randint(500, 4000, size=(16, 4)).astype(np.int32), axis=0
+            )[::-1].copy()
+            jobs.append((reqs, frontier, np.int32(110)))
+        units = sum(j[0].shape[0] * len(j[1]) for j in jobs)
+        batch_pack(jobs)  # warm/compile
+        pack_s = min(_timed(lambda: batch_pack(jobs)) for _ in range(3))
+        out["pack_ns_per_unit"] = round(pack_s / units * 1e9, 3)
+
+        reqs = rng.randint(1, 200, size=(8, 4)).astype(np.float64)
+        counts = np.ones(8)
+        alloc = rng.randint(500, 4000, size=(8, 4)).astype(np.float64)
+        prices = rng.rand(8) + 0.5
+
+        def roundtrip():
+            lp_mod.relax(reqs, counts, alloc, prices, iters=32)
+
+        roundtrip()  # compile
+        floor = min(_timed(roundtrip) for _ in range(5))
+        out["lp_relax_floor_ms"] = round(floor * 1000.0, 3)
+        threshold = int(floor / max(pack_s / units, 1e-12))
+        out["lp_min_job_work"] = max(
+            _LP_MIN_CLAMP[0], min(_LP_MIN_CLAMP[1], threshold)
+        )
+    except Exception as e:  # noqa: BLE001 — calibration must not break solves
+        out["lp_calibration_error"] = str(e)[-300:]
+    _LP_CAL = out
+    return out
+
+
+def lp_min_job_work(fallback: Optional[int] = None) -> int:
+    """The auto-backend routing threshold (pods × viable types): env
+    override > on-process calibration > the static default."""
+    env = os.environ.get("KARPENTER_TPU_LP_MIN_WORK")
+    if env:
+        return int(env)
+    cal = lp_calibration()
+    return cal.get(
+        "lp_min_job_work", fallback if fallback is not None else _LP_MIN_DEFAULT
+    )
+
+
 def compat_min_device_work(fallback: Optional[int] = None) -> int:
     """The live routing threshold: env override > on-chip calibration >
     ``fallback`` (the static tunnel-era default). This is the single
@@ -125,5 +202,6 @@ def compat_min_device_work(fallback: Optional[int] = None) -> int:
 
 
 def reset_for_tests() -> None:
-    global _CAL
+    global _CAL, _LP_CAL
     _CAL = None
+    _LP_CAL = None

@@ -72,6 +72,9 @@ _CAPS = {
     "emit": ("KARPENTER_TPU_EMIT_CACHE_MAX", 2048),
     "mergerow": ("KARPENTER_TPU_MERGEROW_CACHE_MAX", 2048),
     "seeds": ("KARPENTER_TPU_SEED_CACHE_MAX", 256),
+    # LP-relaxation memo (solver/backends/lp.py): content-addressed dual
+    # solves (request digest + capacity/price tables + iteration budget)
+    "lprelax": ("KARPENTER_TPU_LPRELAX_CACHE_MAX", 512),
     # disruption-engine memos (disruption/engine.py): family bounds per
     # candidate set, negative drain verdicts per drained subset
     "disruptbounds": ("KARPENTER_TPU_DISRUPT_BOUNDS_CACHE_MAX", 64),
@@ -197,6 +200,10 @@ class JobSkeleton:
     off_zone: list  # (n_ok,)
     off_ct: list
     off_price: np.ndarray
+    # True when the LP backend's cost guard chose this partition over
+    # FFD's: downstream merges of these nodes must not raise plan cost
+    # (solver/backends/lp.py; the merge pass reads it via ``_cost_guard``)
+    cost_guard: bool = False
 
 
 @dataclass

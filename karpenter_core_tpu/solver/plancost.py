@@ -1,0 +1,102 @@
+"""Plan-cost evaluation (ISSUE 8): dollars, bounds, optimality gap.
+
+Node-count parity (the PR-2/PR-7 gates) proves the solver opens no more
+nodes than the greedy oracle — it says nothing about what the fleet
+*costs*. This module prices emitted plans and certifies how far they
+can possibly be from optimal:
+
+- ``fleet_cost(plans)`` — $/hr of the emitted fleet: the sum of each
+  plan's offering price, exactly what the provisioner will pay.
+- ``relaxation_lower_bound(plans, instance_types)`` — a certified lower
+  bound on the $/hr of ANY feasible plan that schedules the same pods
+  onto these instance types, from the LP dual (backends/lp.py
+  ``dual_bound``). The bound deliberately relaxes in the safe
+  direction everywhere: full (un-daemon-adjusted) allocatable, each
+  type's cheapest offering price unconditionally, no viability masks
+  beyond resource fit — every loosening can only LOWER the bound, so
+  ``bound ≤ fleet_cost`` holds for every emitted plan by weak duality
+  (the property tests/test_backends.py holds the inequality on
+  randomized workloads).
+- ``optimality_gap(cost, bound)`` — (cost − bound) / bound, the number
+  the benches report alongside node counts: how much of the fleet
+  price is *provably* irreducible vs potentially-recoverable slack.
+  The gap conflates true suboptimality with bound looseness (integer
+  slack the relaxation cannot see), so it is an upper bound on the
+  recoverable dollars.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def fleet_cost(plans: Sequence) -> float:
+    """$/hr of the emitted fleet — the sum of each NodePlan's offering
+    price (SolverResult.total_price over an explicit plan list)."""
+    return float(sum(p.price for p in plans))
+
+
+def optimality_gap(cost: float, bound: float) -> Optional[float]:
+    """(cost − bound)/bound, or None when the bound is degenerate."""
+    if bound is None or bound <= 0 or not np.isfinite(bound):
+        return None
+    return max(0.0, (float(cost) - float(bound)) / float(bound))
+
+
+def relaxation_lower_bound(
+    plans: Sequence,
+    instance_types: Sequence,
+    iters: int = 256,
+) -> float:
+    """Certified $/hr lower bound for the pods of ``plans`` on
+    ``instance_types`` (pass the union catalog when plans span pools —
+    more types only loosens, which is the safe direction).
+
+    Sound against ``fleet_cost(plans)`` because every emitted plan is
+    feasible in the relaxation: each node's pods fit its (quantized)
+    type capacity, and each node's offering price is ≥ its type's
+    cheapest offering price."""
+    from .backends import lp as lp_mod
+    from .encode import build_axis_from_capacities, build_requests_matrix, quantize_capacity
+
+    instance_types = list(instance_types)
+    if not plans or not instance_types:
+        return 0.0
+    requests: List[dict] = []
+    for plan in plans:
+        pod_requests = getattr(plan, "_pod_requests", None) or []
+        requests.extend(pod_requests)
+    if not requests:
+        return 0.0
+    axis = build_axis_from_capacities([it.capacity for it in instance_types])
+    alloc = np.stack(
+        [quantize_capacity(it.allocatable(), axis) for it in instance_types]
+    ).astype(np.float64)
+    prices = np.array(
+        [
+            min(
+                (o.price for o in it.offerings if o.available),
+                default=float("inf"),
+            )
+            for it in instance_types
+        ],
+        dtype=np.float64,
+    )
+    reqs = build_requests_matrix(requests, axis).astype(np.float64)
+    return lp_mod.dual_bound(reqs, alloc, prices, iters=iters)
+
+
+def cost_block(result, instance_types: Sequence, iters: int = 256) -> dict:
+    """The bench-facing rollup: plan cost, relaxation bound, gap —
+    ``result`` is a SolverResult (new node plans only; existing-node
+    placements are free)."""
+    cost = fleet_cost(result.node_plans)
+    bound = relaxation_lower_bound(result.node_plans, instance_types, iters=iters)
+    gap = optimality_gap(cost, bound)
+    return {
+        "plan_cost_per_hr": round(cost, 4),
+        "lp_bound_per_hr": round(bound, 4),
+        "opt_gap_pct": round(gap * 100.0, 2) if gap is not None else None,
+    }

@@ -215,6 +215,83 @@ def _offering_pmin(
     return cached
 
 
+def _offering_rank(enc: "EncodedInstanceTypes") -> np.ndarray:
+    """(Z, C) stable offering ordinal: lexicographic rank of the
+    (zone name, capacity-type name) pair, cached on the encoding.
+
+    Price-tie argmins must break on this STABLE offering id, never on
+    array position — vocab/axis order is an encoding artifact (growth
+    appends), so two content-identical catalogs observed in different
+    orders would otherwise emit different offerings for equal prices
+    (the PR-5 determinism discipline, applied to plan choice)."""
+    key = ("offrank",)
+    cached = enc.runtime_caches.get(key)
+    if cached is None:
+        pairs = [(z, c) for z in enc.zones for c in enc.capacity_types]
+        order = sorted(range(len(pairs)), key=lambda i: pairs[i])
+        rank = np.empty(len(pairs), dtype=np.int64)
+        rank[order] = np.arange(len(pairs))
+        cached = rank.reshape(len(enc.zones), len(enc.capacity_types))
+        _cache_put(enc, key, cached)
+    return cached
+
+
+def _type_rank(enc: "EncodedInstanceTypes") -> np.ndarray:
+    """(T,) stable type ordinal: rank of the instance type's name
+    (ties on duplicate names fall back to catalog position), cached on
+    the encoding — the type-axis analogue of ``_offering_rank``."""
+    key = ("typerank",)
+    cached = enc.runtime_caches.get(key)
+    if cached is None:
+        names = [it.name for it in enc.instance_types]
+        order = sorted(range(len(names)), key=lambda i: (names[i], i))
+        cached = np.empty(len(names), dtype=np.int64)
+        cached[order] = np.arange(len(names))
+        _cache_put(enc, key, cached)
+    return cached
+
+
+def _stable_argmin(values: np.ndarray, rank: np.ndarray) -> int:
+    """Index of the minimum of ``values``; exact ties resolve to the
+    smallest ``rank`` (a stable content id), not the first position."""
+    lo = values.min()
+    if not np.isfinite(lo):
+        return int(np.argmin(values))
+    tied = values == lo
+    return int(np.flatnonzero(tied)[np.argmin(rank[tied])])
+
+
+def _rank_order(idx: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    """``idx`` reordered by its elements' ``rank`` (stable): the viable
+    type axis in name-rank order, so positional price-tie breaks are
+    content-stable (see _type_rank)."""
+    return idx[np.argsort(rank[idx], kind="stable")]
+
+
+def _job_prices(meta: dict) -> np.ndarray:
+    """Per viable type, the cheapest offering price admitted by the
+    job's zone/capacity-type requirements (zone-pinned when set) — THE
+    price model for packed nodes, shared between the finalize step
+    (_job_skeleton) and the pack backends (solver/backends/ re-exports
+    it as ``job_prices``) so a backend's cost reasoning cannot drift
+    from what the finalize step will charge. Lives in this module so
+    the cachesound read-set analysis sees the job memo's price reads
+    inline (cross-module reads are invisible to its dataflow)."""
+    enc = meta["enc"]
+    viable_idx = meta["viable_idx"]
+    zone_ok, ct_ok, zone = meta["zone_ok"], meta["ct_ok"], meta["zone"]
+    if zone is not None:
+        zi = enc.zones.index(zone)
+        zprices = enc.offering_price[viable_idx, zi, :][:, ct_ok]
+        return np.where(np.isfinite(zprices), zprices, np.inf).min(axis=1)
+    op = enc.offering_price[viable_idx][:, zone_ok, :][:, :, ct_ok].reshape(
+        len(viable_idx), -1
+    )
+    if not op.size:
+        return np.full(len(viable_idx), np.inf)
+    return np.where(np.isfinite(op), op, np.inf).min(axis=1)
+
+
 def _requirements_fingerprint(reqs) -> tuple:
     """Canonical identity of a merged Requirements set (full algebra:
     operator polarity, values, Gt/Lt bounds) for class-merge equality.
@@ -611,6 +688,12 @@ class TPUScheduler:
                         note="sum of device_wait spans (dispatch+transfer+blocked)",
                     )
                 self.last_merge_stats = dict(self._merge_stats)
+                self.last_pack_stats = dict(self._pack_backend_stats)
+                if tr is not None and self.last_pack_stats.get("backend") not in (
+                    None,
+                    "ffd",
+                ):
+                    tr.args["pack_backend"] = self.last_pack_stats
                 self.last_cache_stats = self._cstats.to_dict()
                 if tr is not None and (self._cstats.hits or self._cstats.misses):
                     # hit rates ride on the solve trace → /debug/traces
@@ -643,6 +726,9 @@ class TPUScheduler:
             "merge_candidates_screened": 0,
             "merge_pairs_applied": 0,
         }
+        # pack-backend outcome for this solve (solver/backends/): which
+        # engine partitioned the jobs, LP guard wins, bound sums
+        self._pack_backend_stats = {}
         # cross-tick incremental state (solver/incremental.py): replay
         # probe first — a provably unchanged tick skips the pipeline
         # entirely; everything unprovable falls through to a full solve
@@ -3676,6 +3762,11 @@ class TPUScheduler:
             for i in idx:
                 result.pod_errors[pods[i].uid] = "no viable instance type"
             return
+        # stable name-rank order on the viable axis: every downstream
+        # price argmin over this axis (assign_cheapest_types, both
+        # engines) then resolves exact price ties to the same instance
+        # type regardless of catalog list order (see _offering_rank)
+        viable_idx = _rank_order(viable_idx, _type_rank(enc))
         # daemon-adjusted allocatable (shared with the merge pass so the
         # pack-time and merge-time capacity views can't diverge)
         alloc = self._alloc_full(enc, daemon)[viable_idx].astype(np.int32)
@@ -3724,18 +3815,62 @@ class TPUScheduler:
         batch indices. Misses run exactly the cold pipeline and populate
         the memo. Emission order is the metas order either way, so warm
         and cold solves build identical plan/record streams."""
+        from . import backends as backends_mod
+
+        # pack-backend seam (solver/backends/): ffd | lp | auto per the
+        # KARPENTER_TPU_PACK_BACKEND switch. The backend only decides the
+        # pod→node partition; pricing/finalize below is backend-agnostic,
+        # and each job's memo key carries the backend token so a switch
+        # between ticks can never alias cached skeletons.
+        backend = backends_mod.active_backend()
         ws = self._warm
         keys: List[Optional[tuple]] = [None] * len(jobs)
         skels: List[Optional[incremental.JobSkeleton]] = [None] * len(jobs)
         if ws is not None and jobs:
             with tracer.span("pack.cache.lookup", jobs=len(jobs)):
                 for i, (job, meta) in enumerate(zip(jobs, metas)):
-                    key = self._job_key(job, meta, mesh)
+                    key = self._job_key(job, meta, mesh, backend)
                     keys[i] = key
                     if key is not None:
                         skels[i] = ws.jobs.get(key, self._cstats)
         miss = [i for i in range(len(jobs)) if skels[i] is None]
-        packed = batch_pack([jobs[i] for i in miss], mesh=mesh) if miss else []
+        # the backends' meta contract, enumerated field by field: this
+        # is every meta input a backend may read (backends/__init__.py),
+        # and listing them explicitly keeps the job-memo read-set check
+        # field-precise (passing the whole metas list would root the
+        # value slice at `metas` and mask the per-field key witnesses)
+        miss_metas = [
+            dict(
+                alloc=metas[i]["alloc"],
+                enc=metas[i]["enc"],
+                viable_idx=metas[i]["viable_idx"],
+                zone_ok=metas[i]["zone_ok"],
+                ct_ok=metas[i]["ct_ok"],
+                zone=metas[i]["zone"],
+            )
+            for i in miss
+        ]
+        # backend.lock spans the call AND the per-call output reads: a
+        # concurrent solve (shadow parity) on the shared singleton must
+        # not overwrite last_stats/last_job_flags between them
+        with backend.lock:
+            packed = (
+                backend.pack_jobs(
+                    [jobs[i] for i in miss],
+                    miss_metas,
+                    mesh=mesh,
+                    stats=self._cstats,
+                )
+                if miss
+                else []
+            )
+            self._observe_pack_backend(backend, bool(miss))
+            # per-job guard flags: True where the LP partition won —
+            # those jobs' merge records become cost-guarded (a merge may
+            # not raise the price back above what the guard just saved)
+            miss_flags = list(getattr(backend, "last_job_flags", ()) or ())
+        if len(miss_flags) != len(miss):
+            miss_flags = [False] * len(miss)
         if merge_all is None:
             # small plans: every (uncapped) node joins the merge pass —
             # the oracle also back-fills leftover space on full nodes.
@@ -3755,8 +3890,11 @@ class TPUScheduler:
                 skel = skels[i]
                 if skel is None:
                     node_ids, node_count = packed[mi]
+                    cost_guard = miss_flags[mi]
                     mi += 1
-                    skel = self._job_skeleton(meta, node_ids, int(node_count))
+                    skel = self._job_skeleton(
+                        meta, node_ids, int(node_count), cost_guard=cost_guard
+                    )
                     if keys[i] is not None:
                         # meta["reqs"] is the job's request matrix (keyed
                         # by its blake2b digest via the job tuple) and
@@ -3768,7 +3906,28 @@ class TPUScheduler:
                     meta, skel, keys[i], pods, result, records, merge_all
                 )
 
-    def _job_key(self, job: tuple, meta: dict, mesh) -> Optional[tuple]:
+    def _observe_pack_backend(self, backend, dispatched: bool) -> None:
+        """Surface the pack backend's per-call outcome (LP guard wins,
+        relaxation bound sum) in per-solve stats, the solve trace, and
+        the lp-jobs metric."""
+        stats = getattr(backend, "last_stats", None) if dispatched else None
+        acc = self._pack_backend_stats
+        acc["backend"] = backend.name
+        if not stats:
+            return
+        for k in ("jobs", "lp_won", "ffd_kept"):
+            if k in stats:
+                acc[k] = acc.get(k, 0) + int(stats[k])
+        for k in ("lp_bound_sum", "lp_saved_per_hr"):
+            if k in stats:
+                acc[k] = round(acc.get(k, 0.0) + float(stats[k]), 6)
+        if self.metrics is not None and hasattr(self.metrics, "solver_lp_jobs"):
+            if stats.get("lp_won"):
+                self.metrics.solver_lp_jobs.inc(stats["lp_won"], outcome="lp_won")
+            if stats.get("ffd_kept"):
+                self.metrics.solver_lp_jobs.inc(stats["ffd_kept"], outcome="ffd_kept")
+
+    def _job_key(self, job: tuple, meta: dict, mesh, backend=None) -> Optional[tuple]:
         """Content address of one pack job: every input the pack AND the
         finalize read. Two ticks producing equal keys provably produce
         identical skeletons (the computation is deterministic), which is
@@ -3803,10 +3962,16 @@ class TPUScheduler:
             limits_key,
             bool(meta["no_merge"]),
             incremental.pack_engine_token(mesh),
+            # pack-backend identity: which engine partitioned this job
+            # (plus its configuration, e.g. the LP iteration budget) —
+            # two backends may produce different partitions for equal
+            # inputs, so their skeletons must never alias
+            backend.job_token() if backend is not None else ("ffd",),
         )
 
     def _job_skeleton(
-        self, meta: dict, node_ids: np.ndarray, node_count: int
+        self, meta: dict, node_ids: np.ndarray, node_count: int,
+        cost_guard: bool = False,
     ) -> incremental.JobSkeleton:
         """The pure finalize computation for one packed job, positional
         over the job's size-sorted pod order (no batch indices — those
@@ -3824,23 +3989,15 @@ class TPUScheduler:
                 0, z, np.zeros(1, dtype=np.int64), unsched,
                 np.zeros(0, dtype=bool), np.zeros(0, dtype=bool),
                 np.zeros((0, R), dtype=np.int64), alloc.max(axis=0) if alloc.size else np.zeros(R, np.int32),
-                z, z, [], [], np.zeros(0),
+                z, z, [], [], np.zeros(0), cost_guard,
             )
         usage = node_usage_from_assignment(reqs, node_ids, node_count)
 
         # price per viable type: cheapest offering allowed by the
-        # signature's zone/capacity-type requirements (zone-pinned if set)
-        if zone is not None:
-            zi = enc.zones.index(zone)
-            zprices = enc.offering_price[viable_idx, zi, :][:, ct_ok]
-            prices = np.where(np.isfinite(zprices), zprices, np.inf).min(axis=1)
-        else:
-            op = enc.offering_price[viable_idx][:, zone_ok, :][:, :, ct_ok].reshape(
-                len(viable_idx), -1
-            )
-            prices = np.where(np.isfinite(op), op, np.inf).min(axis=1) if op.size else np.full(
-                len(viable_idx), np.inf
-            )
+        # signature's zone/capacity-type requirements (zone-pinned if
+        # set) — shared with the pack backends (backends.job_prices) so
+        # a backend's cost reasoning cannot drift from this pricing
+        prices = _job_prices(meta)
 
         chosen_types = assign_cheapest_types(usage, alloc, prices)
         # underfull ⇔ half the elementwise-max viable allocatable still
@@ -3858,6 +4015,15 @@ class TPUScheduler:
         underfull = np.all(
             usage64 * 2 <= alloc_cap.astype(np.int64)[None, :], axis=1
         )
+        if cost_guard:
+            # LP-chosen partitions: a node is only a merge candidate when
+            # it is underfull for its CHOSEN type — the LP deliberately
+            # sizes nodes for cheap small types, and measuring fullness
+            # against the biggest viable type would send every such node
+            # through a merge pass whose cost guard then rejects it
+            # pair by pair (pure overhead at plan-identical output)
+            chosen_alloc = alloc[np.maximum(chosen_types, 0)].astype(np.int64)
+            underfull = ok & np.all(usage64 * 2 <= chosen_alloc, axis=1)
         ok_nodes = np.flatnonzero(ok)
         ok_ord = np.full(node_count, -1, dtype=np.int64)
         ok_ord[ok_nodes] = np.arange(ok_nodes.size)
@@ -3885,6 +4051,7 @@ class TPUScheduler:
             off_zone=off_zone,
             off_ct=off_ct,
             off_price=off_price,
+            cost_guard=bool(cost_guard),
         )
 
     def _emit_skeleton(
@@ -3916,7 +4083,7 @@ class TPUScheduler:
         # stay out
         if meta["no_merge"]:
             to_record = np.zeros(skel.node_count, dtype=bool)
-        elif merge_all:
+        elif merge_all and not skel.cost_guard:
             to_record = skel.ok.copy()
         else:
             to_record = skel.ok & skel.underfull
@@ -3948,6 +4115,8 @@ class TPUScheduler:
                     max_per_node=max_per_node,
                     limits=job_limits,
                 )
+                if skel.cost_guard:
+                    rec["_cost_guard"] = True
                 if key is not None:
                     rec["_rkey"] = (key, n)
                 records.append(rec)
@@ -4316,6 +4485,18 @@ class TPUScheduler:
         off_ok = enc.offering_avail[:, zmask][:, :, ct_ok].any(axis=(1, 2))
         if not (fits & off_ok).any():
             return False
+        # cost guard (solver/backends/lp.py): when either side's
+        # partition was chosen by the LP backend for its price, the
+        # merged node may not cost more than the two nodes it replaces
+        # — the node-count-driven consolidation must not undo the LP's
+        # dollar win. FFD-origin records never carry the flag, so the
+        # default merge semantics are byte-identical to before.
+        merged_price: Optional[float] = None
+        if m.get("_cost_guard") or r.get("_cost_guard"):
+            pm = np.where(fits & off_ok, _offering_pmin(enc, zmask, ct_ok), np.inf)
+            merged_price = float(pm.min())
+            if merged_price > self._record_price(m) + self._record_price(r) + 1e-9:
+                return False
         limits = m.get("limits", []) + r.get("limits", [])
         if limits:
             # every hostname-level constraint of either side must
@@ -4364,7 +4545,32 @@ class TPUScheduler:
         )
         m["members"].extend(r["members"])
         m["_limit_counts"] = counts
+        if m.get("_cost_guard") or r.get("_cost_guard"):
+            m["_cost_guard"] = True
+            m["_price"] = merged_price
+        else:
+            m.pop("_price", None)
         return True
+
+    def _record_price(self, rec: dict) -> float:
+        """The price this record would emit at today: cheapest offering
+        of the cheapest viable type that holds its usage, under its own
+        zone/capacity-type masks (the _emit_record choice). Cached on
+        the record dict — the merge guard prices each side once."""
+        p = rec.get("_price")
+        if p is not None:
+            return p
+        enc = rec["enc"]
+        zmask = rec["zone_ok"]
+        if rec["zone"] is not None:
+            zmask = np.zeros(len(enc.zones), dtype=bool)
+            zmask[enc.zones.index(rec["zone"])] = True
+        alloc = self._alloc_full(enc, rec["daemon"])
+        fits = rec["viable"] & np.all(rec["usage"][None, :] <= alloc, axis=1)
+        prices = np.where(fits, _offering_pmin(enc, zmask, rec["ct_ok"]), np.inf)
+        p = float(prices.min()) if prices.size else float("inf")
+        rec["_price"] = p
+        return p
 
     def _record_limit_count(self, record: dict, sel, ns: str, pods: List[Pod]) -> int:
         cache = record.setdefault("_limit_counts", {})
@@ -4393,9 +4599,11 @@ class TPUScheduler:
             zmask[enc.zones.index(zone)] = True
         # per-type cheapest price within the (zone, ct) mask comes from a
         # table cached on the encoding — merged records share few
-        # distinct masks, so emit stops re-reducing (T, Z, C) per record
+        # distinct masks, so emit stops re-reducing (T, Z, C) per record.
+        # Price ties break on the stable type name rank, not catalog
+        # position (see _offering_rank)
         p = np.where(fits, _offering_pmin(enc, zmask, ct_ok), np.inf)
-        t = int(np.argmin(p))
+        t = _stable_argmin(p, _type_rank(enc))
         if not np.isfinite(p[t]):
             for i in m["members"]:
                 result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
@@ -4433,7 +4641,8 @@ class TPUScheduler:
             zmask[enc.zones.index(zone)] = True
             mask = mask & zmask[:, None]
         masked = np.where(mask, prices, np.inf)
-        zi, ci = np.unravel_index(np.argmin(masked), masked.shape)
+        flat = _stable_argmin(masked.ravel(), _offering_rank(enc).ravel())
+        zi, ci = np.unravel_index(flat, masked.shape)
         return enc.zones[zi], enc.capacity_types[ci], float(masked[zi, ci])
 
     @staticmethod
@@ -4445,8 +4654,9 @@ class TPUScheduler:
         zone: Optional[str],
     ) -> Tuple[List[str], List[str], np.ndarray]:
         """_cheapest_offering over many nodes' chosen types at once: one
-        masked argmin over (N, Z, C). Row-major argmin + unravel matches
-        the scalar's tie-breaking exactly."""
+        masked argmin over (N, Z, C). Price ties break on the stable
+        lexicographic (zone, capacity-type) rank — the same rule as the
+        scalar — never on array position (see _offering_rank)."""
         prices = enc.offering_price[types]  # (N, Z, C)
         mask = np.isfinite(prices) & ct_ok[None, None, :] & zone_ok[None, :, None]
         if zone is not None:
@@ -4454,7 +4664,10 @@ class TPUScheduler:
             zmask[enc.zones.index(zone)] = True
             mask = mask & zmask[None, :, None]
         masked = np.where(mask, prices, np.inf).reshape(len(types), -1)
-        flat = np.argmin(masked, axis=1)
+        pmin = masked.min(axis=1)
+        rank = _offering_rank(enc).reshape(1, -1)
+        tied = masked == pmin[:, None]
+        flat = np.where(tied, rank, np.iinfo(np.int64).max).argmin(axis=1)
         zi, ci = np.unravel_index(flat, prices.shape[1:])
         return (
             [enc.zones[z] for z in zi],

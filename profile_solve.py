@@ -3,6 +3,12 @@
 Usage: python profile_solve.py [pods] [types] [--ticks N] [--churn RATE]
        python profile_solve.py --stream SCENARIO [--scale N] [--pace S]
        python profile_solve.py --disrupt [--nodes N] [--pods-per-node K]
+       python profile_solve.py [pods] [types] --backend {ffd,lp,auto}
+
+With --backend, the solve runs under that pack backend
+(KARPENTER_TPU_PACK_BACKEND; solver/backends/) — lp additionally prints
+the plan cost, the LP relaxation lower bound, and the optimality gap,
+so either backend can be profiled off-TPU with BENCH_BACKEND=cpu.
 
 With --disrupt, builds the config-9 consolidation fleet (bench.py
 disrupt_fleet: N nodes, N*K bound pods, 5% budget), runs one cold
@@ -73,11 +79,19 @@ def _parse_args():
     ap.add_argument("--engine", default="batched",
                     choices=("batched", "sequential"),
                     help="disruption engine to profile (with --disrupt)")
+    ap.add_argument("--backend", default=None, choices=("ffd", "lp", "auto"),
+                    help="pack backend to profile (KARPENTER_TPU_PACK_BACKEND;"
+                         " solver/backends/ — lp reports plan cost, the"
+                         " relaxation bound, and the optimality gap)")
     return ap.parse_args()
 
 
 def main():
     args = _parse_args()
+    if args.backend:
+        # mirrors --disrupt/--stream: one flag pins the engine for the
+        # whole process (off-TPU: combine with BENCH_BACKEND=cpu)
+        os.environ["KARPENTER_TPU_PACK_BACKEND"] = args.backend
     out = {}
     backend = bench.resolve_backend(out)
     print("backend:", backend, file=sys.stderr)
@@ -137,6 +151,24 @@ def main():
         res = solver.solve(pods)
         print(f"warm: {(time.perf_counter()-t0)*1000:.1f} ms "
               f"({res.pods_scheduled} pods, {res.node_count} nodes)", file=sys.stderr)
+    ps_stats = getattr(solver, "last_pack_stats", None) or {}
+    if ps_stats.get("backend") not in (None, "ffd"):
+        from karpenter_core_tpu.solver import plancost
+
+        block = plancost.cost_block(res, provider.instance_types)
+        print(
+            "pack backend: {} (lp_won={} ffd_kept={} saved=${}/hr) "
+            "cost=${}/hr bound=${}/hr gap={}%".format(
+                ps_stats.get("backend"),
+                ps_stats.get("lp_won", 0),
+                ps_stats.get("ffd_kept", 0),
+                round(ps_stats.get("lp_saved_per_hr", 0.0), 2),
+                block["plan_cost_per_hr"],
+                block["lp_bound_per_hr"],
+                block["opt_gap_pct"],
+            ),
+            file=sys.stderr,
+        )
     ms = solver.last_merge_stats or {}
     print(
         "merge: engine={} {:.1f} ms, {} records, {} screened, {} applied".format(

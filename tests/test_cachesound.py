@@ -407,6 +407,8 @@ _MUT_FILES = [
     "karpenter_core_tpu/scheduler/scheduler.py",
     "karpenter_core_tpu/disruption/helpers.py",
     "karpenter_core_tpu/disruption/engine.py",
+    "karpenter_core_tpu/solver/backends/__init__.py",
+    "karpenter_core_tpu/solver/backends/lp.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -492,6 +494,16 @@ _MUTANTS = [
     ("bounds-key-drop-candidates", "karpenter_core_tpu/disruption/engine.py",
      "key = (gen, world, tuple(c.provider_id() for c in cands))",
      "key = (gen, world)", "cache-key"),
+    # ISSUE 8: the LP-relaxation memo (solver/backends/lp.py) — a dual
+    # solve is a function of the request matrix, the capacity table,
+    # the price table, AND the iteration budget; dropping the budget or
+    # the price fingerprint would alias solves across env/price changes.
+    ("lprelax-key-drop-iters", "karpenter_core_tpu/solver/backends/lp.py",
+     "            prices.tobytes(),\n            int(iters),\n        )",
+     "            prices.tobytes(),\n        )", "cache-key"),
+    ("lprelax-key-drop-pricefp", "karpenter_core_tpu/solver/backends/lp.py",
+     "            alloc.tobytes(),\n            prices.tobytes(),\n",
+     "            alloc.tobytes(),\n", "cache-key"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -502,6 +514,8 @@ _MANDATORY = {
     "cluster-bump-del-update-node", "catalog-bump-del-set-types",
     # ISSUE 7 acceptance: the drained-subset delta keys must be witnessed
     "verdict-key-drop-subset", "bounds-key-drop-candidates",
+    # ISSUE 8 acceptance: the LP relax memo's budget + price-table keys
+    "lprelax-key-drop-iters", "lprelax-key-drop-pricefp",
 }
 
 
