@@ -1622,6 +1622,214 @@ def config10() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 11: fleet scaling curve (fleet/ — ISSUE 9)
+# ---------------------------------------------------------------------------
+
+#: catalog archetypes the fleet's tenants cycle through ("mixed
+#: catalogs"): real menus run hundreds of types (config 2 uses 500)
+_FLEET_ARCHETYPE_SIZES = (64, 160, 320)
+
+
+def fleet_catalog(archetype: int, bump: int = 0) -> list:
+    """One archetype's instance-type menu (+ a gpu tail for a second
+    resource axis). ``bump`` produces a content-distinct revision (the
+    mid-stream catalog mutation in the churn rounds)."""
+    from karpenter_core_tpu.cloudprovider.fake import instance_types, new_instance_type
+
+    size = _FLEET_ARCHETYPE_SIZES[archetype % len(_FLEET_ARCHETYPE_SIZES)]
+    cat = instance_types(size - 12 + bump)
+    for g in range(12):
+        cat.append(
+            new_instance_type(
+                f"fleet-gpu-{archetype}-{g}",
+                {"cpu": str(8 * (g + 1)), "memory": f"{16 * (g + 1)}Gi",
+                 "pods": "110", "nvidia.com/gpu": str(min(8, g + 1))},
+            )
+        )
+    return cat
+
+
+def fleet_env(n_tenants: int, seed: int = 11):
+    """Registry + engine for one fleet measurement: tenants cycle the
+    catalog archetypes (fresh, content-identical objects per tenant —
+    each tenant owns its provider), ~60% of each archetype's tenants
+    run its standard workload stack (content twins — the same charts
+    everywhere), the rest carry tenant-specific mixes."""
+    from karpenter_core_tpu.fleet import FleetEngine, FleetRegistry
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+
+    os.environ["KARPENTER_TPU_CATALOG_CACHE_MAX"] = str(2 * n_tenants + 16)
+    registry = FleetRegistry()
+    engine = FleetEngine(registry)
+    tenants = []
+    for t in range(n_tenants):
+        archetype = t % len(_FLEET_ARCHETYPE_SIZES)
+        twin = (t % 5) < 3
+        tid = f"fleet-{t:03d}"
+        provider = FakeCloudProvider()
+        provider.instance_types = fleet_catalog(archetype)
+        provider.bump_catalog_generation()
+        nodepool = NodePool()
+        nodepool.metadata.name = "default"
+        registry.add_tenant(tid, [nodepool], provider)
+        tenants.append({"tid": tid, "idx": t, "archetype": archetype, "twin": twin, "seed": seed})
+    return registry, engine, tenants
+
+
+def fleet_work(tenants: list, pods_each: int, round_idx: int) -> dict:
+    """One round's pending pods per tenant. Twins of an archetype share
+    request content (their job matrices dedupe on the content plane);
+    non-twins draw tenant-specific shapes. Every round's shapes are
+    fresh (new arrivals, not a replay)."""
+    work = {}
+    for t in tenants:
+        # round 0 is the provisioning burst; churn rounds bring 10%
+        # fresh arrivals (2× the config-7 steady churn rate)
+        n = pods_each if round_idx == 0 else max(1, int(pods_each * 0.1))
+        content_seed = (
+            t["seed"] + 7919 * round_idx
+            + (t["archetype"] if t["twin"] else 104_729 + t["idx"])
+        )
+        rng = np.random.RandomState(content_seed)
+        pods = []
+        for i in range(n):
+            cpu = ["100m", "250m", "500m", "1", "2", "4"][rng.randint(6)]
+            mem = ["128Mi", "512Mi", "1Gi", "2Gi", "4Gi"][rng.randint(5)]
+            gpu = "1" if rng.rand() < 0.1 else None
+            pods.append(_mk_pod(f"{t['tid']}-r{round_idx}-{i}", cpu, mem, gpu=gpu))
+        work[t["tid"]] = pods
+    return work
+
+
+def fleet_run(
+    n_tenants: int,
+    pods_each: int,
+    engine_name: str,
+    rounds: int = 3,
+    collect_plans: bool = False,
+) -> dict:
+    """One engine's fleet measurement: a provisioning burst (round 0,
+    every tenant's full workload) followed by churn rounds (30% fresh
+    arrivals; tenant 0 mutates its catalog before round 1). Timed wall
+    covers the solve rounds only — both engines consume identical,
+    pre-materialized pod streams."""
+    os.environ["KARPENTER_TPU_FLEET_ENGINE"] = engine_name
+    registry, engine, tenants = fleet_env(n_tenants)
+    works = [fleet_work(tenants, pods_each, r) for r in range(rounds)]
+    plans: dict = {}
+    decided = 0
+    dispatch = {"flushes": 0, "pack_calls": 0, "jobs": 0, "max_occupancy": 0}
+    wall = 0.0
+    per_round_ms = []
+    for r, work in enumerate(works):
+        if r == 1:
+            # mid-stream catalog mutation: tenant 0 ships a new menu
+            h = registry.get(tenants[0]["tid"])
+            h.provider.set_instance_types(fleet_catalog(tenants[0]["archetype"], bump=1))
+        with nogc():
+            t0 = time.perf_counter()
+            outcomes = engine.solve_round(work)
+            dt = time.perf_counter() - t0
+        wall += dt
+        per_round_ms.append(round(dt * 1000.0, 1))
+        d = engine.last_round.get("dispatch") or {}
+        for k in ("flushes", "pack_calls", "jobs"):
+            dispatch[k] += d.get(k, 0)
+        dispatch["max_occupancy"] = max(dispatch["max_occupancy"], d.get("max_occupancy", 0))
+        for tid in sorted(outcomes):
+            o = outcomes[tid]
+            if o.error is not None:
+                raise RuntimeError(f"fleet solve failed for {tid}: {o.error}")
+            decided += o.pods
+            if collect_plans:
+                plans[(r, tid)] = tuple(
+                    sorted(_fleet_plan_identity(p) for p in o.result.node_plans)
+                )
+    return {
+        "engine": engine_name,
+        "tenants": n_tenants,
+        "pods_each": pods_each,
+        "rounds": rounds,
+        "pods_decided": decided,
+        "wall_ms": round(wall * 1000.0, 1),
+        "round_ms": per_round_ms,
+        "pods_per_sec": round(decided / wall, 1) if wall else 0.0,
+        "dispatch": dispatch,
+        "plans": plans,
+    }
+
+
+def _fleet_plan_identity(plan) -> tuple:
+    """Content projection for engine parity (object identities differ:
+    the batched engine emits from canonical catalog snapshots)."""
+    return (
+        plan.nodepool_name,
+        plan.instance_type.name,
+        plan.zone,
+        plan.capacity_type,
+        round(plan.price, 9),
+        tuple(plan.pod_indices),
+        plan.max_pods_per_node,
+    )
+
+
+def config11() -> dict:
+    """Fleet scaling curve (ISSUE 9): {8, 32, 128} tenants × {200, 1k}
+    pods each × mixed catalog archetypes, batched vs solo. Gates:
+    aggregate fleet throughput at 128 small tenants ≥ 3× solo, and
+    per-tenant plan identity 100% (batched ⇔ solo, every tenant, every
+    round, including the mid-stream catalog mutation)."""
+    # pay process warmup (jit compiles, interning) outside the timers
+    fleet_run(2, _scale(40), "solo", rounds=1)
+    fleet_run(2, _scale(40), "batched", rounds=1)
+
+    curve = []
+    gate_ratio = None
+    for n_tenants in (8, 32, 128):
+        for pods_each in (200, 1000):
+            solo = fleet_run(n_tenants, _scale(pods_each), "solo")
+            batched = fleet_run(n_tenants, _scale(pods_each), "batched")
+            ratio = (
+                round(batched["pods_per_sec"] / solo["pods_per_sec"], 2)
+                if solo["pods_per_sec"]
+                else 0.0
+            )
+            if n_tenants == 128 and pods_each == 200:
+                gate_ratio = ratio
+            curve.append(
+                {
+                    "tenants": n_tenants,
+                    "pods_each": pods_each,
+                    "solo_pods_per_sec": solo["pods_per_sec"],
+                    "batched_pods_per_sec": batched["pods_per_sec"],
+                    "throughput_ratio": ratio,
+                    "solo_round_ms": solo["round_ms"],
+                    "batched_round_ms": batched["round_ms"],
+                    "dispatch": batched["dispatch"],
+                }
+            )
+
+    # plan identity, both engines over identical content (8 tenants,
+    # 3 rounds, catalog mutation mid-stream)
+    solo_id = fleet_run(8, _scale(200), "solo", collect_plans=True)
+    bat_id = fleet_run(8, _scale(200), "batched", collect_plans=True)
+    cells = set(solo_id["plans"]) | set(bat_id["plans"])
+    identical = sum(
+        1 for c in cells if solo_id["plans"].get(c) == bat_id["plans"].get(c)
+    )
+    return {
+        "config": "11: fleet scaling curve {8,32,128} tenants x {200,1k} pods, batched vs solo",
+        "curve": curve,
+        "throughput_ratio_at_128_small": gate_ratio,
+        "throughput_target_ratio": 3.0,
+        "throughput_over_target": bool(gate_ratio and gate_ratio >= 3.0),
+        "plan_identity": f"{identical}/{len(cells)}",
+        "plan_identical_all": identical == len(cells),
+    }
+
+
+# ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
 
@@ -1750,9 +1958,9 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11):
             try:
-                if fn in (config7, config8, config9):  # measure the incremental/serving/disruption paths
+                if fn in (config7, config8, config9, config11):  # measure the incremental/serving/disruption/fleet paths
                     configs.append(fn())
                 else:
                     with incremental_off():

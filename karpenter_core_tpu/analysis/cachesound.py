@@ -257,8 +257,20 @@ def _build_registry(files: Sequence[FileContext]) -> Registry:
 
 
 # skip cache-plumbing scopes: the containers' own implementation
-_PLUMBING_CLASSES = {"LRU", "CacheStats", "WarmState"}
+# (SkeletonPlane is the fleet content plane's accessor pair — its
+# call sites in solver._pack_and_finalize are the analyzed sites,
+# exactly like WarmState's seeds_get/seeds_put)
+_PLUMBING_CLASSES = {"LRU", "CacheStats", "WarmState", "SkeletonPlane"}
 _PLUMBING_FNS = {"warm_state_for", "reset", "cache_cap", "enabled"}
+
+# tenant-scoped caches (ISSUE 9): their validity guards are PER-OBJECT
+# generation counters (a cluster's informer generation, a provider's
+# catalog generation), so the key must also witness WHICH tenant's
+# object the guard belongs to — equal counter values from two tenants'
+# objects witness nothing about each other, and a key without the
+# tenant component would serve one tenant's entries to another.
+_TENANT_SCOPED_SPECS = {"seeds", "fleetenv"}
+_TENANT_WITNESS_SEGMENTS = {"_tenant_scope", "tenant_id", "tenant"}
 
 
 def _own_nodes(fn: ast.AST):
@@ -1071,6 +1083,20 @@ def _fn_events(an: Analyzer, fn: FnInfo) -> List[CacheEvent]:
                 if attr == "seeds_put" and len(node.args) > 2:
                     ev.value_exprs = [node.args[2]]
                 out.append(ev)
+            elif attr in ("skeleton_get", "skeleton_put") and node.args:
+                # fleet content plane accessor pair (fleet/megasolve.py
+                # SkeletonPlane): key arg 0, stored skeleton arg 1
+                spec = ContainerSpec("fleetjob")
+                ev = CacheEvent(
+                    "get" if attr == "skeleton_get" else "put",
+                    spec,
+                    fn,
+                    node.lineno,
+                    key_exprs=[node.args[0]],
+                )
+                if attr == "skeleton_put" and len(node.args) > 1:
+                    ev.value_exprs = [node.args[1]]
+                out.append(ev)
         elif isinstance(node, ast.Assign) and isinstance(
             node.targets[0], ast.Subscript
         ):
@@ -1439,6 +1465,31 @@ def _check_site(an: Analyzer, site: Site) -> Iterable[Finding]:
                 if {rootkey(p) for p in a} & roots:
                     reads |= a
     reads = _minimal(_drop_plumbing(reads, receivers))
+
+    # tenant-scope witness (ISSUE 9): generation-guarded caches that can
+    # serve multiple tenants must carry the tenant scope in their key —
+    # the generation guard is a per-object counter, so equal values from
+    # two tenants' objects would otherwise alias their entries
+    if site.spec.name in _TENANT_SCOPED_SPECS:
+        raw_witness = _witness_of(an, site.gets + site.puts)
+        has_tenant = any(
+            seg in _TENANT_WITNESS_SEGMENTS for p in raw_witness for seg in p
+        )
+        if not has_tenant and not excluded(("_tenant_scope",)):
+            yield Finding(
+                rule="cache-key",
+                path=fn.ctx.relpath,
+                line=put_line,
+                symbol=fn.symbol,
+                message=(
+                    f"cache '{site.spec.name}': key does not witness the tenant "
+                    f"scope — its generation guard is a per-tenant counter, so "
+                    f"equal generations from different tenants' objects would "
+                    f"alias entries across tenants (add the solver's "
+                    f"_tenant_scope / the tenant id to the key)"
+                ),
+                severity=SEV_ERROR,
+            )
 
     # pod-memo rv guard: the stored tuple's first element must witness
     # the pod's resource_version (the memo's only validity check)

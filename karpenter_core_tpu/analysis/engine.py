@@ -51,6 +51,7 @@ class AnalysisConfig:
         "karpenter_core_tpu/cloudprovider/",
         "karpenter_core_tpu/tracing/",
         "karpenter_core_tpu/serving/",
+        "karpenter_core_tpu/fleet/",
     )
     # cross-module device-array-returning functions (jit-decorated
     # functions in the SAME module are detected automatically)
@@ -83,6 +84,10 @@ class AnalysisConfig:
         # plan-quality pack backends (ISSUE 8): the LP relaxation memo
         "karpenter_core_tpu/solver/backends/__init__.py",
         "karpenter_core_tpu/solver/backends/lp.py",
+        # fleet mega-solve (ISSUE 9): the tenant envelope/canonical
+        # catalog memos and the fleet-wide job-skeleton content plane
+        "karpenter_core_tpu/fleet/registry.py",
+        "karpenter_core_tpu/fleet/megasolve.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)
@@ -92,8 +97,12 @@ class AnalysisConfig:
         "karpenter_core_tpu/cloudprovider/types.py",
     )
     # serving-pipeline modules: multi-threaded by design, held to the
-    # pipeline-safety rule (lock-guarded or queue-handed-off sharing)
-    serving_prefixes: Tuple[str, ...] = ("karpenter_core_tpu/serving/",)
+    # pipeline-safety rule (lock-guarded or queue-handed-off sharing);
+    # the fleet engine's worker threads are held to the same rule
+    serving_prefixes: Tuple[str, ...] = (
+        "karpenter_core_tpu/serving/",
+        "karpenter_core_tpu/fleet/",
+    )
     # modules whose cluster-API reads define the generation-relevant
     # field set (what the solver's caches can actually observe)
     cluster_consumer_modules: Tuple[str, ...] = (

@@ -1,0 +1,36 @@
+"""Fleet solver (ISSUE 9 tentpole): multiplex many tenant clusters
+through one device.
+
+The north star is thousands of small tenant clusters, not one giant
+one — yet a single-cluster solve pays the full dispatch floor
+(solver/calibrate.py) no matter how small the tenant. This package
+amortizes that floor across the fleet:
+
+- ``registry``  — per-tenant Cluster/CloudProvider/solver handles with
+  strict isolation: no provider or cluster object may serve two
+  tenants, and every identity/generation-scoped cross-solve memo a
+  tenant's solver touches is tenant-scoped (enforced by the cachesound
+  tenant-witness check + kill mutants).
+- ``megasolve`` — the mega-solve engine: tenants' pack jobs coalesce
+  into one dispatch through the PR-8 ``PackBackend`` seam (ffd and lp
+  both batch), catalog archetypes dedupe onto canonical content-
+  addressed entries, and job skeletons ride a fleet-wide content plane.
+  ``KARPENTER_TPU_FLEET_ENGINE={batched,solo}`` — solo (independent
+  per-tenant solves) stays the plan-identity oracle.
+- ``scheduler`` — bounded admission with deficit-round-robin fairness
+  across tenants, batch-window coalescing, and per-tenant
+  decision-latency SLOs (serving/latency.py).
+"""
+
+from .megasolve import FleetEngine, TenantOutcome, fleet_engine_name
+from .registry import FleetRegistry, TenantHandle
+from .scheduler import FleetScheduler
+
+__all__ = [
+    "FleetEngine",
+    "FleetRegistry",
+    "FleetScheduler",
+    "TenantHandle",
+    "TenantOutcome",
+    "fleet_engine_name",
+]

@@ -1,0 +1,576 @@
+"""Mega-solve: N tenants' solves through one device, one dispatch.
+
+Three cooperating pieces, all opt-in per round via
+``KARPENTER_TPU_FLEET_ENGINE`` (default ``batched``; ``solo`` is the
+plan-identity oracle — independent per-tenant solves, exactly what a
+standalone per-tenant serving stack would run):
+
+- **CatalogPlane** — content-dedupes tenant catalogs. Small tenants
+  overwhelmingly run catalog *archetypes* (the same instance-type
+  menu); solo serving re-encodes that menu once per tenant. The plane
+  maps (tenant, pool, provider catalog generation) to a content
+  fingerprint (the ``fleetenv`` memo — computed once per generation,
+  not per solve) and fingerprints to one canonical deep-copied catalog
+  snapshot (``fleetcanon``), so content-identical tenants resolve to
+  the SAME catalog object and share one encoded `_CatalogEntry` (and
+  with it the compat-row cache). Snapshots are plane-owned copies: a
+  tenant mutating its own catalog in place can never corrupt what
+  other tenants read.
+
+- **SkeletonPlane** — the fleet-wide job-skeleton memo (``fleetjob``).
+  A job key minus its trailing tenant scope is pure content (catalog
+  entry identity+fingerprint, pool fingerprint, request digest, every
+  mask, engine+backend tokens — solver._job_key), and the skeleton is
+  a deterministic function of that content, so sharing across tenants
+  is memoization, never approximation.
+
+- **_MegaDispatcher** — pack-call coalescing. Each tenant solve runs on
+  a worker thread with a thread-local ``_CoalescingBackend`` installed
+  (solver/backends.set_thread_backend); its pack submissions park at a
+  quiescence barrier and flush as ONE ``PackBackend.pack_jobs`` call —
+  pack.batch_pack then buckets the combined fleet's jobs by padded
+  shape into a few vmapped dispatches (the lp backend batches its dual
+  relaxations the same way). Per-job results are independent of batch
+  composition (vmap lanes are independent; the native packer is
+  per-job), so demuxed results are byte-identical to solo packs by
+  construction.
+
+Identity invariant: batched plans are byte-identical to solo plans for
+the same tenant inputs (bench config 11 and tests/test_fleet.py gate
+it). Isolation invariant: the only cross-tenant sharing is
+content-addressed; every identity/generation-scoped memo carries the
+tenant scope (cachesound tenant-witness check + kill mutants).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..solver import backends as backends_mod
+from ..solver.backends import PackBackend
+from ..solver.incremental import LRU, CacheStats
+from ..tracing import tracer
+
+
+def fleet_engine_name() -> str:
+    """Engine switch, read per round (the PR-2/7/8 pattern). Unknown
+    names degrade to the default, never fail the round."""
+    name = os.environ.get("KARPENTER_TPU_FLEET_ENGINE", "batched").strip().lower()
+    return name if name in ("batched", "solo") else "batched"
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _clone_catalog(its: list) -> list:
+    """Plane-owned snapshot of a tenant catalog: every field the
+    encoding (and the emitted plans) read is copied, so no tenant's
+    in-place mutation can reach the canonical entry. Field-level, not
+    deepcopy — InstanceType carries a lazy-allocatable lock."""
+    from ..cloudprovider.types import (
+        InstanceType,
+        InstanceTypeOverhead,
+        Offering,
+        Offerings,
+    )
+
+    out = []
+    for it in its:
+        out.append(
+            InstanceType(
+                it.name,
+                it.requirements.copy(),
+                Offerings(
+                    Offering(o.capacity_type, o.zone, o.price, o.available)
+                    for o in it.offerings
+                ),
+                dict(it.capacity),
+                overhead=InstanceTypeOverhead(
+                    dict(it.overhead.kube_reserved),
+                    dict(it.overhead.system_reserved),
+                    dict(it.overhead.eviction_threshold),
+                ),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# catalog content plane
+
+
+class CatalogPlane:
+    """Content-addressed canonical catalogs for the batched engine."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        # (tenant_id, pool name, provider catalog generation) -> content
+        # fingerprint: the generation is the provider's own invalidation
+        # witness (PR-4 trusted-generation contract), so the fingerprint
+        # is computed once per catalog generation, not once per solve
+        self._envelopes = LRU("fleetenv")
+        # content fingerprint -> (canonical snapshot, plane generation)
+        self._canon = LRU("fleetcanon")
+        self._next_gen = 0
+        self._active = False
+        self.stats = CacheStats()
+
+    def activate(self, on: bool) -> None:
+        with self._mu:
+            self._active = bool(on)
+
+    def active(self) -> bool:
+        with self._mu:
+            return self._active
+
+    def _fingerprint_for(self, tenant_id: str, pool_name, gen, its) -> bytes:
+        from ..solver.solver import _catalog_fingerprint
+
+        key = (tenant_id, pool_name, gen)
+        fp = self._envelopes.get(key, self.stats)
+        if fp is None:
+            fp = _catalog_fingerprint(its)
+            # the provider's generation in the key witnesses the catalog
+            # content ``its`` (the generation bumps on every catalog
+            # mutation — the trusted-generation contract the cache-
+            # invalidation rule enforces), and (tenant_id, pool name)
+            # witness WHICH provider's catalog this is
+            # analysis: allow-cache-key(its)
+            self._envelopes.put(key, fp, self.stats)
+        return fp
+
+    def _canonical_for(self, fp: bytes, its: list) -> tuple:
+        with self._mu:
+            canon = self._canon.get(fp, self.stats)
+            if canon is None:
+                # plane-owned deep copy: tenants keep their own objects,
+                # the canonical snapshot can never be mutated under the
+                # shared encoded entry's feet
+                self._next_gen += 1
+                canon = (_clone_catalog(its), ("fleet", self._next_gen))
+                # content-addressed: the fingerprint IS the full read-set
+                # of the snapshot (it digests every field the encoding
+                # reads — solver._catalog_fingerprint)
+                # analysis: allow-cache-key(its)
+                self._canon.put(fp, canon, self.stats)
+        return canon
+
+    def prewarm(self, tenant_id: str, provider, nodepools) -> None:
+        """Admission-time envelope warm: each pool catalog's content
+        fingerprint (and, first-of-content, its canonical snapshot) is
+        computed when the fleet LEARNS the tenant, not inside the
+        tenant's first serving round — one fingerprint per catalog
+        generation, ever (the solo engine pays none: it rides the
+        provider's trusted generation directly). Mid-stream catalog
+        mutations re-fingerprint lazily in-round, once."""
+        cg = getattr(provider, "catalog_generation", None)
+        for np_ in list(nodepools) or [None]:
+            its = provider.get_instance_types(np_)
+            gen = cg(np_) if callable(cg) else None
+            if gen is None:
+                continue
+            pool_name = np_.metadata.name if np_ is not None else None
+            fp = self._fingerprint_for(tenant_id, pool_name, gen, its)
+            self._canonical_for(fp, its)
+
+    def resolve(self, tenant_id: str, provider, nodepool) -> Tuple[list, object]:
+        """→ (catalog, generation witness) for one tenant pool.
+
+        Inactive, or for providers without a trusted generation counter
+        (content changes would be invisible to the envelope memo), this
+        is a pass-through of the tenant's own catalog."""
+        its = provider.get_instance_types(nodepool)
+        cg = getattr(provider, "catalog_generation", None)
+        gen = cg(nodepool) if callable(cg) else None
+        if not self.active() or gen is None:
+            return its, gen
+        pool_name = nodepool.metadata.name if nodepool is not None else None
+        fp = self._fingerprint_for(tenant_id, pool_name, gen, its)
+        return self._canonical_for(fp, its)
+
+    def debug_state(self) -> dict:
+        with self._mu:
+            return {
+                "active": self._active,
+                "envelopes": len(self._envelopes),
+                "canonical_catalogs": len(self._canon),
+                "stats": self.stats.to_dict(),
+            }
+
+
+class TenantCatalogView:
+    """CloudProvider facade a tenant's solver reads: pass-through in
+    solo mode, canonical content-deduped snapshots in batched mode.
+    Everything except the catalog surface delegates to the tenant's own
+    provider (create/delete/list stay strictly per-tenant)."""
+
+    def __init__(self, provider, plane: CatalogPlane, tenant_id: str):
+        self._provider = provider
+        self._plane = plane
+        self._tenant_id = tenant_id
+
+    def get_instance_types(self, nodepool=None):
+        catalog, _gen = self._plane.resolve(self._tenant_id, self._provider, nodepool)
+        return catalog
+
+    def catalog_generation(self, nodepool=None):
+        _catalog, gen = self._plane.resolve(self._tenant_id, self._provider, nodepool)
+        return gen
+
+    def __getattr__(self, name):
+        return getattr(self._provider, name)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide job-skeleton content plane
+
+
+class SkeletonPlane:
+    """Accessor pair around the ``fleetjob`` LRU — the solver consults
+    it from ``_pack_and_finalize`` under the tenant-free content prefix
+    of the job key (key[:-1]); see the soundness argument there."""
+
+    def __init__(self) -> None:
+        self._skeletons = LRU("fleetjob")
+
+    def skeleton_get(self, key: tuple, stats: Optional[CacheStats] = None):
+        return self._skeletons.get(key, stats)
+
+    def skeleton_put(self, key: tuple, skel, stats: Optional[CacheStats] = None) -> None:
+        self._skeletons.put(key, skel, stats)
+
+    def __len__(self) -> int:
+        return len(self._skeletons)
+
+
+# ---------------------------------------------------------------------------
+# pack coalescing: the one-dispatch mega-solve
+
+
+class _PackWait:
+    """One tenant thread's parked pack submission."""
+
+    __slots__ = ("jobs", "metas", "mesh", "results", "flags", "error", "done")
+
+    def __init__(self, jobs, metas, mesh):
+        self.jobs = jobs
+        self.metas = metas
+        self.mesh = mesh
+        self.results = None
+        self.flags: List[bool] = []
+        self.error: Optional[BaseException] = None
+        self.done = False
+
+
+class _MegaDispatcher:
+    """Quiescence-flush coalescer: pack submissions from tenant worker
+    threads park here; when every busy worker is parked (or the safety
+    window expires), the LAST arrival flushes them all as ONE call into
+    the real pack backend. Per-job pack results do not depend on batch
+    composition, so flush grouping affects latency only, never plans."""
+
+    def __init__(self, backend: PackBackend, window: float = 0.05):
+        self._backend = backend
+        self._window = window
+        self._cv = threading.Condition()
+        self._active = 0  # workers currently driving a tenant solve
+        self._pending: List[_PackWait] = []
+        self.stats = CacheStats()  # fleet-level relax-memo traffic (lp)
+        # mega-dispatch observability (gauges + /debug/fleet)
+        self.flushes = 0
+        self.calls = 0
+        self.jobs_in = 0
+        self.max_occupancy = 0
+        self.pad_real = 0
+        self.pad_slots = 0
+
+    def target_token(self) -> tuple:
+        """The REAL backend's job token: fleet job-memo keys must equal
+        solo keys for identical content (that equality is what lets the
+        content plane and the per-tenant memos interoperate)."""
+        return self._backend.job_token()
+
+    def worker_begin(self) -> None:
+        with self._cv:
+            self._active += 1
+
+    def worker_end(self) -> None:
+        with self._cv:
+            self._active -= 1
+            # a departing worker can complete quiescence for the rest
+            self._cv.notify_all()
+
+    def submit(self, jobs: list, metas: list, mesh) -> Tuple[list, List[bool]]:
+        w = _PackWait(jobs, metas, mesh)
+        with self._cv:
+            self._pending.append(w)
+            self.calls += 1
+            self.jobs_in += len(jobs)
+            self._cv.notify_all()
+        while True:
+            batch: Optional[List[_PackWait]] = None
+            with self._cv:
+                if w.done:
+                    break
+                if self._pending and len(self._pending) >= max(self._active, 1):
+                    # quiescence: every busy worker is parked here
+                    batch, self._pending = self._pending, []
+                elif not self._cv.wait(timeout=self._window):
+                    if not w.done and self._pending:
+                        # safety flush: progress even if a worker stalls
+                        # outside the barrier (grouping is latency-only)
+                        batch, self._pending = self._pending, []
+            if batch is not None:
+                self._run_batch(batch)
+                with self._cv:
+                    self._cv.notify_all()
+        if w.error is not None:
+            raise w.error
+        return w.results, w.flags
+
+    def _run_batch(self, batch: List[_PackWait]) -> None:
+        from ..solver.pack import _pad_class
+
+        all_jobs = [j for w in batch for j in w.jobs]
+        all_metas = [m for w in batch for m in w.metas]
+        mesh = batch[0].mesh
+        try:
+            with tracer.span("fleet.megadispatch", jobs=len(all_jobs), tenant_calls=len(batch)):
+                # the real backend's lock spans the call and its per-call
+                # outputs (the PR-8 singleton discipline)
+                with self._backend.lock:
+                    packed = self._backend.pack_jobs(
+                        all_jobs, all_metas, mesh=mesh, stats=self.stats
+                    )
+                    flags = list(getattr(self._backend, "last_job_flags", ()) or ())
+            if len(flags) != len(all_jobs):
+                flags = [False] * len(all_jobs)
+            with self._cv:
+                self.flushes += 1
+                self.max_occupancy = max(self.max_occupancy, len(batch))
+                for j in all_jobs:
+                    p = int(j[0].shape[0])
+                    self.pad_real += p
+                    self.pad_slots += _pad_class(p)
+            pos = 0
+            for w in batch:
+                n = len(w.jobs)
+                w.results = packed[pos : pos + n]
+                w.flags = flags[pos : pos + n]
+                pos += n
+        except BaseException as err:  # noqa: BLE001 — every waiter must wake with the error
+            for w in batch:
+                w.error = err
+        finally:
+            with self._cv:
+                for w in batch:
+                    w.done = True
+                self._cv.notify_all()
+
+    def summary(self) -> dict:
+        with self._cv:
+            waste = (
+                round(1.0 - self.pad_real / self.pad_slots, 4) if self.pad_slots else 0.0
+            )
+            return {
+                "flushes": self.flushes,
+                "pack_calls": self.calls,
+                "jobs": self.jobs_in,
+                "max_occupancy": self.max_occupancy,
+                "padding_waste": waste,
+            }
+
+
+class _CoalescingBackend(PackBackend):
+    """Per-tenant-thread facade over the mega-dispatcher. The job token
+    delegates to the real backend so job-memo keys (and with them the
+    content plane) are engine-agnostic."""
+
+    name = "fleet"
+
+    def __init__(self, dispatcher: _MegaDispatcher):
+        super().__init__()
+        self._dispatcher = dispatcher
+        self.last_stats: dict = {}
+
+    def job_token(self) -> tuple:
+        return self._dispatcher.target_token()
+
+    def pack_jobs(self, jobs, metas, mesh=None, stats=None):
+        results, flags = self._dispatcher.submit(jobs, metas, mesh)
+        self.last_job_flags = flags
+        return results
+
+
+# ---------------------------------------------------------------------------
+# the fleet engine
+
+
+class TenantOutcome:
+    """One tenant's result for one round."""
+
+    __slots__ = ("result", "error", "ms", "pods")
+
+    def __init__(self, result=None, error: Optional[str] = None, ms: float = 0.0, pods: int = 0):
+        self.result = result
+        self.error = error
+        self.ms = ms
+        self.pods = pods
+
+
+class FleetEngine:
+    """Runs fleet rounds: a mapping {tenant_id: pending pods} in, a
+    mapping {tenant_id: TenantOutcome} out, behind the engine switch."""
+
+    def __init__(self, registry, metrics=None):
+        self.registry = registry
+        self.metrics = metrics
+        self.skeletons = SkeletonPlane()
+        self._mu = threading.Lock()
+        self._round = 0
+        self.last_round: dict = {}
+        self.last_dispatch: dict = {}
+        # tenant-label cardinality cap for the per-tenant metrics: the
+        # first N tenants keep their label, the rest collapse to
+        # "_other" (a fleet of thousands must not mint thousands of
+        # label sets per counter)
+        self._label_cap = _env_int("KARPENTER_TPU_FLEET_TENANT_LABELS", 64)
+        self._labeled: set = set()
+
+    def _tenant_label(self, tenant_id: str) -> str:
+        with self._mu:
+            if tenant_id in self._labeled:
+                return tenant_id
+            if len(self._labeled) < self._label_cap:
+                self._labeled.add(tenant_id)
+                return tenant_id
+            return "_other"
+
+    # -- per-tenant solve ---------------------------------------------------
+
+    def _solve_tenant(self, tenant_id: str, pods: list, engine: str) -> TenantOutcome:
+        handle = self.registry.get(tenant_id)
+        if handle is None:
+            return TenantOutcome(error=f"unknown tenant {tenant_id!r}", pods=len(pods))
+        t0 = time.perf_counter()
+        try:
+            result = handle.solver.solve(pods)
+            out = TenantOutcome(
+                result=result, ms=(time.perf_counter() - t0) * 1000.0, pods=len(pods)
+            )
+        except Exception as err:  # noqa: BLE001 — one tenant's failure must not fail the round
+            out = TenantOutcome(
+                error=f"{type(err).__name__}: {err}",
+                ms=(time.perf_counter() - t0) * 1000.0,
+                pods=len(pods),
+            )
+        self.registry.record_solve(tenant_id, len(pods), out.error)
+        if self.metrics is not None:
+            label = self._tenant_label(tenant_id)
+            self.metrics.fleet_solves.inc(tenant=label, engine=engine)
+            self.metrics.fleet_pods.inc(len(pods), tenant=label)
+        return out
+
+    # -- rounds -------------------------------------------------------------
+
+    def solve_round(self, work: Dict[str, list]) -> Dict[str, TenantOutcome]:
+        """One fleet round over {tenant_id: pods}. Engine read per round."""
+        engine = fleet_engine_name()
+        t0 = time.perf_counter()
+        order = sorted(work)
+        plane = self.registry.plane
+        plane.activate(engine == "batched")
+        for tid in order:
+            handle = self.registry.get(tid)
+            if handle is not None:
+                handle.solver.fleet_plane = self.skeletons if engine == "batched" else None
+        if engine == "solo":
+            outcomes = {tid: self._solve_tenant(tid, work[tid], engine) for tid in order}
+            dispatch: dict = {}
+        else:
+            outcomes, dispatch = self._solve_batched(work, order, engine)
+        dt = time.perf_counter() - t0
+        with self._mu:
+            self._round += 1
+            self.last_dispatch = dispatch
+            self.last_round = {
+                "round": self._round,
+                "engine": engine,
+                "tenants": len(order),
+                "pods": sum(len(p) for p in work.values()),
+                "ms": round(dt * 1000.0, 3),
+                "errors": {t: o.error for t, o in outcomes.items() if o.error},
+                "composition": [
+                    {"tenant": t, "pods": len(work[t]), "ms": round(outcomes[t].ms, 3)}
+                    for t in order
+                ],
+                "dispatch": dispatch,
+            }
+        if self.metrics is not None:
+            self.metrics.fleet_round_duration.observe(dt, engine=engine)
+            if dispatch:
+                occ = dispatch.get("max_occupancy", 0)
+                self.metrics.fleet_batch_occupancy.set(float(occ))
+                self.metrics.fleet_padding_waste.set(float(dispatch.get("padding_waste", 0.0)))
+        return outcomes
+
+    def _solve_batched(
+        self, work: Dict[str, list], order: List[str], engine: str
+    ) -> Tuple[Dict[str, TenantOutcome], dict]:
+        dispatcher = _MegaDispatcher(backends_mod.active_backend())
+        outcomes: Dict[str, TenantOutcome] = {}
+        out_mu = threading.Lock()
+        queue = list(order)
+        q_mu = threading.Lock()
+
+        def next_tenant() -> Optional[str]:
+            with q_mu:
+                return queue.pop(0) if queue else None
+
+        def run_worker() -> None:
+            dispatcher.worker_begin()
+            backends_mod.set_thread_backend(_CoalescingBackend(dispatcher))
+            try:
+                while True:
+                    tid = next_tenant()
+                    if tid is None:
+                        return
+                    out = self._solve_tenant(tid, work[tid], engine)
+                    with out_mu:
+                        outcomes[tid] = out
+            finally:
+                backends_mod.set_thread_backend(None)
+                dispatcher.worker_end()
+
+        # default 4 lanes: on the CPU fallback the tenant pipelines are
+        # host-bound (more lanes just contend), while enough remain to
+        # keep the quiescence barrier's mega-dispatches multi-tenant;
+        # on a real device, raise it — lanes overlap device waits
+        n_workers = min(len(order), _env_int("KARPENTER_TPU_FLEET_WORKERS", 4))
+        threads = [
+            threading.Thread(target=run_worker, name=f"fleet-worker-{i}", daemon=True)
+            for i in range(max(n_workers, 1))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return outcomes, dispatcher.summary()
+
+    def debug_state(self) -> dict:
+        with self._mu:
+            last_round = dict(self.last_round)
+        return {
+            "engine": fleet_engine_name(),
+            "registry": self.registry.debug_state(),
+            "catalog_plane": self.registry.plane.debug_state(),
+            "skeleton_plane": len(self.skeletons),
+            "last_round": last_round,
+        }

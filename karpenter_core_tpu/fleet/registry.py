@@ -1,0 +1,198 @@
+"""Tenant registry: per-tenant control-plane handles, strictly isolated.
+
+One registry holds every tenant the fleet serves. Each tenant gets its
+own solver (``TPUScheduler`` with a tenant scope), its own pinned
+WarmState (solver/incremental.py), and its own decision-latency
+tracker. Isolation is structural, not advisory:
+
+- a CloudProvider or Cluster object registered to one tenant is
+  REJECTED for any other tenant (object sharing is how cross-tenant
+  cache aliasing starts — generation counters are per-object);
+- the solver's tenant scope rides every identity/generation-scoped
+  memo key (seed cache, job memo, warm-state resolution), so even a
+  deliberately shared provider could not alias two tenants' caches;
+- the only cross-tenant sharing is the mega-solve CONTENT plane
+  (megasolve.py), which is content-addressed by construction — a hit
+  is the same computation, not a neighbor's state.
+
+Tenant catalogs reach the solver through a ``TenantCatalogView``
+(megasolve.py): inactive (solo engine) it is a pass-through to the
+tenant's own provider; active (batched engine) it resolves the catalog
+to the fleet's canonical content-deduped snapshot so content-identical
+tenants share one encoded catalog entry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..serving.latency import DecisionLatencyTracker
+from ..solver import TPUScheduler
+from ..solver.incremental import WarmState
+
+
+class TenantHandle:
+    """Everything the fleet holds for one tenant. Mutable counters are
+    guarded by the owning registry's lock."""
+
+    def __init__(
+        self,
+        tenant_id: str,
+        nodepools: list,
+        provider,
+        view,
+        solver: TPUScheduler,
+        cluster=None,
+        kube_client=None,
+        latency: Optional[DecisionLatencyTracker] = None,
+    ):
+        self.tenant_id = tenant_id
+        self.nodepools = list(nodepools)
+        self.provider = provider  # the tenant's own provider
+        self.view = view  # what the solver actually reads (catalog view)
+        self.solver = solver
+        self.cluster = cluster
+        self.kube_client = kube_client
+        self.latency = latency or DecisionLatencyTracker()
+        self.added_at = time.time()
+        # round accounting (registry lock)
+        self.solves = 0
+        self.pods_solved = 0
+        self.last_error: Optional[str] = None
+
+    def summary(self) -> dict:
+        return {
+            "tenant": self.tenant_id,
+            "nodepools": [np_.metadata.name for np_ in self.nodepools],
+            "solves": self.solves,
+            "pods_solved": self.pods_solved,
+            "pending": self.latency.pending_count(),
+            "decided": self.latency.decided_count(),
+            "last_error": self.last_error,
+        }
+
+
+class FleetRegistry:
+    """Thread-safe tenant directory; add/remove are steady-state
+    operations (the fleet scheduler keeps running through them)."""
+
+    def __init__(self, plane=None, metrics=None):
+        from .megasolve import CatalogPlane
+
+        self._mu = threading.RLock()
+        self._tenants: Dict[str, TenantHandle] = {}
+        # object-identity ledgers backing the no-sharing invariant
+        self._provider_owner: Dict[int, str] = {}
+        self._cluster_owner: Dict[int, str] = {}
+        self.plane = plane or CatalogPlane()
+        self.metrics = metrics
+        self.generation = 0  # bumped by add/remove (debug/round snapshots)
+
+    # -- membership ---------------------------------------------------------
+
+    def add_tenant(
+        self,
+        tenant_id: str,
+        nodepools: list,
+        provider,
+        cluster=None,
+        kube_client=None,
+    ) -> TenantHandle:
+        from .megasolve import TenantCatalogView
+
+        tenant_id = str(tenant_id)
+        with self._mu:
+            if tenant_id in self._tenants:
+                raise ValueError(f"tenant {tenant_id!r} already registered")
+            owner = self._provider_owner.get(id(provider))
+            if owner is not None:
+                raise ValueError(
+                    f"cloud provider already registered to tenant {owner!r} — "
+                    "tenants must not share provider objects (per-object "
+                    "generation counters would alias their caches)"
+                )
+            if cluster is not None:
+                c_owner = self._cluster_owner.get(id(cluster))
+                if c_owner is not None:
+                    raise ValueError(
+                        f"cluster already registered to tenant {c_owner!r} — "
+                        "tenants must not share cluster state"
+                    )
+            view = TenantCatalogView(provider, self.plane, tenant_id)
+            solver = TPUScheduler(
+                nodepools,
+                view,
+                kube_client=kube_client,
+                cluster=cluster,
+                tenant=tenant_id,
+            )
+            # one pinned WarmState per tenant: isolation plus a cache
+            # home that cannot be evicted by other tenants' churn (the
+            # global registry is a small LRU sized for single-tenant
+            # processes)
+            solver.warm_state_pin = WarmState(view)
+            handle = TenantHandle(
+                tenant_id,
+                nodepools,
+                provider,
+                view,
+                solver,
+                cluster=cluster,
+                kube_client=kube_client,
+            )
+            self._tenants[tenant_id] = handle
+            self._provider_owner[id(provider)] = tenant_id
+            if cluster is not None:
+                self._cluster_owner[id(cluster)] = tenant_id
+            self.generation += 1
+            # admission pays the tenant's catalog fingerprints (once per
+            # catalog generation), keeping its first round's timeline
+            # clean — see CatalogPlane.prewarm
+            self.plane.prewarm(tenant_id, provider, nodepools)
+            return handle
+
+    def remove_tenant(self, tenant_id: str) -> bool:
+        """Drop a tenant and its pinned caches. Safe during steady
+        state: an in-flight round that already holds the handle finishes
+        its solve; subsequent rounds no longer see the tenant."""
+        with self._mu:
+            handle = self._tenants.pop(str(tenant_id), None)
+            if handle is None:
+                return False
+            self._provider_owner.pop(id(handle.provider), None)
+            if handle.cluster is not None:
+                self._cluster_owner.pop(id(handle.cluster), None)
+            self.generation += 1
+            return True
+
+    # -- lookup -------------------------------------------------------------
+
+    def get(self, tenant_id: str) -> Optional[TenantHandle]:
+        with self._mu:
+            return self._tenants.get(str(tenant_id))
+
+    def tenant_ids(self) -> List[str]:
+        with self._mu:
+            return sorted(self._tenants)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._tenants)
+
+    def record_solve(self, tenant_id: str, pods: int, error: Optional[str] = None) -> None:
+        with self._mu:
+            handle = self._tenants.get(tenant_id)
+            if handle is None:
+                return
+            handle.solves += 1
+            handle.pods_solved += pods
+            handle.last_error = error
+
+    def debug_state(self) -> dict:
+        with self._mu:
+            return {
+                "generation": self.generation,
+                "tenants": [h.summary() for _, h in sorted(self._tenants.items())],
+            }

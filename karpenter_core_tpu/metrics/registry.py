@@ -278,6 +278,41 @@ class Metrics:
             "Serving pipeline stage-queue depth (caps are env-tunable, KARPENTER_TPU_SERVING_*_CAP)",
             ["stage"],
         )
+        # fleet solver (fleet/): per-tenant solve traffic (tenant label
+        # cardinality-capped, KARPENTER_TPU_FLEET_TENANT_LABELS — excess
+        # tenants collapse to "_other"), mega-dispatch shape, and the
+        # deficit-round-robin fairness pressure
+        self.fleet_solves = r.counter(
+            f"{ns}_tpu_fleet_solves_total",
+            "Per-tenant fleet solves, by engine (batched | solo); tenant label capped",
+            ["tenant", "engine"],
+        )
+        self.fleet_pods = r.counter(
+            f"{ns}_tpu_fleet_pods_total",
+            "Pods decided per tenant by the fleet engine; tenant label capped",
+            ["tenant"],
+        )
+        self.fleet_batch_occupancy = r.gauge(
+            f"{ns}_tpu_fleet_batch_occupancy",
+            "Tenant pack calls coalesced into the last mega-dispatch flush",
+        )
+        self.fleet_padding_waste = r.gauge(
+            f"{ns}_tpu_fleet_padding_waste",
+            "Padded pod-slot fraction wasted by the last round's mega-dispatch size classes",
+        )
+        self.fleet_fairness_deficit = r.gauge(
+            f"{ns}_tpu_fleet_fairness_deficit",
+            "Largest per-tenant deficit-round-robin backlog credit after the last round",
+        )
+        self.fleet_decision_latency = r.histogram(
+            f"{ns}_tpu_fleet_decision_latency_seconds",
+            "Fleet pod-pending to plan-emitted decision latency, all tenants",
+        )
+        self.fleet_round_duration = r.histogram(
+            f"{ns}_tpu_fleet_round_duration_seconds",
+            "Fleet round wall time, by engine",
+            labels=["engine"],
+        )
         # node/nodepool/pod scrapers (metrics/{node,nodepool,pod})
         self.node_allocatable = r.gauge(f"{ns}_nodes_allocatable", "Node allocatable", ["node", "resource"])
         self.node_pod_requests = r.gauge(f"{ns}_nodes_total_pod_requests", "Node pod requests", ["node", "resource"])

@@ -146,6 +146,7 @@ class OperationalServer:
         enable_profiling: bool = False,
         logger=None,
         serving_state: Optional[Callable[[], dict]] = None,
+        fleet_state: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.ready_check = ready_check
@@ -155,6 +156,9 @@ class OperationalServer:
         self.logger = logger
         # serving-pipeline introspection hook (ServingPipeline.debug_state)
         self.serving_state = serving_state
+        # fleet introspection hook (FleetEngine/FleetScheduler state:
+        # registry, last batch composition, DRR deficits)
+        self.fleet_state = fleet_state
         self._metrics_server: Optional[_Server] = None
         self._probe_server: Optional[_Server] = None
 
@@ -183,6 +187,19 @@ class OperationalServer:
             payload = json.dumps(self.serving_state(), default=str)
         except Exception as err:  # noqa: BLE001 — a debug route must not 500 the server
             return 500, "text/plain", f"serving state unavailable: {err}\n"
+        return 200, "application/json", payload
+
+    def _fleet(self, _query) -> Tuple[int, str, str]:
+        """Fleet state: tenant registry, last mega-solve round
+        composition, dispatcher coalescing stats, DRR deficits."""
+        import json
+
+        if self.fleet_state is None:
+            return 404, "text/plain", "fleet solver not running\n"
+        try:
+            payload = json.dumps(self.fleet_state(), default=str)
+        except Exception as err:  # noqa: BLE001 — a debug route must not 500 the server
+            return 500, "text/plain", f"fleet state unavailable: {err}\n"
         return 200, "application/json", payload
 
     # -- lifecycle ----------------------------------------------------------
@@ -218,6 +235,8 @@ class OperationalServer:
         }
         if self.serving_state is not None:
             metrics_routes["/debug/serving"] = self._serving
+        if self.fleet_state is not None:
+            metrics_routes["/debug/fleet"] = self._fleet
         if self.enable_profiling:
             metrics_routes["/debug/pprof/"] = _stack_dump
             metrics_routes["/debug/pprof/profile"] = _collapsed_profile

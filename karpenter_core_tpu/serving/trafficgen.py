@@ -27,6 +27,7 @@ Two drive modes:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -320,6 +321,27 @@ def _catalog(n_types: int) -> List:
     return cat
 
 
+def materialize_spec(spec: PodSpecLite) -> Pod:
+    """A pending Pod from one scenario spec (shared by the serving
+    harness and the fleet driver)."""
+    pod = Pod()
+    pod.metadata.name = spec.name
+    pod.metadata.labels = {"team": f"t{spec.team}"}
+    requests = {"cpu": parse_quantity(spec.cpu), "memory": parse_quantity(spec.mem)}
+    if spec.gpu:
+        requests["nvidia.com/gpu"] = parse_quantity(spec.gpu)
+    pod.spec = PodSpec(
+        node_selector={"team": f"t{spec.team}"},
+        containers=[
+            Container(name="main", resources=ResourceRequirements(requests=requests))
+        ],
+    )
+    pod.status.conditions = [
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    ]
+    return pod
+
+
 class TrafficHarness:
     """One self-contained serving world. Create one per run — plan
     identity is compared across runs, so runs must not share mutable
@@ -362,22 +384,7 @@ class TrafficHarness:
     # -- injection ----------------------------------------------------------
 
     def _materialize(self, spec: PodSpecLite) -> Pod:
-        pod = Pod()
-        pod.metadata.name = spec.name
-        pod.metadata.labels = {"team": f"t{spec.team}"}
-        requests = {"cpu": parse_quantity(spec.cpu), "memory": parse_quantity(spec.mem)}
-        if spec.gpu:
-            requests["nvidia.com/gpu"] = parse_quantity(spec.gpu)
-        pod.spec = PodSpec(
-            node_selector={"team": f"t{spec.team}"},
-            containers=[
-                Container(name="main", resources=ResourceRequirements(requests=requests))
-            ],
-        )
-        pod.status.conditions = [
-            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
-        ]
-        return pod
+        return materialize_spec(spec)
 
     def inject_step(self, step: Step, step_index: int) -> None:
         """Apply one scenario step to the kube store (deletes/evictions
@@ -717,6 +724,142 @@ def run_free(
 
 
 # ---------------------------------------------------------------------------
+# fleet driver: N independent scenario streams against one device
+# (fleet/ — ISSUE 9). Each tenant gets its own provider/catalog archetype
+# and its own seeded scenario; steps are injected fleet-wide and decided
+# through the FleetScheduler's DRR rounds.
+
+
+def _fleet_plan_key(plan) -> tuple:
+    """Content identity of one NodePlan (the engine-parity projection:
+    object identities differ across engines by design — the batched
+    engine emits from canonical catalog snapshots)."""
+    return (
+        plan.nodepool_name,
+        plan.instance_type.name,
+        plan.zone,
+        plan.capacity_type,
+        round(plan.price, 9),
+        tuple(plan.pod_indices),
+        plan.max_pods_per_node,
+    )
+
+
+def run_fleet_measurement(
+    n_tenants: int = 8,
+    scenario: str = "rollout",
+    scale: int = 200,
+    engine: str = "batched",
+    seed: int = 7,
+    catalog_sizes: Tuple[int, ...] = (16, 48, 96),
+    quantum: Optional[int] = None,
+) -> dict:
+    """One fleet drive: ``n_tenants`` independent ``scenario`` streams
+    (per-tenant seeds and catalog archetypes) through one FleetScheduler
+    on the chosen engine → plain-JSON summary with the aggregate
+    throughput, per-tenant decision-latency SLO, the mega-dispatch
+    coalescing stats, and a content hash of every tenant's plan stream
+    (equal across engines ⇔ plan identity)."""
+    import hashlib
+
+    from ..fleet import FleetEngine, FleetRegistry, FleetScheduler
+    from .latency import percentiles_ms
+
+    os.environ["KARPENTER_TPU_FLEET_ENGINE"] = engine
+    # the catalog entry cache must hold the whole fleet's archetypes
+    # (both engines get the same headroom)
+    os.environ.setdefault("KARPENTER_TPU_CATALOG_CACHE_MAX", str(2 * n_tenants + 16))
+    registry = FleetRegistry()
+    fleet = FleetEngine(registry)
+    sched = FleetScheduler(fleet, quantum=quantum)
+
+    scenarios = []
+    for t in range(n_tenants):
+        tid = f"tenant-{t:03d}"
+        sc = build_scenario(scenario, scale=scale, seed=seed + 17 * t)
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog(catalog_sizes[t % len(catalog_sizes)])
+        provider.bump_catalog_generation()
+        nodepool = NodePool()
+        nodepool.metadata.name = "default"
+        nodepool.spec.template.requirements = [
+            NodeSelectorRequirement("team", "In", [f"t{k}" for k in range(sc.teams)])
+        ]
+        registry.add_tenant(tid, [nodepool], provider)
+        scenarios.append((tid, sc, provider, nodepool))
+
+    plan_log: List[tuple] = []
+    round_dispatch = {"flushes": 0, "pack_calls": 0, "jobs": 0, "max_occupancy": 0}
+
+    injected = 0
+    rounds = 0
+    t0 = time.perf_counter()
+    n_steps = max(len(sc.steps) for _, sc, _, _ in scenarios)
+    for si in range(n_steps):
+        for tid, sc, provider, nodepool in scenarios:
+            if si >= len(sc.steps):
+                continue
+            step = sc.steps[si]
+            if step.mutate_catalog:
+                its = provider.get_instance_types(nodepool)
+                for it in its[:: max(1, len(its) // 16)]:
+                    for o in it.offerings:
+                        o.price *= 1.01
+                provider.bump_catalog_generation()
+            if step.creates:
+                pods = [materialize_spec(s) for s in step.creates]
+                injected += len(pods)
+                sched.submit(tid, pods)
+        while sched.queued():
+            outcomes = sched.run_round()
+            rounds += 1
+            d = fleet.last_round.get("dispatch") or {}
+            for k in ("flushes", "pack_calls", "jobs"):
+                round_dispatch[k] += d.get(k, 0)
+            round_dispatch["max_occupancy"] = max(
+                round_dispatch["max_occupancy"], d.get("max_occupancy", 0)
+            )
+            for tid in sorted(outcomes):
+                o = outcomes[tid]
+                if o.error is None:
+                    plan_log.append(
+                        (rounds, tid, tuple(sorted(_fleet_plan_key(p) for p in o.result.node_plans)))
+                    )
+                else:
+                    plan_log.append((rounds, tid, ("error", o.error)))
+    wall = time.perf_counter() - t0
+
+    samples: List[float] = []
+    decided = errors = 0
+    per_tenant = {}
+    for tid, _sc, _p, _np in scenarios:
+        tracker = registry.get(tid).latency
+        ms = tracker.samples_ms()
+        samples.extend(ms)
+        decided += tracker.decided_count()
+        errors += sum(1 for s in tracker.decisions() if s[4])
+        if len(per_tenant) < 4:
+            per_tenant[tid] = percentiles_ms(ms)
+    return {
+        "engine": engine,
+        "scenario": scenario,
+        "tenants": n_tenants,
+        "scale": scale,
+        "rounds": rounds,
+        "pods_injected": injected,
+        "pods_decided": decided,
+        "decision_errors": errors,
+        "wall_s": round(wall, 4),
+        "pods_per_sec": round(decided / wall, 1) if wall else 0.0,
+        "decision_latency_ms": percentiles_ms(samples),
+        "per_tenant_latency_ms": per_tenant,
+        "dispatch": round_dispatch,
+        "plan_sha256": hashlib.sha256(repr(plan_log).encode()).hexdigest(),
+        "scheduler": sched.debug_state(),
+    }
+
+
+# ---------------------------------------------------------------------------
 # CLI: one measurement per process. Bench config 8 shells out here so
 # every (scenario, mode) pair runs with a fresh process-wide state —
 # XLA compile cache included — the pyperf discipline: whichever mode
@@ -798,7 +941,22 @@ def _cli(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--idle", type=float, default=0.02, help="batch window idle seconds")
     ap.add_argument("--max", dest="max_s", type=float, default=0.5, help="batch window max seconds")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: drive N independent tenant streams of "
+                         "--scenario through the fleet scheduler")
+    ap.add_argument("--engine", default="batched", choices=("batched", "solo"),
+                    help="fleet engine (with --fleet)")
     args = ap.parse_args(argv)
+    if args.fleet:
+        out = run_fleet_measurement(
+            n_tenants=args.fleet,
+            scenario=args.scenario,
+            scale=args.scale,
+            engine=args.engine,
+            seed=args.seed if args.seed is not None else 7,
+        )
+        print(json.dumps(out), flush=True)
+        return 0
     out = run_measurement(
         args.scenario,
         args.mode,

@@ -160,6 +160,18 @@ class AutoBackend(PackBackend):
 
 _BACKENDS: dict = {}
 
+# per-thread backend override (fleet/megasolve.py): each tenant solve
+# thread of a batched fleet round installs a coalescing facade here so
+# its pack calls join the fleet-wide mega-dispatch instead of going to
+# the process-global singleton directly. Thread-local by construction —
+# a tenant thread can never see (or clobber) another thread's override.
+_TLS = threading.local()
+
+
+def set_thread_backend(backend: Optional[PackBackend]) -> None:
+    """Install (or with None, clear) this thread's backend override."""
+    _TLS.override = backend
+
 
 def get_backend(name: str) -> PackBackend:
     """Process-global backend singletons (the LP backend's relaxation
@@ -183,8 +195,12 @@ def get_backend(name: str) -> PackBackend:
 
 def active_backend() -> PackBackend:
     """The per-solve backend selection (env read each solve, PR-2
-    engine-switch pattern). Unknown names fall back to ffd — a typo in
-    an env var must degrade, not fail solves."""
+    engine-switch pattern). A thread-local override (fleet mega-solve)
+    wins over the env. Unknown names fall back to ffd — a typo in an
+    env var must degrade, not fail solves."""
+    override = getattr(_TLS, "override", None)
+    if override is not None:
+        return override
     name = os.environ.get("KARPENTER_TPU_PACK_BACKEND", "ffd").strip().lower()
     try:
         return get_backend(name or "ffd")

@@ -79,6 +79,13 @@ _CAPS = {
     # candidate set, negative drain verdicts per drained subset
     "disruptbounds": ("KARPENTER_TPU_DISRUPT_BOUNDS_CACHE_MAX", 64),
     "disruptverify": ("KARPENTER_TPU_DISRUPT_VERIFY_CACHE_MAX", 4096),
+    # fleet mega-solve memos (fleet/megasolve.py): per-tenant catalog
+    # content fingerprints keyed by trusted generation, canonical
+    # catalog snapshots keyed by content, and the fleet-wide content
+    # plane of job skeletons
+    "fleetenv": ("KARPENTER_TPU_FLEET_ENV_CACHE_MAX", 1024),
+    "fleetcanon": ("KARPENTER_TPU_FLEET_CANON_CACHE_MAX", 64),
+    "fleetjob": ("KARPENTER_TPU_FLEET_JOB_CACHE_MAX", 2048),
 }
 _INTERSECTS_MAX = 4096  # content-addressed; clearing only costs re-derivation
 
@@ -406,13 +413,25 @@ _STATES_MAX = 4
 
 def warm_state_for(solver) -> Optional[WarmState]:
     """The WarmState for this solver's cloud provider (None when the
-    incremental path is disabled or there is no provider to key on)."""
+    incremental path is disabled or there is no provider to key on).
+
+    Tenant isolation (fleet/registry.py): the key carries the solver's
+    tenant scope, so two tenants can never resolve to one WarmState even
+    when they share a provider object — the seed cache's generation
+    guard and the replay snapshot are identity-scoped and would alias
+    across clusters otherwise. A fleet registry additionally PINS one
+    WarmState per tenant solver (``warm_state_pin``), which both skips
+    the global LRU and keeps a large fleet from thrashing its
+    ``_STATES_MAX`` bound."""
     if not enabled():
         return None
     provider = solver.cloud_provider
     if provider is None:
         return None
-    key = id(provider)
+    pin = getattr(solver, "warm_state_pin", None)
+    if pin is not None and pin.provider is provider:
+        return pin
+    key = (id(provider), getattr(solver, "_tenant_scope", ()))
     with _STATES_LOCK:
         st = _STATES.get(key)
         if st is None or st.provider is not provider:

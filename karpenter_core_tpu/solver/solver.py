@@ -583,6 +583,7 @@ class TPUScheduler:
         cluster=None,
         recorder=None,
         metrics=None,
+        tenant=None,
     ):
         self.nodepools = order_by_weight(
             [np_ for np_ in nodepools if np_.metadata.deletion_timestamp is None]
@@ -619,6 +620,18 @@ class TPUScheduler:
         self._postpass_matrix = None
         self._postpass_remaining: Optional[Dict[str, dict]] = None
         self._sim_drained: Optional[tuple] = None
+        # fleet tenancy (fleet/registry.py): a non-empty scope isolates
+        # every identity/generation-scoped cross-solve memo this solver
+        # touches — the warm state it resolves to, the topology seed
+        # keys, the job memo keys. Generation counters (cluster,
+        # catalog) are per-object, not global: two tenants' counters at
+        # equal values must never let their cached results alias.
+        self._tenant_scope: tuple = ("tenant", str(tenant)) if tenant is not None else ()
+        # fleet content plane (fleet/megasolve.py): when the batched
+        # fleet engine installs it, job skeletons are additionally
+        # shared fleet-wide under the tenant-free CONTENT prefix of the
+        # job key (see _pack_and_finalize)
+        self.fleet_plane = None
 
     # ------------------------------------------------------------------
 
@@ -2461,8 +2474,14 @@ class TPUScheduler:
             if ws is not None and gen is not None:
                 # the drained-node delta keeps a disruption simulation's
                 # seed counts from aliasing the undrained solve's (and
-                # different drain subsets from aliasing each other)
-                skey = key + (self._seed_exclusion_key(), self._sim_drained)
+                # different drain subsets from aliasing each other); the
+                # tenant scope keeps one tenant's counts from aliasing
+                # another's — the generation guard below is a PER-CLUSTER
+                # counter, so equal generations from different tenants'
+                # clusters witness nothing about each other
+                skey = key + (
+                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope
+                )
                 seeds = ws.seeds_get(skey, gen, self._cstats)
             if seeds is None:
                 with tracer.span("pack.spread_seeds"):
@@ -3824,6 +3843,7 @@ class TPUScheduler:
         # between ticks can never alias cached skeletons.
         backend = backends_mod.active_backend()
         ws = self._warm
+        plane = self.fleet_plane
         keys: List[Optional[tuple]] = [None] * len(jobs)
         skels: List[Optional[incremental.JobSkeleton]] = [None] * len(jobs)
         if ws is not None and jobs:
@@ -3833,6 +3853,15 @@ class TPUScheduler:
                     keys[i] = key
                     if key is not None:
                         skels[i] = ws.jobs.get(key, self._cstats)
+                        if skels[i] is None and plane is not None:
+                            # fleet content plane: the key minus its
+                            # trailing tenant scope is pure content
+                            # (catalog entry identity+fingerprint, pool
+                            # fingerprint, request digest, every mask,
+                            # engine+backend tokens), so a skeleton
+                            # another tenant computed for the identical
+                            # content IS this job's skeleton
+                            skels[i] = plane.skeleton_get(key[:-1], self._cstats)
         miss = [i for i in range(len(jobs)) if skels[i] is None]
         # the backends' meta contract, enumerated field by field: this
         # is every meta input a backend may read (backends/__init__.py),
@@ -3902,6 +3931,16 @@ class TPUScheduler:
                         # — every constituent is in the key
                         # analysis: allow-cache-key(metas.reqs, metas.alloc)
                         ws.jobs.put(keys[i], skel, self._cstats)
+                        if plane is not None:
+                            # content-plane publish under the tenant-free
+                            # content prefix (same witness argument as
+                            # the put above; the dropped tenant scope is
+                            # not in the computation's read-set — the
+                            # skeleton is a pure function of the keyed
+                            # content, which is what makes cross-tenant
+                            # sharing memoization, not approximation)
+                            # analysis: allow-cache-key(metas.reqs, metas.alloc)
+                            plane.skeleton_put(keys[i][:-1], skel, self._cstats)
                 self._emit_skeleton(
                     meta, skel, keys[i], pods, result, records, merge_all
                 )
@@ -3967,6 +4006,12 @@ class TPUScheduler:
             # two backends may produce different partitions for equal
             # inputs, so their skeletons must never alias
             backend.job_token() if backend is not None else ("ffd",),
+            # tenant scope LAST, by contract: everything before it is
+            # pure content (the fleet content plane shares skeletons
+            # across tenants under key[:-1]); the scope itself is
+            # isolation defense-in-depth on top of the per-tenant warm
+            # state (incremental.warm_state_for)
+            self._tenant_scope,
         )
 
     def _job_skeleton(

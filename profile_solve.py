@@ -4,11 +4,18 @@ Usage: python profile_solve.py [pods] [types] [--ticks N] [--churn RATE]
        python profile_solve.py --stream SCENARIO [--scale N] [--pace S]
        python profile_solve.py --disrupt [--nodes N] [--pods-per-node K]
        python profile_solve.py [pods] [types] --backend {ffd,lp,auto}
+       python profile_solve.py --fleet N [--tenant-pods K --engine {batched,solo}]
 
 With --backend, the solve runs under that pack backend
 (KARPENTER_TPU_PACK_BACKEND; solver/backends/) — lp additionally prints
 the plan cost, the LP relaxation lower bound, and the optimality gap,
 so either backend can be profiled off-TPU with BENCH_BACKEND=cpu.
+
+With --fleet N, profiles one fleet mega-solve round over N tenants
+(fleet/megasolve.py; bench.fleet_env's mixed catalog archetypes) —
+burst timing, the profiled warm round, the mega-dispatch coalescing
+stats, and the skeleton-plane size; --engine solo profiles the
+per-tenant oracle path instead.
 
 With --disrupt, builds the config-9 consolidation fleet (bench.py
 disrupt_fleet: N nodes, N*K bound pods, 5% budget), runs one cold
@@ -77,12 +84,19 @@ def _parse_args():
     ap.add_argument("--pods-per-node", type=int, default=100,
                     help="bound pods per node (with --disrupt)")
     ap.add_argument("--engine", default="batched",
-                    choices=("batched", "sequential"),
-                    help="disruption engine to profile (with --disrupt)")
+                    choices=("batched", "sequential", "solo"),
+                    help="engine to profile: batched|sequential with "
+                         "--disrupt, batched|solo with --fleet")
     ap.add_argument("--backend", default=None, choices=("ffd", "lp", "auto"),
                     help="pack backend to profile (KARPENTER_TPU_PACK_BACKEND;"
                          " solver/backends/ — lp reports plan cost, the"
                          " relaxation bound, and the optimality gap)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: profile a mega-solve round over N "
+                         "tenants (fleet/megasolve.py; mixed catalog "
+                         "archetypes from bench.fleet_env)")
+    ap.add_argument("--tenant-pods", type=int, default=200,
+                    help="pods per tenant per round (with --fleet)")
     return ap.parse_args()
 
 
@@ -100,6 +114,9 @@ def main():
         return
     if args.disrupt:
         _disrupt_mode(args)
+        return
+    if args.fleet:
+        _fleet_mode(args)
         return
 
     from karpenter_core_tpu.apis import labels as wk
@@ -185,6 +202,57 @@ def main():
     pr.enable()
     solver.solve(pods)
     pr.disable()
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue())
+
+
+def _fleet_mode(args):
+    """--fleet N: profile one mega-solve round over N tenants — the
+    fleet path bench config 11 measures (fleet/megasolve.py; mixed
+    catalog archetypes, content twins, admission-time prewarm). Warm
+    round first (canonical entries + compile), then cProfile of a fresh
+    round. Worker threads opt into the shared profiler like --stream's
+    stage threads; off-TPU use BENCH_BACKEND=cpu."""
+    import threading
+
+    engine_name = "solo" if args.engine == "solo" else "batched"
+    os.environ["KARPENTER_TPU_FLEET_ENGINE"] = engine_name
+    registry, engine, tenants = bench.fleet_env(args.fleet)
+    t0 = time.perf_counter()
+    engine.solve_round(bench.fleet_work(tenants, args.tenant_pods, 0))
+    print(
+        f"burst round ({args.fleet} tenants x {args.tenant_pods} pods, "
+        f"{engine_name}): {(time.perf_counter()-t0)*1000:.1f} ms",
+        file=sys.stderr,
+    )
+    work = bench.fleet_work(tenants, args.tenant_pods, 1)
+    pr = cProfile.Profile()
+
+    def _enable_for_worker_threads(*_a):
+        # each fleet worker thread turns the shared profiler on for
+        # itself at its first call event (the --stream pattern); XLA
+        # pool threads stay unprofiled
+        if threading.current_thread().name.startswith("fleet-worker-"):
+            threading.setprofile(None)
+            pr.enable()
+
+    threading.setprofile(_enable_for_worker_threads)
+    pr.enable()
+    t0 = time.perf_counter()
+    outcomes = engine.solve_round(work)
+    dt = time.perf_counter() - t0
+    pr.disable()
+    threading.setprofile(None)
+    errors = {t: o.error for t, o in outcomes.items() if o.error}
+    print(
+        f"profiled round: {dt*1000:.1f} ms, "
+        f"{sum(o.pods for o in outcomes.values())} pods, errors={errors or 'none'}",
+        file=sys.stderr,
+    )
+    print(f"dispatch: {engine.last_round.get('dispatch')}", file=sys.stderr)
+    print(f"skeleton plane: {len(engine.skeletons)} entries", file=sys.stderr)
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)

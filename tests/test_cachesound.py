@@ -116,7 +116,27 @@ def test_cache_key_split_site_drift(tmp_path):
 
 
 def test_cache_key_generation_guard_witnesses(tmp_path):
-    # the seeds_get/seeds_put accessor pair carries an explicit guard arg
+    # the seeds_get/seeds_put accessor pair carries an explicit guard
+    # arg; the key carries the tenant scope (generation counters are
+    # per-cluster — ISSUE 9 tenant-witness check)
+    code = """
+        class Solver:
+            def seeds(self, ws, constraint, stats):
+                gen = self._cluster_gen
+                key = (constraint.topology_key, self._tenant_scope)
+                v = ws.seeds_get(key, gen, stats)
+                if v is None:
+                    v = count(constraint)
+                    ws.seeds_put(key, gen, v, stats)
+                return v
+    """
+    assert run_snippet(tmp_path, code).findings == []
+
+
+def test_cache_key_seeds_requires_tenant_scope(tmp_path):
+    # a seed key WITHOUT the tenant scope aliases across tenants whose
+    # cluster generations happen to be equal — flagged even though the
+    # generation guard is present
     code = """
         class Solver:
             def seeds(self, ws, constraint, stats):
@@ -128,7 +148,8 @@ def test_cache_key_generation_guard_witnesses(tmp_path):
                     ws.seeds_put(key, gen, v, stats)
                 return v
     """
-    assert run_snippet(tmp_path, code).findings == []
+    report = run_snippet(tmp_path, code)
+    assert [f for f in report.findings if "tenant" in f.message]
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +430,8 @@ _MUT_FILES = [
     "karpenter_core_tpu/disruption/engine.py",
     "karpenter_core_tpu/solver/backends/__init__.py",
     "karpenter_core_tpu/solver/backends/lp.py",
+    "karpenter_core_tpu/fleet/registry.py",
+    "karpenter_core_tpu/fleet/megasolve.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -427,8 +450,8 @@ _MUTANTS = [
      "trail = trails[ci] if trails is not None else None",
      "trail = ci if trails is not None else None", "cache-key"),
     ("seed-key-drop-exclusion", "karpenter_core_tpu/solver/solver.py",
-     "skey = key + (self._seed_exclusion_key(), self._sim_drained)",
-     "skey = key + (self._sim_drained,)", "cache-key"),
+     "skey = key + (\n                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope\n                )",
+     "skey = key + (self._sim_drained, self._tenant_scope)", "cache-key"),
     ("compat-key-drop-poolfp", "karpenter_core_tpu/solver/solver.py",
      "(pool_fp, sid),", "(sid,),", "cache-key"),
     ("mergerow-key-drop-rkey", "karpenter_core_tpu/solver/merge.py",
@@ -504,6 +527,22 @@ _MUTANTS = [
     ("lprelax-key-drop-pricefp", "karpenter_core_tpu/solver/backends/lp.py",
      "            alloc.tobytes(),\n            prices.tobytes(),\n",
      "            alloc.tobytes(),\n", "cache-key"),
+    # ISSUE 9: fleet multi-tenancy. The mega-solve envelope memo maps a
+    # tenant's (pool, provider generation) to its catalog content
+    # fingerprint — generations are PER-PROVIDER counters, so dropping
+    # the tenant id would alias two tenants' catalogs at equal counter
+    # values. Same shape for the topology seed cache: its generation
+    # guard is a PER-CLUSTER counter, so the key must witness the
+    # solver's tenant scope (both held by the cache-key tenant-witness
+    # check; the fleet job-skeleton plane is deliberately tenant-FREE —
+    # its key is pure content, the soundness argument lives at the
+    # solver's skeleton_put site).
+    ("fleetenv-key-drop-tenant", "karpenter_core_tpu/fleet/megasolve.py",
+     "key = (tenant_id, pool_name, gen)",
+     "key = (pool_name, gen)", "cache-key"),
+    ("seed-key-drop-tenantscope", "karpenter_core_tpu/solver/solver.py",
+     "skey = key + (\n                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope\n                )",
+     "skey = key + (self._seed_exclusion_key(), self._sim_drained)", "cache-key"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -516,6 +555,9 @@ _MANDATORY = {
     "verdict-key-drop-subset", "bounds-key-drop-candidates",
     # ISSUE 8 acceptance: the LP relax memo's budget + price-table keys
     "lprelax-key-drop-iters", "lprelax-key-drop-pricefp",
+    # ISSUE 9 acceptance: no cross-tenant cache aliasing — the mega-solve
+    # envelope memo and the seed cache must witness the tenant
+    "fleetenv-key-drop-tenant", "seed-key-drop-tenantscope",
 }
 
 

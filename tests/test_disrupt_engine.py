@@ -411,7 +411,9 @@ class TestSimDrainedDelta:
             solver.solve(pods, sim_drained=("fake:///node-b",))
             solver.solve(pods)  # live solve: delta component is None
             assert len(keys) >= 3
-            deltas = {k[-1] for k in keys}
+            # the sim_drained delta sits before the trailing tenant
+            # scope (ISSUE 9: the seed key ends with _tenant_scope)
+            deltas = {k[-2] for k in keys}
             assert ("fake:///node-a",) in deltas
             assert ("fake:///node-b",) in deltas
             assert None in deltas  # the undrained solve never aliases

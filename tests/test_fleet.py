@@ -1,0 +1,442 @@
+"""Fleet solver (ISSUE 9): batched-vs-solo plan identity, tenant
+isolation, DRR fairness, admission backpressure, steady-state
+membership churn, mega-dispatch coalescing, and the operational
+surface."""
+
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.apis.nodepool import NodePool
+from karpenter_core_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+    new_instance_type,
+)
+from karpenter_core_tpu.fleet import (
+    FleetEngine,
+    FleetRegistry,
+    FleetScheduler,
+    fleet_engine_name,
+)
+from karpenter_core_tpu.metrics import Metrics
+from karpenter_core_tpu.solver import incremental
+
+from helpers import make_pod, plan_key
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    incremental.reset()
+    monkeypatch.setenv("KARPENTER_TPU_CATALOG_CACHE_MAX", "64")
+    yield
+    incremental.reset()
+
+
+def _engine(mode, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_ENGINE", mode)
+
+
+def _catalog(kind: str, n: int):
+    """Catalog archetypes with different vocab footprints: the plain
+    generator, a gpu-extended menu (extra resource axis + zones), and a
+    narrow two-type menu."""
+    if kind == "plain":
+        return instance_types(n)
+    if kind == "gpu":
+        cat = instance_types(max(n - 4, 2))
+        for g in range(4):
+            cat.append(
+                new_instance_type(
+                    f"gpu-{g}",
+                    {"cpu": str(8 * (g + 1)), "memory": f"{16 * (g + 1)}Gi",
+                     "pods": "110", "nvidia.com/gpu": str(g + 1)},
+                )
+            )
+        return cat
+    return [
+        new_instance_type("tiny", {"cpu": "2", "memory": "4Gi", "pods": "32"}),
+        new_instance_type("big", {"cpu": "32", "memory": "128Gi", "pods": "110"}),
+    ]
+
+
+def _pods(tid: str, n: int, seed: int, gpu_frac: float = 0.0):
+    rng = np.random.RandomState(seed)
+    pods = []
+    for i in range(n):
+        req = {
+            "cpu": ["100m", "250m", "500m", "1", "2"][rng.randint(5)],
+            "memory": ["128Mi", "512Mi", "1Gi", "2Gi"][rng.randint(4)],
+        }
+        if gpu_frac and rng.rand() < gpu_frac:
+            req["nvidia.com/gpu"] = "1"
+        pods.append(make_pod(name=f"{tid}-p{i}", requests=req))
+    return pods
+
+
+def _add_tenant(reg, tid, catalog, pods_seed=0, n_pods=40, gpu_frac=0.0):
+    provider = FakeCloudProvider()
+    provider.instance_types = catalog
+    provider.bump_catalog_generation()
+    np_ = NodePool()
+    np_.metadata.name = "default"
+    reg.add_tenant(tid, [np_], provider)
+    return _pods(tid, n_pods, pods_seed, gpu_frac)
+
+
+def _plan_keys(outcome):
+    assert outcome.error is None, outcome.error
+    return sorted(plan_key(p) for p in outcome.result.node_plans)
+
+
+# ---------------------------------------------------------------------------
+# plan identity: batched == solo, per tenant, byte for byte
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_batched_vs_solo_plan_identity(seed, monkeypatch):
+    """N tenants with mixed catalog archetypes (different vocab sizes),
+    one of them mutating its catalog between rounds: every tenant's
+    batched plans equal its solo plans, every round."""
+
+    def run(mode):
+        _engine(mode, monkeypatch)
+        reg = FleetRegistry()
+        eng = FleetEngine(reg)
+        rng = np.random.RandomState(seed)
+        kinds = ["plain", "gpu", "narrow", "plain", "gpu"]
+        sizes = [12, 30, 2, 30, 18]
+        work = {}
+        for t, (kind, size) in enumerate(zip(kinds, sizes)):
+            tid = f"t{t}"
+            work[tid] = _add_tenant(
+                reg,
+                tid,
+                _catalog(kind, size),
+                pods_seed=seed * 100 + rng.randint(50),
+                n_pods=30 + 10 * t,
+                gpu_frac=0.2 if kind == "gpu" else 0.0,
+            )
+        rounds = []
+        # round 1: the provisioning burst
+        rounds.append({t: _plan_keys(o) for t, o in eng.solve_round(work).items()})
+        # mid-stream catalog mutation for tenant t1 (generation-correct)
+        h = reg.get("t1")
+        h.provider.set_instance_types(_catalog("plain", 8))
+        # round 2: fresh pods, t1 on its mutated catalog
+        work2 = {
+            tid: _pods(tid + "r2", 25, seed * 100 + 7 + i)
+            for i, tid in enumerate(sorted(work))
+        }
+        rounds.append({t: _plan_keys(o) for t, o in eng.solve_round(work2).items()})
+        return rounds
+
+    solo = run("solo")
+    batched = run("batched")
+    assert batched == solo
+
+
+# ---------------------------------------------------------------------------
+# isolation
+
+
+def test_tenant_churn_never_invalidates_neighbor_caches(monkeypatch):
+    """Tenant A's churn (catalog mutation + new pods) must not
+    invalidate tenant B's warm caches: B's next identical solve stays
+    warm (job-memo hits, no job misses)."""
+    _engine("batched", monkeypatch)
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    pods_a = _add_tenant(reg, "a", _catalog("plain", 20), pods_seed=1)
+    pods_b = _add_tenant(reg, "b", _catalog("gpu", 24), pods_seed=2)
+    eng.solve_round({"a": pods_a, "b": pods_b})
+
+    # A churns: catalog replaced, fresh workload solved twice
+    a = reg.get("a")
+    a.provider.set_instance_types(_catalog("plain", 11))
+    eng.solve_round({"a": _pods("a2", 60, 9)})
+    eng.solve_round({"a": _pods("a3", 60, 10)})
+
+    # B's content-identical re-solve (fresh pod objects, so the
+    # whole-solve replay stays out of the way and the job memo answers)
+    # is still fully warm
+    out = eng.solve_round({"b": _pods("b2", 40, 2)})
+    stats = reg.get("b").solver.last_cache_stats
+    assert out["b"].error is None
+    assert stats["hits"].get("job", 0) > 0
+    assert stats["misses"].get("job", 0) == 0
+
+
+def test_registry_rejects_shared_objects():
+    reg = FleetRegistry()
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(4)
+    np_ = NodePool()
+    np_.metadata.name = "default"
+    reg.add_tenant("a", [np_], provider)
+    with pytest.raises(ValueError, match="already registered"):
+        reg.add_tenant("b", [np_], provider)
+    with pytest.raises(ValueError, match="already registered"):
+        reg.add_tenant("a", [np_], FakeCloudProvider())
+
+
+def test_warm_states_are_tenant_scoped():
+    """Two solvers sharing one provider object but carrying different
+    tenant scopes resolve to different WarmStates (the seed cache's
+    generation guard is per-cluster — shared state would alias)."""
+    from karpenter_core_tpu.solver import TPUScheduler
+
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(4)
+    np_ = NodePool()
+    np_.metadata.name = "default"
+    s1 = TPUScheduler([np_], provider, tenant="a")
+    s2 = TPUScheduler([np_], provider, tenant="b")
+    s3 = TPUScheduler([np_], provider)
+    ws1 = incremental.warm_state_for(s1)
+    ws2 = incremental.warm_state_for(s2)
+    ws3 = incremental.warm_state_for(s3)
+    assert ws1 is not ws2 and ws1 is not ws3 and ws2 is not ws3
+    assert incremental.warm_state_for(s1) is ws1
+
+
+# ---------------------------------------------------------------------------
+# fairness + admission
+
+
+def test_drr_hog_tenant_cannot_starve_small_tenants(monkeypatch):
+    """A hog with a huge backlog drains at quantum-per-round while every
+    small tenant's whole backlog is admitted in its next round."""
+    _engine("batched", monkeypatch)
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    sched = FleetScheduler(eng, quantum=100)
+    hog_pods = _add_tenant(reg, "hog", _catalog("plain", 10), n_pods=450)
+    smalls = {}
+    for i in range(3):
+        tid = f"small{i}"
+        smalls[tid] = _add_tenant(reg, tid, _catalog("plain", 10), pods_seed=i, n_pods=30)
+    sched.submit("hog", hog_pods)
+    for tid, pods in smalls.items():
+        sched.submit(tid, pods)
+    rounds = sched.run_until_idle()
+    # hog needs ceil(450/100) = 5 rounds; smalls decide in round 1
+    assert rounds == 5
+    for tid in smalls:
+        log = reg.get(tid).latency.decision_log()
+        assert log and all(tick == 1 for tick, _ in log)
+    hog_log = reg.get("hog").latency.decision_log()
+    assert {tick for tick, _ in hog_log} == {1, 2, 3, 4, 5}
+    # every hog-present round still admitted every waiting small tenant
+    first = sched.round_log[0]
+    assert set(first["admitted"]) == {"hog", "small0", "small1", "small2"}
+    assert first["admitted"]["hog"] == 100
+
+
+def test_admission_backpressure_blocks_never_drops(monkeypatch):
+    _engine("batched", monkeypatch)
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_ADMIT_CAP", "50")
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    sched = FleetScheduler(eng, quantum=40)
+    _add_tenant(reg, "t", _catalog("plain", 8), n_pods=1)
+    pods = _pods("t", 130, 3)
+
+    done = threading.Event()
+
+    def producer():
+        assert sched.submit("t", pods) is True
+        done.set()
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    # producer must block at the 50-pod cap
+    time.sleep(0.1)
+    assert not done.is_set()
+    assert sched.queued("t") == 50
+    assert sched.debug_state()["blocked_submits"] >= 1
+    # rounds drain the queue; the producer unblocks and every pod is
+    # decided — none dropped
+    deadline = time.monotonic() + 30
+    while (sched.queued() or not done.is_set()) and time.monotonic() < deadline:
+        sched.run_round()
+    th.join(timeout=5)
+    assert done.is_set()
+    tracker = reg.get("t").latency
+    assert tracker.decided_count() == 130
+    assert tracker.pending_count() == 0
+
+
+def test_tenant_add_remove_during_steady_state(monkeypatch):
+    _engine("batched", monkeypatch)
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    sched = FleetScheduler(eng, quantum=500)
+    pods_a = _add_tenant(reg, "a", _catalog("plain", 10), pods_seed=0)
+    sched.submit("a", pods_a)
+    out = sched.run_round()
+    assert out["a"].error is None
+
+    # add a tenant mid-stream: next round serves both
+    pods_b = _add_tenant(reg, "b", _catalog("gpu", 16), pods_seed=1)
+    sched.submit("a", _pods("a2", 20, 5))
+    sched.submit("b", pods_b)
+    out = sched.run_round()
+    assert set(out) == {"a", "b"} and all(o.error is None for o in out.values())
+
+    # remove a tenant with queued work: queue dropped, registry clean,
+    # the other tenant unaffected
+    sched.submit("a", _pods("a3", 15, 6))
+    sched.submit("b", _pods("b2", 15, 7))
+    assert reg.remove_tenant("a")
+    dropped = sched.forget_tenant("a")
+    assert dropped == 15
+    out = sched.run_round()
+    assert set(out) == {"b"} and out["b"].error is None
+    with pytest.raises(KeyError):
+        sched.submit("a", _pods("a4", 1, 8))
+
+
+# ---------------------------------------------------------------------------
+# mega-dispatch coalescing
+
+
+def test_batched_round_coalesces_pack_dispatches(monkeypatch):
+    _engine("batched", monkeypatch)
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_WORKERS", "4")
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    work = {}
+    for t in range(8):
+        tid = f"t{t}"
+        work[tid] = _add_tenant(reg, tid, _catalog("plain", 16), pods_seed=t, n_pods=50)
+    out = eng.solve_round(work)
+    assert all(o.error is None for o in out.values())
+    d = eng.last_round["dispatch"]
+    # every tenant's pack went through the dispatcher, and at least one
+    # flush carried multiple tenants' jobs (the mega-dispatch)
+    assert d["pack_calls"] >= 8
+    assert d["flushes"] < d["pack_calls"]
+    assert d["max_occupancy"] >= 2
+    # solo rounds never touch the dispatcher
+    _engine("solo", monkeypatch)
+    eng.solve_round({t: _pods(t + "s", 10, 1) for t in work})
+    assert eng.last_round["dispatch"] == {}
+
+
+def test_content_plane_shares_catalog_and_skeletons(monkeypatch):
+    """Content-identical tenants resolve to one canonical catalog and
+    share job skeletons in batched mode."""
+    _engine("batched", monkeypatch)
+    # one worker: tenants run sequentially, so the later content-twins
+    # can hit what the first published (with W workers, W simultaneous
+    # twins each compute the first round's skeletons before any put —
+    # the plane's wins come from later arrivals and later rounds)
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_WORKERS", "1")
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    cat = _catalog("plain", 14)
+    work = {}
+    for t in range(4):
+        tid = f"t{t}"
+        # same content, distinct objects per tenant
+        work[tid] = _add_tenant(reg, tid, list(cat), pods_seed=7, n_pods=40)
+        # identical pod CONTENT across tenants (names differ)
+    out = eng.solve_round(work)
+    assert all(o.error is None for o in out.values())
+    plane = reg.plane.debug_state()
+    assert plane["canonical_catalogs"] == 1
+    assert len(eng.skeletons) > 0
+    # at least one tenant's solve hit the fleetjob plane
+    hits = sum(
+        reg.get(t).solver.last_cache_stats["hits"].get("fleetjob", 0) for t in work
+    )
+    assert hits > 0
+    # the canonical entries are plane-owned copies, not tenant objects
+    canon_cat = reg.get("t0").view.get_instance_types(None)
+    assert canon_cat is not cat and canon_cat[0] is not cat[0]
+    assert canon_cat[0].name == cat[0].name
+
+
+# ---------------------------------------------------------------------------
+# operational surface
+
+
+def test_engine_name_env(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_ENGINE", "solo")
+    assert fleet_engine_name() == "solo"
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_ENGINE", "bogus")
+    assert fleet_engine_name() == "batched"
+
+
+def test_fleet_metrics_and_label_cap(monkeypatch):
+    _engine("batched", monkeypatch)
+    monkeypatch.setenv("KARPENTER_TPU_FLEET_TENANT_LABELS", "3")
+    reg = FleetRegistry()
+    metrics = Metrics()
+    eng = FleetEngine(reg, metrics=metrics)
+    work = {}
+    for t in range(6):
+        tid = f"t{t}"
+        work[tid] = _add_tenant(reg, tid, _catalog("plain", 8), pods_seed=t, n_pods=10)
+    eng.solve_round(work)
+    labels = {
+        dict(k).get("tenant") for k in metrics.fleet_solves.values.keys()
+    }
+    assert "_other" in labels
+    assert len(labels - {"_other"}) == 3
+    assert metrics.fleet_batch_occupancy.get() is not None
+    exposition = metrics.registry.expose()
+    assert "karpenter_tpu_fleet_solves_total" in exposition
+
+
+def test_debug_fleet_route(monkeypatch):
+    from karpenter_core_tpu.operator.server import OperationalServer
+
+    _engine("batched", monkeypatch)
+    reg = FleetRegistry()
+    eng = FleetEngine(reg)
+    sched = FleetScheduler(eng, quantum=100)
+    pods = _add_tenant(reg, "a", _catalog("plain", 8), n_pods=12)
+    sched.submit("a", pods)
+    sched.run_round()
+
+    metrics = Metrics()
+
+    def fleet_state():
+        return {"engine": eng.debug_state(), "scheduler": sched.debug_state()}
+
+    server = OperationalServer(
+        metrics.registry, lambda: True, metrics_port=0, probe_port=0,
+        fleet_state=fleet_state,
+    )
+    server.start()
+    try:
+        assert server.metrics_port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.metrics_port}/debug/fleet", timeout=5
+        ).read().decode()
+        assert '"tenant": "a"' in body
+        assert "last_round" in body
+    finally:
+        server.stop()
+
+
+def test_decision_latency_tracked_per_tenant(monkeypatch):
+    _engine("batched", monkeypatch)
+    reg = FleetRegistry()
+    metrics = Metrics()
+    eng = FleetEngine(reg, metrics=metrics)
+    sched = FleetScheduler(eng, metrics=metrics, quantum=100)
+    pods = _add_tenant(reg, "a", _catalog("plain", 8), n_pods=20)
+    sched.submit("a", pods)
+    sched.run_round()
+    tracker = reg.get("a").latency
+    assert tracker.decided_count() == 20
+    pct = tracker.percentiles()
+    assert pct["p50"] >= 0.0
+    assert metrics.fleet_decision_latency.totals.get(()) == 20
