@@ -254,42 +254,21 @@ def decision_latency_block(samples_ms) -> dict:
 
 def _split(solver) -> dict:
     """Device-vs-host wall split of the solver's most recent solve
-    (solver.last_timings; VERDICT r4: make "TPU-native" measurable),
-    plus the tracer's per-phase self-time breakdown and the top-3 host
-    phases (ISSUE 1: host-dominance must be structurally attributable,
-    not a single host_ms total). The breakdown's phases sum to the
-    solve's wall time by construction (self times partition the root)."""
+    (VERDICT r4: make "TPU-native" measurable), plus the tracer's
+    per-phase self-time breakdown and the top-3 host phases (ISSUE 1:
+    host-dominance must be structurally attributable, not a single
+    host_ms total). Reads the consolidated per-solve stats schema
+    (solver/stats.py — ISSUE 10: the same document /debug/solve/stats
+    serves) and projects it onto the flat per-config BENCH columns, so
+    the artifact keys stay byte-compatible with prior rounds."""
     t = getattr(solver, "last_timings", None)
     if not t:
         return {}
-    out = {
-        "device_ms": round(t["device_ms"], 2),
-        "host_ms": round(t["host_ms"], 2),
-    }
-    cs = getattr(solver, "last_cache_stats", None)
-    if cs and (cs.get("hits") or cs.get("misses")):
-        # steady-state incremental solve (ISSUE 4): per-solve cache
-        # traffic and the aggregate hit rate, per cache layer
-        out["cache_hits"] = dict(cs.get("hits", {}))
-        out["cache_misses"] = dict(cs.get("misses", {}))
-        if "hit_rate" in cs:
-            out["cache_hit_rate"] = cs["hit_rate"]
-    ps = getattr(solver, "last_pack_stats", None)
-    if ps and ps.get("backend") not in (None, "ffd"):
-        # plan-quality pack backend (ISSUE 8): which engine partitioned
-        # the jobs and what the LP guard won on this solve
-        out["pack_backend"] = dict(ps)
-    ms = getattr(solver, "last_merge_stats", None)
-    if ms:
-        # cross-group merge observability (ISSUE 2): wall time of the
-        # merge pass plus the engine's screen/apply counters, so the
-        # BENCH trajectory can track the vectorized engine's win
-        out["merge_ms"] = round(float(ms.get("merge_ms", 0.0)), 2)
-        out["merge_candidates_screened"] = int(ms.get("merge_candidates_screened", 0))
-        out["merge_pairs_applied"] = int(ms.get("merge_pairs_applied", 0))
-        if ms.get("merge_engine"):
-            out["merge_engine"] = ms["merge_engine"]
-    trace_id = t.get("trace_id")
+    from karpenter_core_tpu.solver import stats as solver_stats
+
+    stats = solver_stats.solve_stats(solver)
+    out = solver_stats.bench_fields(stats)
+    trace_id = stats.get("trace_id")
     if trace_id:
         from karpenter_core_tpu.tracing import tracer as _tracer
 
@@ -1098,6 +1077,12 @@ def config8() -> dict:
         entry["pods_per_sec"] = free.get("pods_per_sec")
         entry["queue_stats"] = free.get("queues", {})
         entry["stage_attribution_ms"] = free.get("stage_attribution_ms", {})
+        # decision telemetry plane (ISSUE 10): flight-recorder timeline
+        # reconstruction coverage and the orphan-span count over the
+        # free run (each measurement is its own process, so both are
+        # scenario-scoped)
+        entry["flightrec_coverage"] = free.get("flightrec", {}).get("coverage")
+        entry["orphan_spans"] = free.get("orphan_spans")
         if name == "churn10x":
             seq_free = _stream_measure(name, "sequential", "free", scale, pace)
             entry["sequential_steady_decision_latency_ms"] = seq_free.get(
@@ -1113,6 +1098,15 @@ def config8() -> dict:
     churn = out["scenarios"].get("churn10x", {})
     out["steady_p99_speedup_vs_sequential"] = churn.get(
         "steady_p99_speedup_vs_sequential", 0.0
+    )
+    coverages = [
+        e.get("flightrec_coverage")
+        for e in out["scenarios"].values()
+        if e.get("flightrec_coverage") is not None
+    ]
+    out["flightrec_coverage_min"] = min(coverages) if coverages else None
+    out["orphan_spans_total"] = sum(
+        e.get("orphan_spans") or 0 for e in out["scenarios"].values()
     )
     return out
 

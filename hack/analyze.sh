@@ -5,8 +5,23 @@
 # solver.py alone still re-proves the key/read-set and generation-bump
 # invariants against state/cluster.py and the provider. Pass --all for a
 # full-repo run (the tier-1 meta-test shape).
+#
+# --telemetry (ISSUE 10): the decision-telemetry gate in one command —
+# the Prometheus exposition-format checker, the bench-ledger regression
+# check over the BENCH_r*.json trajectory, and the orphan-span /
+# flight-recorder meta-tests. Tier-1 runs the same tests via pytest;
+# this mode is the pre-push/CI shortcut alongside the analysis run.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ "${1:-}" == "--telemetry" ]]; then
+  shift
+  echo "== bench ledger --check (BENCH_r*.json trajectory gates)"
+  python hack/bench_ledger.py --check "$@"
+  echo "== prom-format + orphan-span + flight-recorder meta-tests"
+  exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -q -p no:cacheprovider \
+    tests/test_prom_format.py tests/test_bench_ledger.py tests/test_flightrec.py \
+    "tests/test_tracing.py::TestOrphanAccounting"
+fi
 if [[ "${1:-}" == "--all" ]]; then
   shift
   exec python -m karpenter_core_tpu.analysis "$@"

@@ -1,0 +1,483 @@
+#!/usr/bin/env python
+"""Perf-regression ledger over the BENCH_r*.json round artifacts
+(ISSUE 10 tentpole): parse every round into one normalized trajectory
+table (backend x config x metric x round), emit ``BENCH_LEDGER.json``
+plus a markdown trend summary, and — ``--check`` — fail when the latest
+round regresses a gate metric by more than the threshold against the
+best prior round, so the next PR cannot silently lose PR-2/4/7's wins.
+
+Artifact anatomy (what seven rounds actually look like):
+
+- every round: ``{n, cmd, rc, tail?, parsed?}``;
+- r06+ carry ``parsed`` = the FULL bench payload (headline keys +
+  ``configs`` list + ``engines``);
+- r02 carries a partial ``parsed`` (headline only) — configs recovered
+  from the tail;
+- r01 and r03-r05 carry only a 2000-char ``tail`` whose FRONT is
+  truncated: the headline is gone, but each per-config JSON object
+  (``{"config": "...", ...}``) inside is complete and recovered by a
+  balanced-brace scan; the ``engines`` block names the backend;
+- r01 is an error round (rc=1, TPU backend unavailable) — retained in
+  the ledger as status=error with zero rows.
+
+Comparability: rows are grouped by (backend, config, metric) — a TPU
+round's numbers never gate a CPU round's (r03's device numbers are a
+different machine class than the CPU-fallback trajectory).
+
+Gate semantics (``--check``): only *gate metrics* fail the check —
+steady/warm p50-shaped latencies and headline throughputs with a
+declared better-direction (see GATE_METRICS). Everything else is
+trend-reported but not gated: bench configs also carry diagnostic
+columns (candidate counts, node counts, cache traffic) whose movement
+is not a regression. A gate metric regresses when the LATEST round is
+worse than the BEST prior same-backend round by more than
+``--threshold`` (default 15%).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+SCHEMA = 1
+
+# The regression gates. Two kinds, chosen per metric by how the real
+# r01-r07 trajectories behave:
+#
+# - RELATIVE gates ride the trajectory: latest round vs the BEST prior
+#   same-backend round, failing beyond the threshold. Only the
+#   steady/warm p50-shaped solver-path numbers qualify — they are
+#   reproducible run to run. Free-run serving latencies and speedup
+#   ratios swing ±20% with machine load (observed across r06→r07 on
+#   unchanged code), so gating them relatively would cry wolf.
+# - ABSOLUTE gates mirror each config's published bench target (the
+#   gate bench.py itself enforces): a floor for wins (pipeline speedup
+#   ≥1.5x, fleet ratio ≥3x, LP saving ≥5%), a ceiling for budgets
+#   (steady disruption decision ≤100 ms), and ==1.0 floors for the
+#   plan-identity booleans — losing identity is always a failure.
+#
+# Everything else is trend-reported in the markdown but never gated:
+# diagnostic counters (candidates, cache traffic, node counts) move by
+# design.
+RELATIVE_GATES: List[Tuple[str, str, str]] = [
+    # (config, metric, direction): "down" = lower is better
+    ("headline", "value", "up"),                        # pods/sec
+    ("headline", "warm_ms", "down"),                    # warm solve wall
+    ("config7", "warm_tick_host_ms_p50", "down"),       # PR-4 steady state
+    ("config7", "noop_tick_host_ms", "down"),           # PR-4 no-op tick
+    ("config7", "decision_latency_ms.p50", "down"),     # tick-driven SLO
+    ("config9", "steady_decision_ms.p50", "down"),      # PR-7 steady pass
+    ("config9", "churn_decision_ms.p50", "down"),       # PR-7 churn pass
+    ("config10", "adversarial_saving_pct", "up"),       # PR-8 LP win
+]
+ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
+    # (config, metric, "floor"|"ceiling", bound)
+    ("config8", "steady_p99_speedup_vs_sequential", "floor", 1.5),
+    ("config8", "plan_identical_all_scenarios", "floor", 1.0),
+    ("config9", "steady_decision_ms.p50", "ceiling", 100.0),
+    ("config9", "plan_identical_all", "floor", 1.0),
+    ("config10", "adversarial_saving_pct", "floor", 5.0),
+    ("config10", "lp_not_worse_all", "floor", 1.0),
+    ("config11", "throughput_ratio_at_128_small", "floor", 3.0),
+    ("config11", "plan_identical_all", "floor", 1.0),
+]
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+# ---------------------------------------------------------------------------
+# recovery parsing
+
+
+def extract_json_objects(text: str, marker: str) -> List[dict]:
+    """Balanced-brace scan: every complete JSON object beginning with
+    ``marker`` in ``text`` (the tail of a truncated artifact). Strings
+    are respected so braces inside values cannot unbalance the scan."""
+    out: List[dict] = []
+    start = 0
+    while True:
+        i = text.find(marker, start)
+        if i < 0:
+            return out
+        depth = 0
+        in_str = False
+        esc = False
+        for j in range(i, len(text)):
+            c = text[j]
+            if in_str:
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == '"':
+                    in_str = False
+                continue
+            if c == '"':
+                in_str = True
+            elif c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        out.append(json.loads(text[i : j + 1]))
+                    except ValueError:
+                        pass
+                    start = j + 1
+                    break
+        else:
+            return out  # truncated object at the very end
+        if start <= i:
+            start = i + len(marker)
+
+
+def _backend_from_tail(tail: str) -> Optional[str]:
+    m = re.search(r'"engines":\s*\{[^}]*"backend":\s*"([a-z]+)"', tail)
+    return m.group(1) if m else None
+
+
+def parse_round(path: str) -> dict:
+    """One artifact → {round, file, rc, status, backend, headline,
+    configs}. status: ok | recovered | error."""
+    with open(path) as f:
+        doc = json.load(f)
+    rnd = _round_of(path)
+    rc = doc.get("rc")
+    parsed = doc.get("parsed")
+    tail = doc.get("tail", "") or ""
+    out = {
+        "round": rnd,
+        "file": os.path.basename(path),
+        "rc": rc,
+        "status": "error",
+        "backend": None,
+        "headline": {},
+        "configs": [],
+    }
+    if isinstance(parsed, dict):
+        out["status"] = "ok"
+        out["backend"] = parsed.get("backend")
+        out["headline"] = {k: v for k, v in parsed.items() if k != "configs"}
+        out["configs"] = [c for c in parsed.get("configs", []) if isinstance(c, dict)]
+    if rc not in (0, None) and not out["configs"] and not out["headline"]:
+        return out  # failed round, nothing recoverable
+    if not out["configs"] and tail:
+        # front-truncated envelope: recover the complete per-config
+        # objects (and the backend) from the retained tail
+        configs = [c for c in extract_json_objects(tail, '{"config"') if "config" in c]
+        if configs:
+            out["configs"] = configs
+            if out["status"] == "error":
+                out["status"] = "recovered"
+        if out["backend"] is None:
+            out["backend"] = _backend_from_tail(tail)
+    if out["status"] == "error" and (out["configs"] or out["headline"]):
+        out["status"] = "recovered"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# normalization
+
+
+def config_key(cfg_name: str) -> str:
+    """'2: 10k mixed ...' → 'config2'; headline rows use 'headline'."""
+    m = re.match(r"\s*(\d+)\s*:", cfg_name)
+    if m:
+        return f"config{int(m.group(1))}"
+    slug = re.sub(r"[^a-z0-9]+", "_", cfg_name.lower()).strip("_")
+    return slug[:40] or "unknown"
+
+
+def flatten_numeric(d: dict, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a config block, dotted for nesting. Bools are
+    counted as 0/1 (gate booleans like plan_identical_all ride along);
+    strings/lists are dropped (phase breakdown lists, config names)."""
+    out: Dict[str, float] = {}
+    for k, v in d.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, bool):
+            out[name] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)) and v is not None:
+            out[name] = float(v)
+        elif isinstance(v, dict):
+            out.update(flatten_numeric(v, prefix=f"{name}."))
+    return out
+
+
+def build_table(rounds: List[dict]) -> List[dict]:
+    """The normalized trajectory table: one row per
+    (round, backend, config, metric)."""
+    rows: List[dict] = []
+    for rd in rounds:
+        backend = rd.get("backend") or "unknown"
+        if rd["headline"]:
+            for metric, value in sorted(flatten_numeric(rd["headline"]).items()):
+                rows.append(
+                    {
+                        "round": rd["round"],
+                        "backend": backend,
+                        "config": "headline",
+                        "metric": metric,
+                        "value": value,
+                    }
+                )
+        for cfg in rd["configs"]:
+            key = config_key(str(cfg.get("config", "")))
+            flat = flatten_numeric({k: v for k, v in cfg.items() if k != "config"})
+            for metric, value in sorted(flat.items()):
+                rows.append(
+                    {
+                        "round": rd["round"],
+                        "backend": backend,
+                        "config": key,
+                        "metric": metric,
+                        "value": value,
+                    }
+                )
+    return rows
+
+
+def trajectories(rows: List[dict]) -> Dict[Tuple[str, str, str], Dict[int, float]]:
+    out: Dict[Tuple[str, str, str], Dict[int, float]] = {}
+    for r in rows:
+        out.setdefault((r["backend"], r["config"], r["metric"]), {})[r["round"]] = r[
+            "value"
+        ]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+
+
+def gate_direction(config: str, metric: str) -> Optional[str]:
+    for cfg, m, direction in RELATIVE_GATES:
+        if config == cfg and metric == m:
+            return direction
+    return None
+
+
+def absolute_gate(config: str, metric: str) -> Optional[Tuple[str, float]]:
+    for cfg, m, kind, bound in ABSOLUTE_GATES:
+        if config == cfg and metric == m:
+            return kind, bound
+    return None
+
+
+def check_regressions(
+    traj: Dict[Tuple[str, str, str], Dict[int, float]], threshold: float
+) -> List[dict]:
+    """Gate pass over the trajectory table: relative gates compare the
+    latest round against the best prior same-backend round; absolute
+    gates hold the latest round to each config's published bench
+    target. Returns the list of failures (empty = pass)."""
+    failures: List[dict] = []
+    for (backend, config, metric), series in sorted(traj.items()):
+        latest_round = max(series)
+        latest = series[latest_round]
+        gate = absolute_gate(config, metric)
+        if gate is not None:
+            kind, bound = gate
+            broken = latest < bound if kind == "floor" else latest > bound
+            if broken:
+                failures.append(
+                    {
+                        "backend": backend,
+                        "config": config,
+                        "metric": metric,
+                        "kind": kind,
+                        "latest_round": latest_round,
+                        "latest": latest,
+                        "bound": bound,
+                        "change_pct": None,
+                    }
+                )
+        direction = gate_direction(config, metric)
+        if direction is None or len(series) < 2:
+            continue
+        prior = {r: v for r, v in series.items() if r != latest_round}
+        if not prior:
+            continue
+        best = min(prior.values()) if direction == "down" else max(prior.values())
+        if best <= 0:
+            continue
+        ratio = latest / best
+        regressed = (
+            ratio > 1.0 + threshold if direction == "down" else ratio < 1.0 - threshold
+        )
+        if regressed:
+            failures.append(
+                {
+                    "backend": backend,
+                    "config": config,
+                    "metric": metric,
+                    "kind": "relative",
+                    "direction": direction,
+                    "latest_round": latest_round,
+                    "latest": latest,
+                    "best_prior": best,
+                    "best_prior_round": min(
+                        (r for r, v in prior.items() if v == best), default=None
+                    ),
+                    "change_pct": round((ratio - 1.0) * 100.0, 2),
+                }
+            )
+    return failures
+
+
+def describe_failure(f: dict) -> str:
+    base = f"`{f['config']}/{f['metric']}` ({f['backend']}): r{f['latest_round']:02d} = {f['latest']:g}"
+    if f.get("kind") == "relative":
+        return (
+            base
+            + f" vs best prior {f['best_prior']:g} (r{f['best_prior_round']:02d}), "
+            + f"{f['change_pct']:+.1f}%"
+        )
+    op = "<" if f["kind"] == "floor" else ">"
+    return base + f" {op} published gate {f['bound']:g}"
+
+
+# ---------------------------------------------------------------------------
+# emission
+
+
+def write_markdown(
+    path: str,
+    rounds: List[dict],
+    traj: Dict[Tuple[str, str, str], Dict[int, float]],
+    failures: List[dict],
+    threshold: float,
+) -> None:
+    all_rounds = sorted({rd["round"] for rd in rounds})
+    lines = [
+        "# Bench trajectory ledger",
+        "",
+        "Generated by `hack/bench_ledger.py` from the `BENCH_r*.json` round",
+        "artifacts. Gate metrics compare the latest round against the best",
+        f"prior same-backend round at a {threshold:.0%} threshold.",
+        "",
+        "## Rounds",
+        "",
+        "| round | file | status | backend | configs |",
+        "|---|---|---|---|---|",
+    ]
+    for rd in rounds:
+        lines.append(
+            f"| r{rd['round']:02d} | {rd['file']} | {rd['status']} "
+            f"| {rd.get('backend') or '-'} | {len(rd['configs'])} |"
+        )
+    lines += ["", "## Gate-metric trends", ""]
+    header = "| backend | config | metric | " + " | ".join(
+        f"r{r:02d}" for r in all_rounds
+    ) + " | gate |"
+    lines.append(header)
+    lines.append("|---" * (4 + len(all_rounds)) + "|")
+    for (backend, config, metric), series in sorted(traj.items()):
+        direction = gate_direction(config, metric)
+        absolute = absolute_gate(config, metric)
+        if direction is None and absolute is None:
+            continue
+        cells = [
+            (f"{series[r]:g}" if r in series else "·") for r in all_rounds
+        ]
+        gates = []
+        if direction is not None:
+            gates.append("↓ better" if direction == "down" else "↑ better")
+        if absolute is not None:
+            kind, bound = absolute
+            gates.append(f"{'≥' if kind == 'floor' else '≤'}{bound:g}")
+        lines.append(
+            f"| {backend} | {config} | {metric} | "
+            + " | ".join(cells)
+            + f" | {', '.join(gates)} |"
+        )
+    lines += ["", "## Check result", ""]
+    if failures:
+        lines.append(f"**FAIL** — {len(failures)} gate metric(s) regressed:")
+        lines.append("")
+        for f in failures:
+            lines.append("- " + describe_failure(f))
+    else:
+        lines.append("**PASS** — no gate metric regressed beyond the threshold.")
+    lines.append("")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+def build_ledger(bench_dir: str, threshold: float) -> dict:
+    paths = sorted(
+        (p for p in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")) if _round_of(p)),
+        key=_round_of,
+    )
+    rounds = [parse_round(p) for p in paths]
+    rows = build_table(rounds)
+    traj = trajectories(rows)
+    failures = check_regressions(traj, threshold)
+    return {
+        "schema": SCHEMA,
+        "threshold": threshold,
+        "rounds": [
+            {k: rd[k] for k in ("round", "file", "rc", "status", "backend")}
+            | {"configs": len(rd["configs"]), "headline_metrics": len(rd["headline"])}
+            for rd in rounds
+        ],
+        "table": rows,
+        "failures": failures,
+        "_rounds_full": rounds,  # stripped before writing
+        "_traj": traj,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--out", default=None, help="BENCH_LEDGER.json path (default: <dir>/BENCH_LEDGER.json)")
+    ap.add_argument("--md", default=None, help="markdown trend summary path (default: <dir>/BENCH_LEDGER.md)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="gate regression threshold as a fraction (default 0.15)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when a gate metric regressed vs the best prior round")
+    args = ap.parse_args(argv)
+
+    ledger = build_ledger(args.dir, args.threshold)
+    rounds = ledger.pop("_rounds_full")
+    traj = ledger.pop("_traj")
+    if not rounds:
+        print(f"bench_ledger: no BENCH_r*.json artifacts under {args.dir}", file=sys.stderr)
+        return 2
+
+    out_path = args.out or os.path.join(args.dir, "BENCH_LEDGER.json")
+    md_path = args.md or os.path.join(args.dir, "BENCH_LEDGER.md")
+    with open(out_path, "w") as fh:
+        json.dump(ledger, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    write_markdown(md_path, rounds, traj, ledger["failures"], args.threshold)
+
+    parsed_rows = len(ledger["table"])
+    print(
+        f"bench_ledger: {len(rounds)} rounds, {parsed_rows} trajectory rows "
+        f"→ {out_path}, {md_path}"
+    )
+    if ledger["failures"]:
+        for f in ledger["failures"]:
+            print("REGRESSION " + describe_failure(f), file=sys.stderr)
+        if args.check:
+            return 1
+    elif args.check:
+        print("bench_ledger: check passed — no gate regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,14 @@ _SYNC_CTOR_SUFFIXES = (
     "Barrier",
     "Lock",
     "RLock",
+    # trace-context handoff objects (ISSUE 10): a TraceContext is
+    # immutable after construction and ``tracer.capture()`` returns one
+    # (or None) — publishing the reference across stage threads is a
+    # single GIL-atomic store of an immutable value, the tracer's
+    # documented crossing discipline. Known under-approximation: any
+    # ``.capture()`` call matches, not just the tracer's.
+    "TraceContext",
+    "capture",
 )
 
 _EXEMPT = {"__init__", "__new__"}

@@ -97,6 +97,9 @@ class DisruptionController:
         # per-decision bounds/engine stats of the last pass that computed
         # any (bench config 9 and /debug/traces read this)
         self.last_decision_stats: Optional[dict] = None
+        # the last pass's trace (the serving pipeline's disruption stage
+        # flight-records it per pass)
+        self.last_trace = None
 
     def reconcile(self) -> Optional[str]:
         """One pass; returns the executed method name or None. The pass
@@ -108,6 +111,7 @@ class DisruptionController:
             return None
         sink = self.metrics.solver_phase_duration if self.metrics is not None else None
         with tracer.trace_root("disrupt", metrics_sink=sink, buffer_if="solve") as tr:
+            self.last_trace = tr
             return self._reconcile(tr)
 
     def _reconcile(self, tr) -> Optional[str]:

@@ -158,7 +158,7 @@ def get_candidates(
 
 
 def simulate_scheduling(
-    kube_client, cluster, provisioner, candidates: List[Candidate]
+    kube_client, cluster, provisioner, candidates: List[Candidate], trace_ctx=None
 ) -> Results:
     """helpers.go:73 simulateScheduling: run the scheduler in simulation
     mode over pending + candidate + deleting-node pods minus the candidate
@@ -168,13 +168,21 @@ def simulate_scheduling(
     the displaced pods pack onto the surviving fleet via the tensor
     existing-capacity path (native/device first-fit) instead of the
     greedy O(P·M) per-pod loop — the same engine the provisioning path
-    uses, so decisions agree by construction."""
+    uses, so decisions agree by construction.
+
+    ``trace_ctx`` (ISSUE 10): the originating decision's TraceContext
+    when the probe runs on a thread other than the one that opened the
+    disruption pass's root — the probe's spans adopt it so they land
+    under the decision instead of orphaning. On the same thread the
+    ``trace_root`` below already joins the active trace and ``adopt``
+    degrades to a plain span."""
     from ..tracing import tracer
 
-    with tracer.trace_root(
-        "disrupt.simulate", is_solve=True, candidates=len(candidates)
-    ):
-        return _simulate(kube_client, cluster, provisioner, candidates)
+    with tracer.adopt(trace_ctx, "disrupt.simulate.adopt", candidates=len(candidates)):
+        with tracer.trace_root(
+            "disrupt.simulate", is_solve=True, candidates=len(candidates)
+        ):
+            return _simulate(kube_client, cluster, provisioner, candidates)
 
 
 def _simulate(kube_client, cluster, provisioner, candidates: List[Candidate]) -> Results:

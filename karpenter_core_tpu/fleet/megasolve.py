@@ -255,9 +255,13 @@ class SkeletonPlane:
 
 
 class _PackWait:
-    """One tenant thread's parked pack submission."""
+    """One tenant thread's parked pack submission. ``ctx`` is the
+    submitting lane's TraceContext (the tenant solve in flight on that
+    worker): the flush records every parked lane's trace as a link on
+    the shared mega-dispatch span, and each lane's trace links back —
+    one batched dispatch ⇒ N tenant decisions, navigable both ways."""
 
-    __slots__ = ("jobs", "metas", "mesh", "results", "flags", "error", "done")
+    __slots__ = ("jobs", "metas", "mesh", "results", "flags", "error", "done", "ctx")
 
     def __init__(self, jobs, metas, mesh):
         self.jobs = jobs
@@ -267,6 +271,7 @@ class _PackWait:
         self.flags: List[bool] = []
         self.error: Optional[BaseException] = None
         self.done = False
+        self.ctx = tracer.capture()
 
 
 class _MegaDispatcher:
@@ -341,8 +346,23 @@ class _MegaDispatcher:
         all_jobs = [j for w in batch for j in w.jobs]
         all_metas = [m for w in batch for m in w.metas]
         mesh = batch[0].mesh
+        # the flushing lane executes the shared dispatch inside its own
+        # tenant solve's trace; every coalesced lane's trace is recorded
+        # as a link on the shared pack span (and reciprocally), so each
+        # tenant's flight record can name the dispatch that served it
+        links = [w.ctx.trace_id for w in batch if w.ctx is not None]
+        flusher_id = tracer.current_trace_id()
+        if flusher_id is not None:
+            for w in batch:
+                if w.ctx is not None and w.ctx.trace_id != flusher_id:
+                    w.ctx.trace.add_link(flusher_id, via="fleet.megadispatch")
         try:
-            with tracer.span("fleet.megadispatch", jobs=len(all_jobs), tenant_calls=len(batch)):
+            with tracer.span(
+                "fleet.megadispatch",
+                jobs=len(all_jobs),
+                tenant_calls=len(batch),
+                links=links,
+            ):
                 # the real backend's lock spans the call and its per-call
                 # outputs (the PR-8 singleton discipline)
                 with self._backend.lock:
@@ -455,7 +475,9 @@ class FleetEngine:
 
     # -- per-tenant solve ---------------------------------------------------
 
-    def _solve_tenant(self, tenant_id: str, pods: list, engine: str) -> TenantOutcome:
+    def _solve_tenant(
+        self, tenant_id: str, pods: list, engine: str, links: Optional[list] = None
+    ) -> TenantOutcome:
         handle = self.registry.get(tenant_id)
         if handle is None:
             return TenantOutcome(error=f"unknown tenant {tenant_id!r}", pods=len(pods))
@@ -465,6 +487,16 @@ class FleetEngine:
             out = TenantOutcome(
                 result=result, ms=(time.perf_counter() - t0) * 1000.0, pods=len(pods)
             )
+            if links:
+                # the submitting lanes' contexts (FleetScheduler.submit
+                # captures one per submission): linked onto the tenant
+                # solve's trace so a submitter's decision navigates to
+                # the solve (and the mega-dispatch) that served it
+                tid = (getattr(handle.solver, "last_timings", None) or {}).get("trace_id")
+                tr = tracer.RING.get(tid) if tid else None
+                if tr is not None:
+                    for link in links:
+                        tr.add_link(link, via="fleet.submit")
         except Exception as err:  # noqa: BLE001 — one tenant's failure must not fail the round
             out = TenantOutcome(
                 error=f"{type(err).__name__}: {err}",
@@ -480,11 +512,17 @@ class FleetEngine:
 
     # -- rounds -------------------------------------------------------------
 
-    def solve_round(self, work: Dict[str, list]) -> Dict[str, TenantOutcome]:
-        """One fleet round over {tenant_id: pods}. Engine read per round."""
+    def solve_round(
+        self, work: Dict[str, list], links: Optional[Dict[str, list]] = None
+    ) -> Dict[str, TenantOutcome]:
+        """One fleet round over {tenant_id: pods}. Engine read per
+        round. ``links`` optionally carries per-tenant submitter trace
+        ids (FleetScheduler lane submissions) to attach to each tenant
+        solve's trace."""
         engine = fleet_engine_name()
         t0 = time.perf_counter()
         order = sorted(work)
+        links = links or {}
         plane = self.registry.plane
         plane.activate(engine == "batched")
         for tid in order:
@@ -492,10 +530,13 @@ class FleetEngine:
             if handle is not None:
                 handle.solver.fleet_plane = self.skeletons if engine == "batched" else None
         if engine == "solo":
-            outcomes = {tid: self._solve_tenant(tid, work[tid], engine) for tid in order}
+            outcomes = {
+                tid: self._solve_tenant(tid, work[tid], engine, links.get(tid))
+                for tid in order
+            }
             dispatch: dict = {}
         else:
-            outcomes, dispatch = self._solve_batched(work, order, engine)
+            outcomes, dispatch = self._solve_batched(work, order, engine, links)
         dt = time.perf_counter() - t0
         with self._mu:
             self._round += 1
@@ -522,8 +563,13 @@ class FleetEngine:
         return outcomes
 
     def _solve_batched(
-        self, work: Dict[str, list], order: List[str], engine: str
+        self,
+        work: Dict[str, list],
+        order: List[str],
+        engine: str,
+        links: Optional[Dict[str, list]] = None,
     ) -> Tuple[Dict[str, TenantOutcome], dict]:
+        links = links or {}
         dispatcher = _MegaDispatcher(backends_mod.active_backend())
         outcomes: Dict[str, TenantOutcome] = {}
         out_mu = threading.Lock()
@@ -542,7 +588,7 @@ class FleetEngine:
                     tid = next_tenant()
                     if tid is None:
                         return
-                    out = self._solve_tenant(tid, work[tid], engine)
+                    out = self._solve_tenant(tid, work[tid], engine, links.get(tid))
                     with out_mu:
                         outcomes[tid] = out
             finally:

@@ -28,7 +28,13 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..tracing import flightrec, tracer
 from .megasolve import FleetEngine, TenantOutcome, _env_int
+
+# submitter trace links retained per tenant between rounds (newest win;
+# a link is one trace_id string — the cap only bounds memory, links are
+# attribution, not accounting)
+_LINKS_KEEP = 64
 
 
 class FleetScheduler:
@@ -52,10 +58,17 @@ class FleetScheduler:
         self.window_s = max(0.0, window_s)
         self.admit_cap = _env_int("KARPENTER_TPU_FLEET_ADMIT_CAP", 10_000)
         self.on_round = on_round
+        burn_gauge = getattr(metrics, "decision_slo_burn", None)
+        if burn_gauge is not None:
+            flightrec.RECORDER.attach_burn_gauge(burn_gauge)
         # RLock-backed: locked helpers (_admit_locked) re-enter from
         # locked callers (run_round)
         self._cv = threading.Condition(threading.RLock())
         self._queues: Dict[str, deque] = {}
+        # per-tenant submitter TraceContext ids since the last round
+        # that admitted the tenant (ISSUE 10: fleet lane submissions
+        # carry their decision context into the round that serves them)
+        self._pending_links: Dict[str, deque] = {}
         self._deficit: Dict[str, float] = {}
         self._rotation: List[str] = []  # arrival order; stable across rounds
         self._stop = False
@@ -100,6 +113,10 @@ class FleetScheduler:
                 q.append(pod)
                 self._submitted += 1
                 handle.latency.pod_pending(pod.uid, step=self.tick)
+            ctx = tracer.capture()
+            if ctx is not None:
+                links = self._pending_links.setdefault(tenant_id, deque(maxlen=_LINKS_KEEP))
+                links.append(ctx.trace_id)
             self._cv.notify_all()
         return True
 
@@ -119,6 +136,7 @@ class FleetScheduler:
             if tenant_id in self._rotation:
                 self._rotation.remove(tenant_id)
             self._deficit.pop(tenant_id, None)
+            self._pending_links.pop(tenant_id, None)
             dropped = len(q) if q else 0
             if handle is not None and q:
                 for pod in q:
@@ -151,6 +169,13 @@ class FleetScheduler:
             admitted = self._admit_locked()
             self.tick += 1
             tick = self.tick
+            # the admitted tenants' accumulated submitter links ride
+            # into the round; unadmitted tenants keep theirs queued
+            links = {
+                tid: list(self._pending_links.pop(tid, ()))
+                for tid in admitted
+                if self._pending_links.get(tid)
+            }
             if admitted:
                 self.round_log.append(
                     {
@@ -162,7 +187,7 @@ class FleetScheduler:
             self._cv.notify_all()  # admission freed queue space
         if not admitted:
             return {}
-        outcomes = self.engine.solve_round(admitted)
+        outcomes = self.engine.solve_round(admitted, links=links)
         max_deficit = 0.0
         with self._cv:
             self.rounds_run += 1
@@ -174,20 +199,54 @@ class FleetScheduler:
             out = outcomes.get(tid)
             if handle is None:
                 continue
-            handle.latency.pods_decided(
-                [p.uid for p in pods], tick, error=out is None or out.error is not None
+            solve_tid = (getattr(handle.solver, "last_timings", None) or {}).get(
+                "trace_id"
             )
+            settled = handle.latency.pods_decided(
+                [p.uid for p in pods],
+                tick,
+                error=out is None or out.error is not None,
+                trace_id=solve_tid,
+            )
+            self._flight_record(tid, tick, handle, out, pods, settled, solve_tid)
         if self.metrics is not None:
             self.metrics.fleet_fairness_deficit.set(float(max_deficit))
             for tid, pods in admitted.items():
                 handle = self.registry.get(tid)
                 if handle is None:
                     continue
+                solve_tid = (getattr(handle.solver, "last_timings", None) or {}).get(
+                    "trace_id"
+                )
                 for s in handle.latency.decisions()[-len(pods):]:
-                    self.metrics.fleet_decision_latency.observe(s[1])
+                    self.metrics.fleet_decision_latency.observe(s[1], exemplar=solve_tid)
         if self.on_round is not None:
             self.on_round(tick, outcomes)
         return outcomes
+
+    def _flight_record(self, tid, tick, handle, out, pods, settled, solve_tid) -> None:
+        """One per-tenant-per-round decision record (kind=fleet): the
+        tenant's pods went pending at submit and were decided when this
+        round returned — the same interval the serving records carry."""
+        try:
+            from ..solver import stats as solver_stats
+
+            flightrec.RECORDER.record(
+                "fleet",
+                tick,
+                trace=tracer.RING.get(solve_tid) if solve_tid else None,
+                solve=solver_stats.solve_stats(handle.solver),
+                latency_ms=[s * 1000.0 for s in settled],
+                pods_decided=len(pods),
+                errors=1 if (out is None or out.error is not None) else 0,
+                tenant=tid,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail the round
+            import logging
+
+            logging.getLogger("karpenter.fleet").debug(
+                "fleet flight-record failed", exc_info=True
+            )
 
     def run_until_idle(self, max_rounds: int = 1_000_000) -> int:
         """Synchronous drive (benches, tests): rounds until every queue

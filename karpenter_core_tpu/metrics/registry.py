@@ -5,6 +5,7 @@ so dashboards port over."""
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -15,6 +16,27 @@ NAMESPACE = "karpenter"
 DURATION_BUCKETS = [
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600,
 ]
+
+
+def latency_buckets() -> List[float]:
+    """Decision-latency histogram buckets, env-tunable (ISSUE 10
+    satellite): ``KARPENTER_TPU_LATENCY_BUCKETS_MS`` is a comma-
+    separated millisecond list (e.g. "1,5,10,50,100,500,1000") so
+    ms-scale fleet decisions and second-scale disruption decisions
+    don't all pile into one bucket. Buckets are fixed at Histogram
+    construction — the env is read when ``Metrics`` is built (operator
+    start), not per observe. Unset/invalid → the reference's
+    DurationBuckets."""
+    raw = os.environ.get("KARPENTER_TPU_LATENCY_BUCKETS_MS", "")
+    if not raw.strip():
+        return DURATION_BUCKETS
+    try:
+        ms = sorted({float(part) for part in raw.split(",") if part.strip()})
+    except ValueError:
+        return DURATION_BUCKETS
+    if not ms or any(b <= 0 for b in ms):
+        return DURATION_BUCKETS
+    return [b / 1000.0 for b in ms]
 
 
 def _labels_key(labels: Dict[str, str]) -> tuple:
@@ -85,20 +107,40 @@ class Histogram:
         self.counts: Dict[tuple, List[int]] = {}
         self.sums: Dict[tuple, float] = {}
         self.totals: Dict[tuple, int] = {}
+        # last exemplar per (labelset, bucket): OpenMetrics-style trace
+        # anchors ("which trace_id filled this latency bucket last") —
+        # served via /debug/decisions, NOT the text exposition (classic
+        # Prometheus text format has no exemplar syntax; emitting it
+        # would fail the textcheck gate and ordinary scrapers)
+        self._exemplars: Dict[tuple, Dict[str, Tuple[str, float, float]]] = {}
         self._mu = threading.Lock()
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None, **labels) -> None:
         key = _labels_key(labels)
         with self._mu:
             if key not in self.counts:
                 self.counts[key] = [0] * len(self.buckets)
                 self.sums[key] = 0.0
                 self.totals[key] = 0
+            bucket = "+Inf"
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self.counts[key][i] += 1
+                    if bucket == "+Inf":
+                        bucket = str(b)
             self.sums[key] += value
             self.totals[key] += 1
+            if exemplar is not None:
+                self._exemplars.setdefault(key, {})[bucket] = (
+                    str(exemplar),
+                    value,
+                    time.time(),
+                )
+
+    def exemplars(self, **labels) -> Dict[str, Tuple[str, float, float]]:
+        """{bucket le → (exemplar, value, wall ts)} for one label set."""
+        with self._mu:
+            return dict(self._exemplars.get(_labels_key(labels), {}))
 
     def time(self, **labels):
         """Context manager: `with h.time(): ...` (metrics.Measure helper)."""
@@ -149,6 +191,30 @@ def _fmt_labels(key: tuple, **extra) -> str:
         return ""
     inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
     return "{" + inner + "}"
+
+
+class _TracerOrphanCollector:
+    """Registry bridge for the tracer's process-global orphan-span
+    counter (tracing/tracer.py): spans born on a thread with no active
+    root vanish from every trace — with cross-thread context
+    propagation in place the count should be zero, and the serving/
+    fleet identity tests assert it. Read-only: the value lives in the
+    tracer so instrumented code never needs a Metrics handle."""
+
+    name = f"{NAMESPACE}_tpu_tracer_orphan_spans_total"
+    help = (
+        "Spans dropped because no trace was active on their thread "
+        "(attribution bug once TraceContext propagation covers every lane)"
+    )
+
+    def collect(self) -> List[str]:
+        from ..tracing.tracer import orphan_spans
+
+        return [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} counter",
+            f"{self.name} {float(orphan_spans())}",
+        ]
 
 
 class Registry:
@@ -266,8 +332,18 @@ class Metrics:
         # stage-queue depths (backpressure visibility)
         self.serving_decision_latency = r.histogram(
             f"{ns}_serving_decision_latency_seconds",
-            "Pod-pending to plan-emitted decision latency (serving SLO)",
+            "Pod-pending to plan-emitted decision latency (serving SLO); buckets env-tunable via KARPENTER_TPU_LATENCY_BUCKETS_MS; exemplar trace_ids per bucket via /debug/decisions",
+            buckets=latency_buckets(),
         )
+        # decision telemetry plane (tracing/flightrec.py): SLO burn rate
+        # (fraction of decisions over KARPENTER_TPU_SLO_TARGET_MS per
+        # trailing window) and the tracer's orphan-span counter
+        self.decision_slo_burn = r.gauge(
+            f"{ns}_tpu_decision_slo_burn_rate",
+            "Fraction of decisions over the latency SLO target in the trailing window (1m | 10m)",
+            ["window"],
+        )
+        r.register(_TracerOrphanCollector())
         self.serving_stage_duration = r.histogram(
             f"{ns}_serving_stage_duration_seconds",
             "Serving pipeline stage wall time (batch_wait | plan)",
@@ -306,7 +382,8 @@ class Metrics:
         )
         self.fleet_decision_latency = r.histogram(
             f"{ns}_tpu_fleet_decision_latency_seconds",
-            "Fleet pod-pending to plan-emitted decision latency, all tenants",
+            "Fleet pod-pending to plan-emitted decision latency, all tenants; buckets env-tunable via KARPENTER_TPU_LATENCY_BUCKETS_MS",
+            buckets=latency_buckets(),
         )
         self.fleet_round_duration = r.histogram(
             f"{ns}_tpu_fleet_round_duration_seconds",

@@ -231,6 +231,12 @@ class Operator:
         if self.http is None:
             from .server import OperationalServer
 
+            from ..solver import stats as solver_stats
+
+            def _live_solver():
+                cached = getattr(self.provisioner, "_tpu_solver", None)
+                return cached[1] if cached is not None else None
+
             self.http = OperationalServer(
                 self.registry,
                 ready_check=self.healthy,
@@ -240,6 +246,9 @@ class Operator:
                 logger=self.logger,
                 serving_state=(
                     self.serving.debug_state if self.serving is not None else None
+                ),
+                solve_stats=lambda: solver_stats.route_payload(
+                    _live_solver, lambda: getattr(self, "disruption", None)
                 ),
             )
             self.http.start()

@@ -103,6 +103,33 @@ def _traces_last(_query) -> Tuple[int, str, str]:
     return 200, "application/json", to_chrome_json([tr])
 
 
+def _decisions(query) -> Tuple[int, str, str]:
+    """The flight recorder's ring (tracing/flightrec.py): per-decision
+    records with SLO burn rates and timeline-reconstruction coverage.
+    ``?tail=N`` bounds the decision list (default 32)."""
+    import json
+
+    from ..tracing import RECORDER
+
+    try:
+        tail = int(query.get("tail", ["32"])[0])
+    except ValueError:
+        return 400, "text/plain", "bad tail parameter\n"
+    return 200, "application/json", json.dumps(RECORDER.debug_state(tail=tail), default=str)
+
+
+def _decisions_last(_query) -> Tuple[int, str, str]:
+    """The most recent decision's flight record."""
+    import json
+
+    from ..tracing import RECORDER
+
+    rec = RECORDER.last()
+    if rec is None:
+        return 404, "text/plain", "no decisions recorded yet\n"
+    return 200, "application/json", json.dumps(rec, default=str)
+
+
 class _Handler(BaseHTTPRequestHandler):
     # routes injected per-server via the server instance
     def do_GET(self):  # noqa: N802 — http.server API
@@ -147,6 +174,7 @@ class OperationalServer:
         logger=None,
         serving_state: Optional[Callable[[], dict]] = None,
         fleet_state: Optional[Callable[[], dict]] = None,
+        solve_stats: Optional[Callable[[], Optional[dict]]] = None,
     ):
         self.registry = registry
         self.ready_check = ready_check
@@ -159,6 +187,9 @@ class OperationalServer:
         # fleet introspection hook (FleetEngine/FleetScheduler state:
         # registry, last batch composition, DRR deficits)
         self.fleet_state = fleet_state
+        # consolidated per-solve stats hook (solver/stats.py): the one
+        # stable schema over the scattered last_* stat blobs
+        self.solve_stats = solve_stats
         self._metrics_server: Optional[_Server] = None
         self._probe_server: Optional[_Server] = None
 
@@ -202,6 +233,22 @@ class OperationalServer:
             return 500, "text/plain", f"fleet state unavailable: {err}\n"
         return 200, "application/json", payload
 
+    def _solve_stats(self, _query) -> Tuple[int, str, str]:
+        """Consolidated per-solve stats (solver/stats.py SCHEMA): one
+        stable document over timings/cache/merge/pack-backend/disruption
+        — the blob the bench readers and dashboards consume."""
+        import json
+
+        if self.solve_stats is None:
+            return 404, "text/plain", "no solver wired\n"
+        try:
+            payload = self.solve_stats()
+        except Exception as err:  # noqa: BLE001 — a debug route must not 500 the server
+            return 500, "text/plain", f"solve stats unavailable: {err}\n"
+        if payload is None:
+            return 404, "text/plain", "no solve has completed yet\n"
+        return 200, "application/json", json.dumps(payload, default=str)
+
     # -- lifecycle ----------------------------------------------------------
 
     @property
@@ -232,11 +279,17 @@ class OperationalServer:
             # read the ring buffer (ISSUE 1 tentpole)
             "/debug/traces": _traces,
             "/debug/traces/last": _traces_last,
+            # the flight recorder rides the same always-on policy as the
+            # trace ring: the routes only read the bounded ring
+            "/debug/decisions": _decisions,
+            "/debug/decisions/last": _decisions_last,
         }
         if self.serving_state is not None:
             metrics_routes["/debug/serving"] = self._serving
         if self.fleet_state is not None:
             metrics_routes["/debug/fleet"] = self._fleet
+        if self.solve_stats is not None:
+            metrics_routes["/debug/solve/stats"] = self._solve_stats
         if self.enable_profiling:
             metrics_routes["/debug/pprof/"] = _stack_dump
             metrics_routes["/debug/pprof/profile"] = _collapsed_profile

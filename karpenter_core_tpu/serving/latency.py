@@ -57,11 +57,22 @@ class DecisionLatencyTracker:
         with self._mu:
             self._pending.pop(uid, None)
 
-    def pods_decided(self, uids: Iterable[str], tick: int, error: bool = False) -> None:
+    def pods_decided(
+        self,
+        uids: Iterable[str],
+        tick: int,
+        error: bool = False,
+        trace_id: Optional[str] = None,
+    ) -> List[float]:
         """First decision wins (a later re-plan of a still-pending pod
-        does not extend its measured latency)."""
+        does not extend its measured latency). ``trace_id`` (the
+        deciding solve's trace) rides the latency histogram as an
+        exemplar, so a slow bucket names a loadable trace. Returns the
+        latencies (seconds) settled by THIS call — the flight
+        recorder's per-decision timeline input."""
         t = self.clock()
         hist = self._histogram
+        settled: List[float] = []
         with self._mu:
             for uid in uids:
                 arrived = self._pending.pop(uid, None)
@@ -70,8 +81,10 @@ class DecisionLatencyTracker:
                 lat = t - arrived[0]
                 self._samples.append((uid, lat, arrived[1], tick, error))
                 self._decision_log.append((tick, uid))
+                settled.append(lat)
                 if hist is not None:
-                    hist.observe(lat)
+                    hist.observe(lat, exemplar=trace_id)
+        return settled
 
     # -- consumers ----------------------------------------------------------
 

@@ -45,7 +45,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from ..provisioning.batcher import Batcher
-from ..tracing import tracer
+from ..tracing import flightrec, tracer
 from ..utils import pod as podutils
 from .latency import DecisionLatencyTracker
 from .queues import Closed, StageQueue, queue_cap
@@ -109,53 +109,138 @@ class _DecisionStep:
     the optional on_decision hook (the traffic simulator's kubelet
     binder). Both the pipeline's plan thread and `SequentialLoop` run
     EXACTLY this code, which is what makes 'byte-identical to the
-    sequential reconcile' hold by construction."""
+    sequential reconcile' hold by construction.
 
-    def __init__(self, provisioner, latency: DecisionLatencyTracker, on_decision=None):
+    Telemetry plane (ISSUE 10): the whole step runs under one
+    ``decision`` trace root — the provisioner's reconcile root and the
+    solver's solve root JOIN it, so every span of the decision's
+    lifetime (including spans worker threads adopt via the captured
+    context) lands under one trace. At plan-emit time the step
+    assembles the decision's flight record. ``on_root`` (pipeline only)
+    receives the decision's TraceContext the moment the root opens —
+    the prewarm thread adopts it so the double buffer's speculative
+    work is attributed to the decision it overlaps."""
+
+    def __init__(
+        self,
+        provisioner,
+        latency: DecisionLatencyTracker,
+        on_decision=None,
+        kind: str = "sequential",
+        recorder=None,
+        on_root=None,
+    ):
         self.provisioner = provisioner
         self.latency = latency
         self.on_decision = on_decision
+        self.kind = kind
+        self.recorder = recorder if recorder is not None else flightrec.RECORDER
+        self.on_root = on_root
+        # the decision context of the step in flight / just finished —
+        # read only by the thread that called run() (the plan thread or
+        # the sequential loop), for enqueueing downstream work under
+        # this decision's trace
+        self.last_ctx = None
 
-    def run(self, tick: int) -> dict:
+    def run(self, tick: int, queue_wait_ms: Optional[float] = None) -> dict:
         t0 = time.perf_counter()
-        names, reason, results = self.provisioner.reconcile_with_results()
-        decided: List[str] = []
-        errored: List[str] = []
-        if results is not None:
-            for plan in getattr(results, "tpu_plans", []) or []:
-                if getattr(plan, "created_claim_name", None):
-                    decided.extend(p.uid for p in plan.pods)
-            for claim in results.new_node_claims:
-                if getattr(claim, "created_claim_name", None):
-                    decided.extend(p.uid for p in claim.pods)
-            for plan in getattr(results, "existing_plans", []) or []:
-                decided.extend(p.uid for p in getattr(plan, "pods", []) or [])
-            for ex in results.existing_nodes:
-                decided.extend(p.uid for p in ex.pods)
-            errored.extend(results.pod_errors.keys())
-        # decision point: the plan (or terminal error) is emitted
-        self.latency.pods_decided(decided, tick)
-        self.latency.pods_decided(errored, tick, error=True)
-        if self.on_decision is not None and results is not None:
-            # simulator hook (kubelet binder) — runs ON the authoritative
-            # thread, before the next tick's listing, in both modes
-            self.on_decision(tick, results)
+        with tracer.trace_root("decision", buffer_if="solve", tick=tick) as tr:
+            self.last_ctx = tracer.capture()
+            if self.on_root is not None:
+                self.on_root(self.last_ctx)
+            names, reason, results = self.provisioner.reconcile_with_results()
+            decided: List[str] = []
+            errored: List[str] = []
+            plan_cost = 0.0
+            if results is not None:
+                for plan in getattr(results, "tpu_plans", []) or []:
+                    if getattr(plan, "created_claim_name", None):
+                        decided.extend(p.uid for p in plan.pods)
+                        plan_cost += float(getattr(plan, "price", 0.0) or 0.0)
+                for claim in results.new_node_claims:
+                    if getattr(claim, "created_claim_name", None):
+                        decided.extend(p.uid for p in claim.pods)
+                for plan in getattr(results, "existing_plans", []) or []:
+                    decided.extend(p.uid for p in getattr(plan, "pods", []) or [])
+                for ex in results.existing_nodes:
+                    decided.extend(p.uid for p in ex.pods)
+                errored.extend(results.pod_errors.keys())
+            trace_id = tr.trace_id if tr is not None else None
+            # decision point: the plan (or terminal error) is emitted —
+            # the settled latencies feed the flight record, the trace_id
+            # rides the latency histogram as an exemplar
+            settled = self.latency.pods_decided(decided, tick, trace_id=trace_id)
+            settled += self.latency.pods_decided(
+                errored, tick, error=True, trace_id=trace_id
+            )
+            if self.on_decision is not None and results is not None:
+                # simulator hook (kubelet binder) — runs ON the authoritative
+                # thread, before the next tick's listing, in both modes
+                self.on_decision(tick, results)
         solver = None
         cached = getattr(self.provisioner, "_tpu_solver", None)
         if cached is not None:
             solver = cached[1]
         timings = getattr(solver, "last_timings", None) if solver is not None else None
+        step_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+        self._flight_record(
+            tick, tr, solver, settled, decided, errored, queue_wait_ms, plan_cost
+        )
         return {
             "tick": tick,
-            "step_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+            "step_ms": step_ms,
             "created": len(names),
             "decided": len(decided),
             "errors": len(errored),
             "reason": reason,
             "trace_id": (timings or {}).get("trace_id"),
+            "decision_trace_id": tr.trace_id if tr is not None else None,
             "solve_host_ms": round((timings or {}).get("host_ms", 0.0), 3),
             "solve_device_ms": round((timings or {}).get("device_ms", 0.0), 3),
         }
+
+    def _flight_record(
+        self, tick, tr, solver, settled, decided, errored, queue_wait_ms, plan_cost
+    ) -> None:
+        """Assemble the decision's flight record once the root closed
+        (so the root span's duration and every same-thread span are
+        final). Must never fail the decision."""
+        try:
+            from ..solver import stats as solver_stats
+
+            solve = solver_stats.solve_stats(solver) if solver is not None else {}
+            cost: dict = {}
+            if decided and plan_cost:
+                from ..solver import plancost
+
+                bound = (solve.get("pack_backend") or {}).get("lp_bound_sum")
+                gap = plancost.optimality_gap(plan_cost, bound) if bound else None
+                cost = {
+                    "plan_cost_per_hr": round(plan_cost, 4),
+                    "lp_bound_per_hr": round(bound, 4) if bound else None,
+                    "opt_gap_pct": round(gap * 100.0, 2) if gap is not None else None,
+                }
+            if tr is not None and queue_wait_ms:
+                # queue wait on the synthetic lane: visible in the trace
+                # viewer just before the root, excluded from breakdowns
+                tr.add_synthetic(
+                    "queue_wait",
+                    tr.start_ns - int(queue_wait_ms * 1e6),
+                    int(queue_wait_ms * 1e6),
+                )
+            self.recorder.record(
+                self.kind,
+                tick,
+                trace=tr,
+                solve=solve,
+                queue_wait_ms=queue_wait_ms,
+                latency_ms=[s * 1000.0 for s in settled],
+                pods_decided=len(decided),
+                errors=len(errored),
+                cost=cost,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail a decision
+            log.debug("flight-record assembly failed", exc_info=True)
 
 
 class ServingPipeline:
@@ -189,7 +274,16 @@ class ServingPipeline:
         self.telemetry_q = StageQueue(
             "telemetry", self.config.telemetry_queue_cap, depth_gauge
         )
-        self._step = _DecisionStep(provisioner, self.latency, on_decision)
+        burn_gauge = getattr(metrics, "decision_slo_burn", None)
+        if burn_gauge is not None:
+            flightrec.RECORDER.attach_burn_gauge(burn_gauge)
+        self._step = _DecisionStep(
+            provisioner,
+            self.latency,
+            on_decision,
+            kind="pipeline",
+            on_root=self._set_plan_ctx,
+        )
         # optional continuous-disruption stage (DisruptionController):
         # reconciled on the plan thread every `disrupt_every` ticks, so
         # the single-writer invariant holds for disruption's mutations
@@ -211,6 +305,11 @@ class ServingPipeline:
         self._ticks = 0
         self._step_inflight = False
         self._ingested = 0
+        # the in-flight decision's TraceContext (the prewarm handshake's
+        # trace half): stamped by the decision root's on_root hook on
+        # the plan thread, adopted by the prewarm thread so the double
+        # buffer's speculative encode lands on the decision it overlaps
+        self._plan_ctx = None
         # ingest → prewarm handoff: pods seen pending since the last
         # prewarm pass. Only NEW pods can have cold memos/signature
         # rows, so the speculative encode walks the delta, never the
@@ -310,20 +409,28 @@ class ServingPipeline:
                 self._step_inflight = True
             self._encode_done_evt.clear()
             try:
-                rec = self._step.run(tick)
+                rec = self._step.run(tick, queue_wait_ms=queue_wait_ms)
             except Exception:  # noqa: BLE001 — one failed tick must not kill serving
                 log.exception("serving tick %d failed", tick)
                 rec = {"tick": tick, "error": True}
             finally:
+                self._set_plan_ctx(None)
                 with self._mu:
                     self._step_inflight = False
                 self._encode_done_evt.set()
             self._maybe_disrupt(tick, rec)
             rec["queue_wait_ms"] = queue_wait_ms
             try:
-                self.telemetry_q.put(rec, timeout=1.0)
+                # the decision's context rides the entry: the telemetry
+                # stage adopts it, so its drain work lands on the
+                # decision's trace (its own lane, after the root)
+                self.telemetry_q.put(rec, timeout=1.0, ctx=self._step.last_ctx)
             except Closed:
                 return
+
+    def _set_plan_ctx(self, ctx) -> None:
+        with self._mu:
+            self._plan_ctx = ctx
 
     def _maybe_disrupt(self, tick: int, rec: dict) -> None:
         """Continuous-disruption stage: one DisruptionController pass on
@@ -345,6 +452,17 @@ class ServingPipeline:
         if executed:
             rec["disrupt_method"] = executed
         stats = getattr(self.disruption, "last_decision_stats", None)
+        try:
+            self._step.recorder.record(
+                "disrupt",
+                tick,
+                trace=getattr(self.disruption, "last_trace", None),
+                solve={"disruption": dict(stats) if stats else None},
+                pods_decided=0,
+                executed=executed,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail the pass
+            log.debug("disrupt flight-record failed", exc_info=True)
         with self._mu:
             self._disrupt_log.append(
                 {
@@ -364,14 +482,16 @@ class ServingPipeline:
     def _telemetry_loop(self) -> None:
         while True:
             try:
-                rec = self.telemetry_q.get(timeout=0.2)
+                entry = self.telemetry_q.get_entry(timeout=0.2)
             except Closed:
                 return
-            if rec is None:
+            if entry is None:
                 if self._stop_evt.is_set() and self.telemetry_q.depth() == 0:
                     return
                 continue
-            self._record_telemetry(rec)
+            rec, ctx = entry
+            with tracer.adopt(ctx, "telemetry.drain", tick=rec.get("tick")):
+                self._record_telemetry(rec)
 
     def _record_telemetry(self, rec: dict) -> None:
         trace_id = rec.get("trace_id")
@@ -415,7 +535,14 @@ class ServingPipeline:
                 self._new_pods_evt.set()
                 continue
             try:
-                self._prewarm_once()
+                # adopt the overlapped decision's context (None → the
+                # prewarm's own never-buffered roots, as before): the
+                # speculative encode shows up on its own lane of the
+                # decision it double-buffers
+                with self._mu:
+                    ctx = self._plan_ctx
+                with tracer.adopt(ctx, "prewarm"):
+                    self._prewarm_once()
             except Exception:  # noqa: BLE001 — speculation must never break serving
                 log.debug("serving prewarm failed", exc_info=True)
 
@@ -602,6 +729,11 @@ class ServingPipeline:
                 "every": self.config.disrupt_every,
                 "attached": self.disruption is not None,
                 "last_passes": disrupt_log,
+            },
+            "flightrec": {
+                "coverage": self._step.recorder.coverage(kind="pipeline"),
+                "burn_rate": self._step.recorder.burn_rates(),
+                "retained": len(self._step.recorder),
             },
         }
 

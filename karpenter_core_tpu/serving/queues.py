@@ -7,6 +7,13 @@ instead of buffering unboundedly (a slow solve stage slows batch
 formation, which slows ingest, which blocks the watch callback — the
 producer feels the pipeline's true capacity). Caps are env-tunable via
 ``KARPENTER_TPU_SERVING_<NAME>_CAP``.
+
+Trace propagation (ISSUE 10): every ``put`` captures the producer's
+``TraceContext`` (or takes an explicit one) into the queue entry, so a
+consumer that calls ``get_entry`` can re-adopt the producing decision's
+trace on its own thread — the queue is the stage boundary, so it is
+also where the trace crosses. Plain ``get`` unwraps the item and drops
+the context (existing consumers unchanged).
 """
 
 from __future__ import annotations
@@ -15,7 +22,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Optional, Tuple
+
+from ..tracing import tracer
 
 
 class Closed(Exception):
@@ -57,9 +66,16 @@ class StageQueue:
         if self._depth_gauge is not None:
             self._depth_gauge.set(float(depth), stage=self.name)
 
-    def put(self, item, timeout: Optional[float] = None) -> bool:
+    def put(self, item, timeout: Optional[float] = None, ctx=None) -> bool:
         """Enqueue, blocking while full (backpressure). Returns False on
-        timeout, True otherwise. Raises Closed after close()."""
+        timeout, True otherwise. Raises Closed after close().
+
+        The producer's active ``TraceContext`` is captured into the
+        entry (``ctx`` overrides it — e.g. a context snapshotted before
+        the producer's trace root closed); ``get_entry`` hands it to the
+        consumer for re-adoption."""
+        if ctx is None:
+            ctx = tracer.capture()
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             blocked = False
@@ -73,7 +89,7 @@ class StageQueue:
                 self._cv.wait(timeout=remaining)
             if self._closed:
                 raise Closed(self.name)
-            self._items.append(item)
+            self._items.append((item, ctx))
             self._total_puts += 1
             depth = len(self._items)
             if depth > self._high_water:
@@ -82,10 +98,12 @@ class StageQueue:
             self._cv.notify_all()
             return True
 
-    def get(self, timeout: Optional[float] = None):
-        """Dequeue, blocking while empty. Returns the item, or None on
-        timeout (stages enqueue only non-None work items). Raises
-        Closed once the queue is closed AND drained."""
+    def get_entry(self, timeout: Optional[float] = None) -> Optional[Tuple[object, object]]:
+        """Dequeue one (item, trace context) entry, blocking while
+        empty. Returns None on timeout; raises Closed once the queue is
+        closed AND drained. The context is the producer's capture (None
+        when the producer was untraced) — adopt it to land this stage's
+        spans under the producing decision's root."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while not self._items:
@@ -95,10 +113,17 @@ class StageQueue:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._cv.wait(timeout=remaining)
-            item = self._items.popleft()
+            entry = self._items.popleft()
             self._set_gauge(len(self._items))
             self._cv.notify_all()
-            return item
+            return entry
+
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue, blocking while empty. Returns the item, or None on
+        timeout (stages enqueue only non-None work items). Raises
+        Closed once the queue is closed AND drained."""
+        entry = self.get_entry(timeout=timeout)
+        return entry[0] if entry is not None else None
 
     def close(self) -> None:
         """Wake every waiter; subsequent puts raise, gets drain then

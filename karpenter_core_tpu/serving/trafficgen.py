@@ -911,6 +911,12 @@ def run_measurement(
     if rr.stage_stats:
         out["queues"] = rr.stage_stats.get("queues", {})
         out["prewarm"] = rr.stage_stats.get("prewarm", {})
+        # flight-recorder health (ISSUE 10 acceptance: timeline
+        # reconstruction coverage + orphan spans over the whole run)
+        out["flightrec"] = rr.stage_stats.get("flightrec", {})
+        from ..tracing import tracer as _tracer
+
+        out["orphan_spans"] = _tracer.orphan_spans()
         agg: dict = {}
         for tick_rec in rr.stage_stats.get("last_ticks", []):
             agg["batch_wait"] = agg.get("batch_wait", 0.0) + tick_rec.get(

@@ -1561,9 +1561,14 @@ class TPUScheduler:
 
         t0 = _time.perf_counter()
         self._cstats = incremental.CacheStats()
-        pools, pool_catalogs = self._build_pools()
         cg = getattr(self.cloud_provider, "catalog_generation", None)
-        with tracer.trace_root("prewarm_catalog", buffer_if="never", pools=len(pools)):
+        # _build_pools spans (encode.pool_templates) must run INSIDE the
+        # root: on the serving prewarm thread there is no enclosing
+        # trace, and a span opened before the root is an orphan (the
+        # tracer counts those now — the serving identity tests gate on
+        # zero)
+        with tracer.trace_root("prewarm_catalog", buffer_if="never"):
+            pools, pool_catalogs = self._build_pools()
             with _CATALOG_LOCK:
                 with tracer.span("encode.catalog"):
                     for pool, cat in zip(pools, pool_catalogs):

@@ -1,0 +1,106 @@
+"""Consolidated per-solve stats (ISSUE 10 satellite): one stable schema
+over the stat blobs that accreted per-PR on the solver — ``last_timings``
+(PR 1), ``last_merge_stats`` (PR 2), ``last_cache_stats`` (PR 4),
+``last_pack_stats`` (PR 8) — plus the disruption engine's
+``last_decision_stats`` (PR 7) when a controller is wired in.
+
+Consumers:
+
+- ``/debug/solve/stats`` (operator/server.py) serves exactly this dict;
+- ``bench.py _split`` derives its per-config columns from it (the
+  emitted BENCH keys are unchanged, so round-over-round trajectories
+  stay comparable — see ``bench_fields``);
+- the flight recorder (tracing/flightrec.py) embeds it per decision.
+
+Schema discipline: every top-level key below is always present (empty
+dict / None when the layer didn't run), and additions bump ``SCHEMA``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+SCHEMA = 1
+
+
+def _round3(v) -> float:
+    try:
+        return round(float(v), 3)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def solve_stats(solver, disruption=None) -> dict:
+    """The stable consolidated view of ``solver``'s most recent solve.
+    ``disruption`` (optional DisruptionController or anything exposing
+    ``last_decision_stats``) contributes the last disruption decision."""
+    t = getattr(solver, "last_timings", None) or {}
+    cs = getattr(solver, "last_cache_stats", None) or {}
+    ms = getattr(solver, "last_merge_stats", None) or {}
+    ps = getattr(solver, "last_pack_stats", None) or {}
+    dstats = getattr(disruption, "last_decision_stats", None) if disruption is not None else None
+    return {
+        "schema": SCHEMA,
+        "trace_id": t.get("trace_id"),
+        "timings": {
+            "total_ms": _round3(t.get("total_ms", 0.0)),
+            "device_ms": _round3(t.get("device_ms", 0.0)),
+            "host_ms": _round3(t.get("host_ms", 0.0)),
+        },
+        "cache": {
+            "hits": dict(cs.get("hits", {})),
+            "misses": dict(cs.get("misses", {})),
+            "evictions": dict(cs.get("evictions", {})),
+            "hit_rate": cs.get("hit_rate"),
+        },
+        "merge": {
+            "ms": _round3(ms.get("merge_ms", 0.0)),
+            "engine": ms.get("merge_engine"),
+            "records": int(ms.get("merge_records", 0) or 0),
+            "candidates_screened": int(ms.get("merge_candidates_screened", 0) or 0),
+            "pairs_applied": int(ms.get("merge_pairs_applied", 0) or 0),
+        },
+        "pack_backend": dict(ps),
+        "disruption": dict(dstats) if dstats else None,
+    }
+
+
+def bench_fields(stats: dict) -> dict:
+    """Project the consolidated schema onto the flat per-config BENCH
+    columns (``device_ms``/``host_ms``/``cache_*``/``merge_*``/
+    ``pack_backend``) the round artifacts have carried since PR 1-8 —
+    the bench readers consume the stable schema, the emitted artifact
+    keys stay byte-compatible with prior rounds."""
+    out: dict = {}
+    t = stats.get("timings", {})
+    out["device_ms"] = round(t.get("device_ms", 0.0), 2)
+    out["host_ms"] = round(t.get("host_ms", 0.0), 2)
+    cache = stats.get("cache", {})
+    if cache.get("hits") or cache.get("misses"):
+        out["cache_hits"] = dict(cache.get("hits", {}))
+        out["cache_misses"] = dict(cache.get("misses", {}))
+        if cache.get("hit_rate") is not None:
+            out["cache_hit_rate"] = cache["hit_rate"]
+    ps = stats.get("pack_backend", {})
+    if ps and ps.get("backend") not in (None, "ffd"):
+        out["pack_backend"] = dict(ps)
+    merge = stats.get("merge", {})
+    out["merge_ms"] = round(merge.get("ms", 0.0), 2)
+    out["merge_candidates_screened"] = merge.get("candidates_screened", 0)
+    out["merge_pairs_applied"] = merge.get("pairs_applied", 0)
+    if merge.get("engine"):
+        out["merge_engine"] = merge["engine"]
+    return out
+
+
+def route_payload(solver_ref, disruption_ref=None) -> Optional[dict]:
+    """The /debug/solve/stats payload builder: ``solver_ref`` /
+    ``disruption_ref`` are zero-arg callables resolving the CURRENT
+    solver / disruption controller (the operator swaps solvers when the
+    nodepool set changes, so the route must re-resolve per request).
+    Returns None when no solver has solved yet (route answers 404)."""
+    solver = solver_ref() if callable(solver_ref) else solver_ref
+    if solver is None or not getattr(solver, "last_timings", None):
+        return None
+    disruption = disruption_ref() if callable(disruption_ref) else disruption_ref
+    return solve_stats(solver, disruption)

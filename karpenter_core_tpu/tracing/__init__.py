@@ -6,21 +6,31 @@ metrics bridge into ``solver_phase_duration`` and slow-solve capture
 to disk.
 
 Layers:
-  tracer.py  — thread-local span stack, monotonic clocks, ring buffer
-  export.py  — Chrome trace-event JSON (catapult TraceEvent format)
-  capture.py — slow-solve persistence behind env knobs
+  tracer.py    — thread-local span stack, monotonic clocks, ring buffer,
+                 cross-thread TraceContext capture/adopt + orphan counter
+  flightrec.py — per-decision flight recorder: bounded ring of decision
+                 records, SLO burn-rate windows, breach dumps
+  export.py    — Chrome trace-event JSON (catapult TraceEvent format)
+  capture.py   — slow-solve persistence behind env knobs
 """
 
 from .tracer import (  # noqa: F401
     RING,
     Span,
     Trace,
+    TraceContext,
     TraceRing,
+    adopt,
+    capture,
     current_trace,
     current_trace_id,
     enabled,
+    orphan_recent,
+    orphan_spans,
+    reset_orphans,
     span,
     trace_root,
 )
 from .export import to_chrome_events, to_chrome_json  # noqa: F401
 from .capture import maybe_capture  # noqa: F401
+from .flightrec import RECORDER, DecisionRecord, FlightRecorder  # noqa: F401

@@ -18,6 +18,27 @@ Design constraints (the tracer instruments a path whose whole budget is
 - Completed traces land in a fixed-capacity ring buffer (newest-wins)
   read by the ``/debug/traces`` routes; nothing is retained beyond it
   unless the slow-solve capture persists a copy.
+- The system is concurrent (serving stage threads, fleet worker lanes,
+  the prewarm double buffer), but the tracer stays thread-local: a
+  worker thread joins a decision's trace only by *adopting* an explicit
+  ``TraceContext`` captured where the work was enqueued
+  (``capture()``/``adopt()``). Adopted spans land on their own thread
+  lane of the shared trace; they are linked children of the capture
+  point but never subtract from its self time (concurrent time is not
+  nested time), so the root lane's self times still partition the root
+  span exactly. Spans born on a thread with no active root and no
+  adopted context are *orphans*: they vanish from every trace, which is
+  an attribution bug — they are counted
+  (``karpenter_tpu_tracer_orphan_spans_total`` via the metrics bridge)
+  so the serving/fleet identity tests can assert the count stays zero.
+
+Cross-thread mutation discipline: a ``Trace`` is deliberately lock-free.
+Every mutation reachable from an adopted (foreign-thread) context is a
+single GIL-atomic operation — ``spans.append``, ``links.append``,
+``args[k] = v``, ``contains_solve = True`` — and ``parent.child_ns``
+accumulation only ever happens between spans on the SAME thread's
+stack. Readers (/debug routes, the flight recorder) consume traces
+after the root finished, or tolerate a momentarily-short span list.
 
 The metrics bridge: a trace may carry a histogram sink (the scheduler's
 ``solver_phase_duration``); every completed span is observed under
@@ -43,6 +64,44 @@ from typing import Dict, List, Optional
 SYNTHETIC_TID = -1
 
 _trace_counter = itertools.count(1)
+
+# -- orphan-span accounting (ISSUE 10 satellite) ----------------------------
+# A span on a thread with no active trace used to vanish silently; with
+# cross-thread context propagation in place that is always an attribution
+# bug, so it is counted. The counter is process-global (the metrics
+# registry bridges it into karpenter_tpu_tracer_orphan_spans_total) and
+# resettable so tests can assert "this scenario orphaned nothing".
+_orphan_mu = threading.Lock()
+_orphan_total = 0
+_orphan_recent: List[str] = []  # last few orphaned span names (debugging)
+_ORPHAN_RECENT_KEEP = 16
+
+
+def _count_orphan(name: str) -> None:
+    global _orphan_total
+    with _orphan_mu:
+        _orphan_total += 1
+        _orphan_recent.append(name)
+        del _orphan_recent[:-_ORPHAN_RECENT_KEEP]
+
+
+def orphan_spans() -> int:
+    """Spans dropped because no trace was active on their thread."""
+    with _orphan_mu:
+        return _orphan_total
+
+
+def orphan_recent() -> List[str]:
+    """Names of the most recently orphaned spans (newest last)."""
+    with _orphan_mu:
+        return list(_orphan_recent)
+
+
+def reset_orphans() -> None:
+    global _orphan_total
+    with _orphan_mu:
+        _orphan_total = 0
+        _orphan_recent.clear()
 
 
 def enabled() -> bool:
@@ -94,6 +153,8 @@ class Trace:
         "record",
         "contains_solve",
         "args",
+        "root_tid",
+        "links",
     )
 
     def __init__(self, name: str, trace_id: Optional[str] = None, metrics_sink=None, record: bool = True, **args):
@@ -110,6 +171,19 @@ class Trace:
         self.record = record
         self.contains_solve = False
         self.args = dict(args)
+        # thread the root span runs on: the authoritative lane whose
+        # self times partition the root duration. None until trace_root
+        # installs the trace (directly-constructed Traces keep the
+        # pre-adoption behavior: every lane counts).
+        self.root_tid: Optional[int] = None
+        # trace_ids of related traces/contexts (e.g. the N tenant solves
+        # coalesced into one mega-dispatch) — appended GIL-atomically
+        self.links: List[dict] = []
+
+    def add_link(self, trace_id: str, **meta) -> None:
+        """Record a relation to another trace (batched work serving many
+        decisions, a probe serving a foreign decision, ...)."""
+        self.links.append({"trace_id": trace_id, **meta})
 
     # -- accounting ---------------------------------------------------------
 
@@ -128,14 +202,31 @@ class Trace:
         return s
 
     def phase_breakdown_ms(self) -> Dict[str, float]:
-        """Self-time per span name, in ms. Synthetic spans are excluded,
-        so the values sum to the root span's duration (≈ host + device
-        wall time: device waits are real measured spans)."""
+        """Self-time per span name on the ROOT lane, in ms. Synthetic
+        spans and adopted foreign-thread lanes are excluded, so the
+        values sum to the root span's duration (≈ host + device wall
+        time: device waits are real measured spans; concurrent lanes
+        overlap the root and would double-count)."""
         out: Dict[str, float] = {}
+        root_tid = self.root_tid
         for s in self.spans:
             if s.tid == SYNTHETIC_TID:
                 continue
+            if root_tid is not None and s.tid != root_tid:
+                continue
             out[s.name] = out.get(s.name, 0.0) + s.self_ns / 1e6
+        return out
+
+    def lane_breakdown_ms(self) -> Dict[int, Dict[str, float]]:
+        """Per-thread-lane self-time breakdowns (the flight recorder's
+        concurrent-lane attribution). Keys are thread idents; the root
+        lane is present under ``root_tid``; synthetic spans excluded."""
+        out: Dict[int, Dict[str, float]] = {}
+        for s in self.spans:
+            if s.tid == SYNTHETIC_TID:
+                continue
+            lane = out.setdefault(s.tid, {})
+            lane[s.name] = lane.get(s.name, 0.0) + s.self_ns / 1e6
         return out
 
     def device_ms(self) -> float:
@@ -195,22 +286,127 @@ RING = TraceRing(int(os.environ.get("KARPENTER_TPU_TRACE_BUFFER", "32")))
 
 _tls = threading.local()
 
+# sentinel trace installed while recording is disabled (KARPENTER_TPU_TRACE=0
+# with no metrics sink): inner spans must neither record nor count as
+# orphans — the whole subtree is deliberately off, not lost. record=False
+# keeps span() from appending to it; the object is shared process-wide and
+# never buffered.
+_DISABLED = Trace("disabled", trace_id="disabled", record=False)
+
 
 def current_trace() -> Optional[Trace]:
-    return getattr(_tls, "trace", None)
+    tr = getattr(_tls, "trace", None)
+    return None if tr is _DISABLED else tr
 
 
 def current_trace_id() -> Optional[str]:
     tr = getattr(_tls, "trace", None)
-    return tr.trace_id if tr is not None else None
+    return tr.trace_id if tr is not None and tr is not _DISABLED else None
+
+
+class TraceContext:
+    """An explicit handoff of 'where this work belongs': the active
+    trace and the innermost open span at capture time. Immutable — the
+    one legal way a trace crosses a thread boundary (queue items, the
+    prewarm handshake, fleet lane submissions carry one; the consuming
+    thread re-enters the trace with ``adopt``)."""
+
+    __slots__ = ("trace", "parent")
+
+    def __init__(self, trace: Trace, parent: Optional[Span]):
+        self.trace = trace
+        self.parent = parent
+
+    @property
+    def trace_id(self) -> str:
+        return self.trace.trace_id
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"TraceContext({self.trace_id!r}, parent={self.parent and self.parent.name!r})"
+
+
+def capture() -> Optional[TraceContext]:
+    """Snapshot the calling thread's active trace + innermost span for
+    re-adoption on another thread. None when nothing is being traced
+    (the consumer's ``adopt`` then degrades to a no-op)."""
+    tr = getattr(_tls, "trace", None)
+    if tr is None or tr is _DISABLED:
+        return None
+    stack: List[Span] = getattr(_tls, "stack", [])
+    return TraceContext(tr, stack[-1] if stack else None)
+
+
+@contextmanager
+def adopt(ctx: Optional[TraceContext], name: str, **args):
+    """Re-enter a captured trace on the consuming thread.
+
+    The adopted region opens one anchor span (``name``) parented at the
+    capture point and runs on this thread's own lane of the shared
+    trace; nested ``span()``/``trace_root()`` calls inside join it
+    normally. The anchor's duration is NOT added to the capture-point
+    parent's child time — the lanes run concurrently, so adopted time
+    must not eat the root lane's self time.
+
+    Degrades safely: ``ctx`` None → pass-through (yields None); the
+    captured trace already active on this thread → a plain nested span;
+    a DIFFERENT trace active here → a span on the active trace carrying
+    the foreign trace_id as a link (a thread cannot serve two traces,
+    but the relation is recorded on both)."""
+    if ctx is None:
+        yield None
+        return
+    tr = getattr(_tls, "trace", None)
+    if tr is not None and tr is not _DISABLED:
+        if tr is ctx.trace:
+            with span(name, **args) as s:
+                yield s
+            return
+        # cross-trace: record the relation both ways, stay on the
+        # thread's own trace (batched work serving many decisions)
+        tr.add_link(ctx.trace_id, via=name)
+        ctx.trace.add_link(tr.trace_id, via=name)
+        with span(name, link=ctx.trace_id, **args) as s:
+            yield s
+        return
+    prev = tr  # None or _DISABLED: both restored verbatim on exit
+    target = ctx.trace
+    anchor = Span(
+        name,
+        time.perf_counter_ns(),
+        threading.get_ident(),
+        (ctx.parent.depth + 1) if ctx.parent is not None else 0,
+        ctx.parent,
+        args or None,
+    )
+    _tls.trace = target
+    _tls.stack = [anchor]
+    try:
+        yield anchor
+    finally:
+        anchor.dur_ns = time.perf_counter_ns() - anchor.ts_ns
+        # concurrent lane: linked to ctx.parent for tree reconstruction,
+        # deliberately absent from its child_ns (see docstring)
+        if target.record:
+            target.spans.append(anchor)
+        sink = target.metrics_sink
+        if sink is not None:
+            sink.observe(anchor.dur_ns / 1e9, phase=name)
+        _tls.trace = prev
+        _tls.stack = []
 
 
 @contextmanager
 def span(name: str, **args):
     """Time a region of the active trace. No active trace on this
-    thread → pure pass-through (one thread-local read)."""
+    thread → pass-through, but counted as an orphan (with context
+    propagation in place, a span that vanishes is an attribution bug —
+    see module docstring)."""
     tr = getattr(_tls, "trace", None)
     if tr is None:
+        _count_orphan(name)
+        yield None
+        return
+    if tr is _DISABLED:
         yield None
         return
     stack: List[Span] = _tls.stack
@@ -254,8 +450,9 @@ def trace_root(
     On finish the slow-solve capture (capture.py) sees every
     buffered trace.
     """
-    tr = getattr(_tls, "trace", None)
-    if tr is not None:
+    prev = getattr(_tls, "trace", None)
+    if prev is not None and prev is not _DISABLED:
+        tr = prev
         if metrics_sink is not None and tr.metrics_sink is None:
             tr.metrics_sink = metrics_sink
         if is_solve:
@@ -266,17 +463,25 @@ def trace_root(
 
     record = enabled()
     if not record and metrics_sink is None:
-        # nothing to record and nothing to observe: keep the whole
-        # trace a no-op (one env read per solve) so the disabled mode
-        # is genuinely free
-        yield None
+        # nothing to record and nothing to observe: park the disabled
+        # sentinel so inner spans are cheap pass-throughs instead of
+        # counted orphans (one env read per solve — the disabled mode
+        # stays genuinely free)
+        _tls.trace = _DISABLED
+        _tls.stack = []
+        try:
+            yield None
+        finally:
+            _tls.trace = prev
+            _tls.stack = []
         return
 
     tr = Trace(name, metrics_sink=metrics_sink, record=record, **args)
     tr.contains_solve = is_solve
+    tr.root_tid = threading.get_ident()
     _tls.trace = tr
     _tls.stack = []
-    root = Span(name, tr.start_ns, threading.get_ident(), 0, None, args or None)
+    root = Span(name, tr.start_ns, tr.root_tid, 0, None, args or None)
     _tls.stack.append(root)
     try:
         yield tr
@@ -288,7 +493,7 @@ def trace_root(
         sink = tr.metrics_sink
         if sink is not None:
             sink.observe(root.dur_ns / 1e9, phase=name)
-        _tls.trace = None
+        _tls.trace = prev
         _tls.stack = []
         if tr.record and (
             buffer_if == "always" or (buffer_if == "solve" and tr.contains_solve)

@@ -1,0 +1,165 @@
+"""Perf-regression ledger (hack/bench_ledger.py, ISSUE 10 tentpole):
+the BENCH_r01-r07 artifacts parse into one normalized trajectory table
+(including tail-recovery of the front-truncated rounds), `--check`
+passes on the real history, and a synthetic 20% p50 regression (and a
+lost plan-identity gate) demonstrably fail it. Tier-1: this is the gate
+that keeps the next PR from silently losing PR-2/4/7's wins."""
+
+import importlib.util
+import json
+import os
+import shutil
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_ledger", os.path.join(REPO, "hack", "bench_ledger.py")
+)
+ledger = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ledger)
+
+
+def _real_rounds():
+    return sorted(
+        f for f in os.listdir(REPO) if f.startswith("BENCH_r") and f.endswith(".json")
+    )
+
+
+class TestParsing:
+    def test_balanced_brace_extraction_respects_strings(self):
+        text = 'garbage{"config": "1: a {weird} name", "x": 1}{"config": "2: b", "y": {"z": 2}}trunc{"config": "3'
+        objs = ledger.extract_json_objects(text, '{"config"')
+        assert [o["config"] for o in objs] == ["1: a {weird} name", "2: b"]
+        assert objs[1]["y"] == {"z": 2}
+
+    def test_all_seven_rounds_parse(self):
+        rounds = [
+            ledger.parse_round(os.path.join(REPO, f)) for f in _real_rounds()
+        ]
+        assert len(rounds) >= 7
+        by_round = {r["round"]: r for r in rounds}
+        # r01 is the TPU-unavailable error round: retained, zero rows
+        assert by_round[1]["status"] == "error" and not by_round[1]["configs"]
+        # r03-r05 are front-truncated envelopes: configs recovered from
+        # the tail, backend recovered from the engines block
+        for n in (3, 4, 5):
+            assert by_round[n]["status"] == "recovered", by_round[n]
+            assert len(by_round[n]["configs"]) >= 4
+        assert by_round[3]["backend"] == "tpu"
+        assert by_round[4]["backend"] == "cpu"
+        # r06+ carry the full parsed payload
+        for n in (6, 7):
+            assert by_round[n]["status"] == "ok"
+            assert len(by_round[n]["configs"]) >= 10
+            assert by_round[n]["headline"].get("warm_ms")
+
+    def test_table_is_normalized_and_nontrivial(self):
+        built = ledger.build_ledger(REPO, 0.15)
+        rows = built["table"]
+        assert len(rows) > 500
+        for row in rows[:50]:
+            assert set(row) == {"round", "backend", "config", "metric", "value"}
+            assert isinstance(row["value"], float)
+        # the tpu round's rows never mix into the cpu trajectory
+        traj = ledger.trajectories(rows)
+        key_cpu = ("cpu", "config3", "pods_per_sec")
+        key_tpu = ("tpu", "config3", "pods_per_sec")
+        assert key_cpu in traj and key_tpu in traj
+        assert set(traj[key_tpu]) == {3}
+
+
+class TestCheck:
+    def test_check_passes_on_real_artifacts(self, tmp_path):
+        rc = ledger.main(
+            [
+                "--dir", REPO,
+                "--out", str(tmp_path / "LEDGER.json"),
+                "--md", str(tmp_path / "LEDGER.md"),
+                "--check",
+            ]
+        )
+        assert rc == 0
+        doc = json.loads((tmp_path / "LEDGER.json").read_text())
+        assert doc["schema"] == ledger.SCHEMA
+        assert doc["failures"] == []
+        assert len(doc["rounds"]) >= 7
+        md = (tmp_path / "LEDGER.md").read_text()
+        assert "Gate-metric trends" in md
+        assert "**PASS**" in md
+
+    def _fixture_dir(self, tmp_path, mutate):
+        """Copies of the real r06/r07 + a synthetic r08 whose parsed
+        payload is r07's mutated by ``mutate(payload)``."""
+        d = tmp_path / "bench"
+        d.mkdir()
+        for n in (6, 7):
+            shutil.copy(os.path.join(REPO, f"BENCH_r0{n}.json"), d / f"BENCH_r0{n}.json")
+        with open(os.path.join(REPO, "BENCH_r07.json")) as f:
+            doc = json.load(f)
+        mutate(doc["parsed"])
+        (d / "BENCH_r08.json").write_text(json.dumps(doc))
+        return str(d)
+
+    def test_synthetic_20pct_p50_regression_fails(self, tmp_path):
+        def slow_down(parsed):
+            parsed["warm_ms"] = round(parsed["warm_ms"] * 1.20, 1)  # +20% > 15% gate
+            for cfg in parsed["configs"]:
+                if str(cfg.get("config", "")).startswith("7:"):
+                    cfg["warm_tick_host_ms_p50"] = round(
+                        cfg["warm_tick_host_ms_p50"] * 1.20, 2
+                    )
+
+        d = self._fixture_dir(tmp_path, slow_down)
+        rc = ledger.main(
+            ["--dir", d, "--out", str(tmp_path / "L.json"), "--md", str(tmp_path / "L.md"), "--check"]
+        )
+        assert rc == 1
+        doc = json.loads((tmp_path / "L.json").read_text())
+        failed = {(f["config"], f["metric"]) for f in doc["failures"]}
+        assert ("headline", "warm_ms") in failed
+        assert ("config7", "warm_tick_host_ms_p50") in failed
+        md = (tmp_path / "L.md").read_text()
+        assert "**FAIL**" in md
+
+    def test_synthetic_identity_loss_fails_absolute_gate(self, tmp_path):
+        def lose_identity(parsed):
+            for cfg in parsed["configs"]:
+                if str(cfg.get("config", "")).startswith("11:"):
+                    cfg["plan_identical_all"] = False
+
+        d = self._fixture_dir(tmp_path, lose_identity)
+        rc = ledger.main(
+            ["--dir", d, "--out", str(tmp_path / "L.json"), "--md", str(tmp_path / "L.md"), "--check"]
+        )
+        assert rc == 1
+        doc = json.loads((tmp_path / "L.json").read_text())
+        assert any(
+            f["config"] == "config11" and f["metric"] == "plan_identical_all"
+            for f in doc["failures"]
+        )
+
+    def test_within_threshold_change_passes(self, tmp_path):
+        def wiggle(parsed):
+            parsed["warm_ms"] = round(parsed["warm_ms"] * 1.05, 1)  # +5% < 15%
+
+        d = self._fixture_dir(tmp_path, wiggle)
+        rc = ledger.main(
+            ["--dir", d, "--out", str(tmp_path / "L.json"), "--md", str(tmp_path / "L.md"), "--check"]
+        )
+        assert rc == 0
+
+    def test_empty_dir_is_an_error(self, tmp_path):
+        assert ledger.main(["--dir", str(tmp_path), "--check"]) == 2
+
+
+class TestCommittedLedger:
+    def test_committed_ledger_is_current(self):
+        """BENCH_LEDGER.json in the repo matches a fresh build over the
+        committed artifacts (regenerate with `python
+        hack/bench_ledger.py` after adding a round)."""
+        path = os.path.join(REPO, "BENCH_LEDGER.json")
+        assert os.path.exists(path), "run hack/bench_ledger.py to generate the ledger"
+        committed = json.loads(open(path).read())
+        built = ledger.build_ledger(REPO, committed.get("threshold", 0.15))
+        assert committed["table"] == built["table"]
+        assert committed["failures"] == []

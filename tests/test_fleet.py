@@ -101,7 +101,12 @@ def _plan_keys(outcome):
 def test_batched_vs_solo_plan_identity(seed, monkeypatch):
     """N tenants with mixed catalog archetypes (different vocab sizes),
     one of them mutating its catalog between rounds: every tenant's
-    batched plans equal its solo plans, every round."""
+    batched plans equal its solo plans, every round. Also the ISSUE 10
+    orphan gate: every span emitted on a fleet worker lane or dispatcher
+    flush attaches to a trace — the propagation layer may not lose one."""
+    from karpenter_core_tpu.tracing import tracer
+
+    tracer.reset_orphans()
 
     def run(mode):
         _engine(mode, monkeypatch)
@@ -138,6 +143,8 @@ def test_batched_vs_solo_plan_identity(seed, monkeypatch):
     solo = run("solo")
     batched = run("batched")
     assert batched == solo
+    # zero orphaned spans across both engines (lockstep fleet gate)
+    assert tracer.orphan_spans() == 0, tracer.orphan_recent()
 
 
 # ---------------------------------------------------------------------------

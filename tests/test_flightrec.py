@@ -1,0 +1,269 @@
+"""Per-decision flight recorder (tracing/flightrec.py, ISSUE 10
+tentpole) + its operational surface: record assembly (timeline
+reconstruction, queue-wait vs compute, cache/backend digest), the
+bounded ring, SLO burn-rate windows and gauges, breach dumps, the
+/debug/decisions[/last] and /debug/solve/stats routes, exemplar
+trace_ids on the decision-latency histogram, and the env-tunable
+latency buckets satellite."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_core_tpu.metrics.registry import (
+    DURATION_BUCKETS,
+    Metrics,
+    Registry,
+    latency_buckets,
+)
+from karpenter_core_tpu.operator.server import OperationalServer
+from karpenter_core_tpu.tracing import flightrec, tracer
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def _decision_trace(work_s=0.002, lane=False):
+    with tracer.trace_root("decision") as tr:
+        ctx = tracer.capture()
+        if lane:
+            done = threading.Event()
+
+            def worker():
+                with tracer.adopt(ctx, "prewarm"):
+                    time.sleep(work_s)
+                done.set()
+
+            threading.Thread(target=worker).start()
+        with tracer.span("solve"):
+            time.sleep(work_s)
+        if lane:
+            done.wait(5.0)
+    return tr
+
+
+class TestRecordAssembly:
+    def test_timeline_reconstructs_and_sums_to_wall(self):
+        tr = _decision_trace()
+        rec = flightrec.FlightRecorder(capacity=8).record(
+            "pipeline", 3, trace=tr, latency_ms=[4.0, 12.0], queue_wait_ms=1.5,
+            pods_decided=2,
+        )
+        tl = rec["timeline"]
+        assert rec["decision_id"] == tr.trace_id
+        assert rec.reconstructed
+        assert abs(tl["stages_sum_ms"] - tl["wall_ms"]) <= max(0.01 * tl["wall_ms"], 0.05)
+        assert tl["queue_wait_ms"] == 1.5
+        assert "solve" in tl["stages_ms"]
+        assert rec["latency_ms"] == {"max": 12.0, "mean": 8.0, "count": 2}
+        assert rec["slo_ms"] == 12.0
+
+    def test_concurrent_lane_split_out_of_root_stages(self):
+        tr = _decision_trace(lane=True)
+        rec = flightrec.FlightRecorder(capacity=8).record("pipeline", 1, trace=tr)
+        tl = rec["timeline"]
+        # the adopted prewarm lane is attributed, but concurrently — it
+        # must not break the root lane's wall partition
+        assert "prewarm" in tl["concurrent_ms"]
+        assert "prewarm" not in tl["stages_ms"]
+        assert tl["lanes"] == 2
+        assert rec.reconstructed
+
+    def test_untraced_decision_still_lands_unreconstructed(self):
+        rec = flightrec.FlightRecorder(capacity=8).record("sequential", 1, trace=None)
+        assert not rec.reconstructed
+        assert rec["decision_id"].startswith("untraced-")
+
+    def test_ring_is_bounded_newest_wins(self):
+        r = flightrec.FlightRecorder(capacity=3)
+        for i in range(7):
+            r.record("pipeline", i)
+        assert len(r) == 3
+        assert [x["tick"] for x in r.all()] == [4, 5, 6]
+        assert r.last()["tick"] == 6
+
+    def test_coverage_by_kind(self):
+        r = flightrec.FlightRecorder(capacity=8)
+        r.record("pipeline", 1, trace=_decision_trace())
+        r.record("fleet", 2, trace=None)
+        assert r.coverage(kind="pipeline") == 1.0
+        assert r.coverage(kind="fleet") == 0.0
+        assert r.coverage() == 0.5
+
+
+class TestSloAccounting:
+    def test_burn_windows(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SLO_TARGET_MS", "10")
+        clk = [1000.0]
+        r = flightrec.FlightRecorder(capacity=64, clock=lambda: clk[0])
+        m = Metrics()
+        r.attach_burn_gauge(m.decision_slo_burn)
+        # 3 over-target, 1 under, inside the 1m window
+        for lat in (50.0, 50.0, 50.0, 5.0):
+            r.record("pipeline", 1, latency_ms=[lat], pods_decided=1)
+        assert r.burn_rates() == {"1m": 0.75, "10m": 0.75}
+        assert m.decision_slo_burn.get(window="1m") == 0.75
+        # 2 minutes later: the 1m window is clear, 10m still remembers
+        clk[0] += 120.0
+        r.record("pipeline", 2, latency_ms=[5.0], pods_decided=1)
+        burn = r.burn_rates()
+        assert burn["1m"] == 0.0
+        assert burn["10m"] == pytest.approx(3 / 5)
+
+    def test_breach_dump_writes_record_with_trace(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SLO_BREACH_DUMP_MS", "1")
+        monkeypatch.setenv("KARPENTER_TPU_TRACE_DIR", str(tmp_path))
+        tr = _decision_trace()
+        r = flightrec.FlightRecorder(capacity=8)
+        r.record("pipeline", 1, trace=tr, latency_ms=[99.0], pods_decided=1)
+        files = sorted(tmp_path.glob("decision-*.breach.json"))
+        assert files, "breach dump wrote nothing"
+        doc = json.loads(files[-1].read_text())
+        assert doc["record"]["decision_id"] == tr.trace_id
+        assert any(e["name"] == "solve" for e in doc["trace_events"])
+
+    def test_no_dump_below_threshold(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SLO_BREACH_DUMP_MS", "10000")
+        monkeypatch.setenv("KARPENTER_TPU_TRACE_DIR", str(tmp_path))
+        flightrec.FlightRecorder(capacity=8).record(
+            "pipeline", 1, trace=_decision_trace(), latency_ms=[5.0], pods_decided=1
+        )
+        assert not list(tmp_path.glob("*.breach.json"))
+
+
+class TestExemplars:
+    def test_latency_histogram_carries_trace_exemplar(self):
+        from karpenter_core_tpu.serving.latency import DecisionLatencyTracker
+
+        m = Metrics()
+        t = DecisionLatencyTracker(histogram=m.serving_decision_latency)
+        t.pod_pending("p1")
+        settled = t.pods_decided(["p1"], tick=1, trace_id="t-exemplar-1")
+        assert len(settled) == 1
+        ex = m.serving_decision_latency.exemplars()
+        assert len(ex) == 1
+        (bucket, (trace_id, value, ts)), = ex.items()
+        assert trace_id == "t-exemplar-1"
+        assert value == pytest.approx(settled[0])
+        # exemplars stay OUT of the text exposition (classic prom format)
+        assert "t-exemplar-1" not in m.registry.expose()
+
+
+class TestLatencyBucketsEnv:
+    def test_default_buckets(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_LATENCY_BUCKETS_MS", raising=False)
+        assert latency_buckets() == DURATION_BUCKETS
+
+    def test_env_buckets_parse_ms_to_seconds(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_LATENCY_BUCKETS_MS", "1,5, 10,500,2000")
+        assert latency_buckets() == [0.001, 0.005, 0.01, 0.5, 2.0]
+        m = Metrics()
+        assert m.serving_decision_latency.buckets == [0.001, 0.005, 0.01, 0.5, 2.0]
+        assert m.fleet_decision_latency.buckets == [0.001, 0.005, 0.01, 0.5, 2.0]
+        # the fleet ms-scale decision no longer piles into the top bucket
+        m.fleet_decision_latency.observe(0.004)
+        text = "\n".join(m.fleet_decision_latency.collect())
+        assert 'le="0.005"} 1' in text
+
+    def test_invalid_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_LATENCY_BUCKETS_MS", "nope,-3")
+        assert latency_buckets() == DURATION_BUCKETS
+        monkeypatch.setenv("KARPENTER_TPU_LATENCY_BUCKETS_MS", "0,-5")
+        assert latency_buckets() == DURATION_BUCKETS
+
+
+class TestDebugRoutes:
+    def _server(self, **kwargs):
+        srv = OperationalServer(
+            Registry(), ready_check=lambda: True, metrics_port=0, probe_port=0, **kwargs
+        )
+        srv.start()
+        return srv
+
+    def test_decisions_routes(self):
+        flightrec.RECORDER.clear()
+        tr = _decision_trace()
+        flightrec.RECORDER.record(
+            "pipeline", 7, trace=tr, latency_ms=[3.0], pods_decided=1
+        )
+        srv = self._server()
+        try:
+            status, body = _get(srv.metrics_port, "/debug/decisions")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["retained"] == 1
+            assert doc["coverage"] == 1.0
+            assert set(doc["burn_rate"]) == {"1m", "10m"}
+            assert doc["decisions"][0]["decision_id"] == tr.trace_id
+            status, body = _get(srv.metrics_port, "/debug/decisions/last")
+            assert status == 200
+            assert json.loads(body)["tick"] == 7
+            status, _ = _get(srv.metrics_port, "/debug/decisions?tail=bogus")
+            assert status == 400
+        finally:
+            srv.stop()
+            flightrec.RECORDER.clear()
+
+    def test_decisions_last_404_when_empty(self):
+        flightrec.RECORDER.clear()
+        srv = self._server()
+        try:
+            status, _ = _get(srv.metrics_port, "/debug/decisions/last")
+            assert status == 404
+        finally:
+            srv.stop()
+
+    def test_solve_stats_route_serves_consolidated_schema(self):
+        from helpers import make_nodepool, make_pod
+        from karpenter_core_tpu.cloudprovider.fake import (
+            FakeCloudProvider,
+            instance_types,
+        )
+        from karpenter_core_tpu.solver import TPUScheduler
+        from karpenter_core_tpu.solver import stats as solver_stats
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(6)
+        solver = TPUScheduler([make_nodepool()], provider)
+        solver.solve([make_pod(requests={"cpu": "250m"}) for _ in range(8)])
+        srv = self._server(
+            solve_stats=lambda: solver_stats.route_payload(lambda: solver)
+        )
+        try:
+            status, body = _get(srv.metrics_port, "/debug/solve/stats")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["schema"] == solver_stats.SCHEMA
+            # the stable top-level schema, always present
+            assert set(doc) == {
+                "schema", "trace_id", "timings", "cache", "merge",
+                "pack_backend", "disruption",
+            }
+            assert doc["timings"]["total_ms"] > 0
+            assert doc["trace_id"] == solver.last_timings["trace_id"]
+            # bench _split consumes the same document
+            fields = solver_stats.bench_fields(doc)
+            assert {"device_ms", "host_ms", "merge_ms"} <= set(fields)
+        finally:
+            srv.stop()
+
+    def test_solve_stats_404_before_first_solve(self):
+        from karpenter_core_tpu.solver import stats as solver_stats
+
+        srv = self._server(
+            solve_stats=lambda: solver_stats.route_payload(lambda: None)
+        )
+        try:
+            status, _ = _get(srv.metrics_port, "/debug/solve/stats")
+            assert status == 404
+        finally:
+            srv.stop()

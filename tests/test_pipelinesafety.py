@@ -94,6 +94,37 @@ def test_handoff_queue_and_event_fields_exempt(tmp_path):
     assert run_snippet(tmp_path, code).findings == []
 
 
+def test_trace_context_handoff_fields_exempt(tmp_path):
+    # ISSUE 10: a TraceContext captured at enqueue time is an immutable
+    # handoff value — publishing its reference across stage threads is
+    # the tracer's documented crossing, not a race
+    code = """
+        import threading
+        from karpenter_core_tpu.tracing import tracer
+        from karpenter_core_tpu.tracing.tracer import TraceContext
+
+        class Stage:
+            def __init__(self):
+                self._ctx = tracer.capture()
+                self._anchor = TraceContext(None, None)
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._loop)
+                self._thread.start()
+
+            def _loop(self):
+                while True:
+                    with tracer.adopt(self._ctx, "lane"):
+                        pass
+
+            def stamp(self):
+                self._ctx = tracer.capture()
+                self._anchor = TraceContext(None, None)
+    """
+    assert run_snippet(tmp_path, code).findings == []
+
+
 def test_thread_private_state_clean(tmp_path):
     # a field only one context touches is not stage-crossing state
     code = STAGE_CLASS.replace("__LOOP_BODY__", "self.ticks += 1").replace(

@@ -215,8 +215,11 @@ def test_encode_done_listener_fires_once_per_tensor_solve():
 
 @pytest.mark.parametrize("scenario,seed", [("cascade", 7), ("churn10x", 11)])
 def test_lockstep_plan_identity_and_monotonic_order(scenario, seed):
+    from karpenter_core_tpu.tracing import tracer
+
     sc = tg.build_scenario(scenario, scale=60, seed=seed)
     incremental.reset()
+    tracer.reset_orphans()
     seq = tg.run_lockstep(sc, mode="sequential")
     incremental.reset()
     pipe = tg.run_lockstep(sc, mode="pipeline")
@@ -227,6 +230,40 @@ def test_lockstep_plan_identity_and_monotonic_order(scenario, seed):
     assert pipe.pods_decided == seq.pods_decided == sc.total_creates
     # the pipeline really ran its concurrent stages while matching plans
     assert pipe.stage_stats["prewarm"]["runs"] >= 1
+    # ISSUE 10 orphan gate: every span born on a stage thread (window
+    # former, prewarm, telemetry) attached to its decision's trace root
+    assert tracer.orphan_spans() == 0, tracer.orphan_recent()
+
+
+def test_free_run_flight_recorder_coverage_and_orphans():
+    """ISSUE 10 acceptance shape (scaled down from the bench's churn10x
+    free run): ≥99% of decisions carry a fully reconstructed
+    pod-pending → plan-emitted timeline — per-stage self-times summing
+    to the decision's wall clock within 1% — and no span orphaned."""
+    from karpenter_core_tpu.tracing import flightrec, tracer
+
+    flightrec.RECORDER.clear()
+    tracer.reset_orphans()
+    sc = tg.build_scenario("churn10x", scale=40, seed=5)
+    rr = tg.run_free(sc, mode="pipeline", pace_s=0.01)
+    assert rr.pods_decided > 0
+    fstats = rr.stage_stats["flightrec"]
+    assert fstats["retained"] >= 1
+    assert fstats["coverage"] is not None and fstats["coverage"] >= 0.99
+    assert tracer.orphan_spans() == 0, tracer.orphan_recent()
+    recs = [r for r in flightrec.RECORDER.all() if r["kind"] == "pipeline"]
+    assert recs
+    for rec in recs:
+        tl = rec["timeline"]
+        # self-times partition wall within 1% (+ sub-ms jitter floor)
+        assert abs(tl["stages_sum_ms"] - tl["wall_ms"]) <= max(
+            0.01 * tl["wall_ms"], 0.05
+        )
+        assert tl["queue_wait_ms"] is not None
+    # decisions that settled pods carry their latency timeline
+    settled = [r for r in recs if r["pods_decided"] > 0]
+    assert settled and all(r["latency_ms"]["max"] > 0 for r in settled)
+    flightrec.RECORDER.clear()
 
 
 def test_free_running_pipeline_decides_everything():

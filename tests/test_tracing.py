@@ -1,13 +1,19 @@
-"""Solve-trace subsystem (ISSUE 1): span nesting/ordering, ring-buffer
-eviction, Chrome trace-event JSON validity, the /debug/traces routes
-served end-to-end after a real solve, slow-solve capture, and the
-single-flight guard on /debug/pprof/profile."""
+"""Solve-trace subsystem (ISSUE 1 + the ISSUE 10 telemetry plane): span
+nesting/ordering, ring-buffer eviction, Chrome trace-event JSON
+validity, the /debug/traces routes served end-to-end after a real
+solve, slow-solve capture, the single-flight guard on
+/debug/pprof/profile — plus cross-thread TraceContext capture/adopt,
+orphan-span accounting, and the concurrent-trace-roots isolation
+stress."""
 
 import json
+import random
 import threading
 import time
 import urllib.error
 import urllib.request
+
+import pytest
 
 from helpers import make_nodepool, make_pod
 from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
@@ -256,3 +262,223 @@ class TestDebugTracesRoutes:
             assert results["second"] == 429
         finally:
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: orphan-span accounting
+
+
+class TestOrphanAccounting:
+    def test_span_with_no_root_counts_as_orphan(self):
+        tracer.reset_orphans()
+        with tracer.span("floating") as s:
+            assert s is None
+        assert tracer.orphan_spans() == 1
+        assert tracer.orphan_recent() == ["floating"]
+        tracer.reset_orphans()
+        assert tracer.orphan_spans() == 0
+
+    def test_disabled_tracing_is_not_an_orphan(self, monkeypatch):
+        # KARPENTER_TPU_TRACE=0 turns the subtree OFF deliberately: the
+        # sentinel keeps inner spans from counting as lost attribution
+        monkeypatch.setenv("KARPENTER_TPU_TRACE", "0")
+        tracer.reset_orphans()
+        with tracer.trace_root("off") as tr:
+            assert tr is None
+            with tracer.span("inner"):
+                with tracer.span("deeper"):
+                    pass
+        assert tracer.orphan_spans() == 0
+        # and the sentinel is restored off the thread afterwards
+        assert tracer.current_trace() is None
+
+    def test_traced_spans_never_count(self):
+        tracer.reset_orphans()
+        with tracer.trace_root("root"):
+            with tracer.span("a"):
+                pass
+        assert tracer.orphan_spans() == 0
+
+    def test_metrics_bridge_exposes_counter(self):
+        tracer.reset_orphans()
+        m = Metrics()
+        with tracer.span("lost"):
+            pass
+        text = m.registry.expose()
+        assert "karpenter_tpu_tracer_orphan_spans_total 1.0" in text
+        tracer.reset_orphans()
+
+    def test_adopted_span_is_not_an_orphan(self):
+        tracer.reset_orphans()
+        with tracer.trace_root("root") as tr:
+            ctx = tracer.capture()
+            errs = []
+
+            def worker():
+                try:
+                    with tracer.adopt(ctx, "lane"):
+                        with tracer.span("lane.inner"):
+                            pass
+                except Exception as e:  # noqa: BLE001 — surfaced via errs
+                    errs.append(e)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert not errs
+        assert tracer.orphan_spans() == 0
+        assert {s.name for s in tr.spans} >= {"lane", "lane.inner", "root"}
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: TraceContext capture/adopt
+
+
+class TestContextPropagation:
+    def test_capture_returns_none_untraced(self):
+        assert tracer.capture() is None
+
+    def test_adopt_none_is_passthrough(self):
+        with tracer.adopt(None, "x") as s:
+            assert s is None
+
+    def test_adopted_lane_links_to_capture_point(self):
+        with tracer.trace_root("decision") as tr:
+            with tracer.span("enqueue") as parent:
+                ctx = tracer.capture()
+            assert ctx.trace is tr and ctx.parent is parent
+            done = threading.Event()
+
+            def worker():
+                with tracer.adopt(ctx, "consume", item=1) as anchor:
+                    assert tracer.current_trace() is tr
+                    with tracer.span("consume.work"):
+                        pass
+                    assert anchor.parent is parent
+                done.set()
+
+            threading.Thread(target=worker).start()
+            assert done.wait(5.0)
+        by_name = {s.name: s for s in tr.spans}
+        anchor = by_name["consume"]
+        # linked child of the capture point, on its own thread lane
+        assert anchor.parent is by_name["enqueue"]
+        assert anchor.tid != tr.root_tid
+        assert by_name["consume.work"].parent is anchor
+        # concurrent time is NOT nested time: the enqueue span's self
+        # time is untouched by the adopted lane
+        assert by_name["enqueue"].child_ns == 0
+        # root-lane breakdown excludes the foreign lane, so it still
+        # partitions the root duration exactly
+        bd = tr.phase_breakdown_ms()
+        assert "consume" not in bd and "consume.work" not in bd
+        assert abs(sum(bd.values()) - by_name["decision"].dur_ns / 1e6) < 1e-6
+        # while the lane breakdown surfaces it for the flight recorder
+        lanes = tr.lane_breakdown_ms()
+        assert len(lanes) == 2
+
+    def test_adopt_same_trace_degrades_to_span(self):
+        with tracer.trace_root("root") as tr:
+            ctx = tracer.capture()
+            with tracer.adopt(ctx, "again") as s:
+                assert s is not None
+        by_name = {s.name: s for s in tr.spans}
+        assert by_name["again"].parent is by_name["root"]
+        assert by_name["again"].tid == tr.root_tid
+
+    def test_adopt_foreign_trace_records_links_both_ways(self):
+        with tracer.trace_root("a") as tr_a:
+            ctx_a = tracer.capture()
+        with tracer.trace_root("b") as tr_b:
+            with tracer.adopt(ctx_a, "crossover") as s:
+                assert s is not None
+                assert tracer.current_trace() is tr_b  # never two traces
+        assert any(l["trace_id"] == tr_a.trace_id for l in tr_b.links)
+        assert any(l["trace_id"] == tr_b.trace_id for l in tr_a.links)
+
+    def test_trace_root_inside_adopted_lane_joins(self):
+        # the solver's solve() opens trace_root; on an adopted worker
+        # lane it must JOIN the decision trace, not fork its own
+        with tracer.trace_root("decision") as tr:
+            ctx = tracer.capture()
+            done = threading.Event()
+
+            def worker():
+                with tracer.adopt(ctx, "lane"):
+                    with tracer.trace_root("solve", is_solve=True) as inner:
+                        assert inner is tr
+                done.set()
+
+            threading.Thread(target=worker).start()
+            assert done.wait(5.0)
+        assert tr.contains_solve
+        assert "solve" in {s.name for s in tr.spans}
+
+    def test_stage_queue_carries_context(self):
+        from karpenter_core_tpu.serving import StageQueue
+
+        q = StageQueue("t", maxsize=4)
+        with tracer.trace_root("producer") as tr:
+            q.put({"work": 1})
+        item, ctx = q.get_entry()
+        assert item == {"work": 1}
+        assert ctx is not None and ctx.trace is tr
+        # plain get() unwraps (existing consumers unchanged)
+        q.put("bare")
+        assert q.get() == "bare"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10 satellite: concurrent trace roots stay isolated
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_concurrent_trace_roots_do_not_interleave(seed):
+    """Two simultaneous trace_roots on different threads: ring entries
+    must not interleave and spans must never cross-attach (each trace's
+    parent chains stay inside that trace)."""
+    rng = random.Random(seed)
+    RING.clear()
+    tracer.reset_orphans()
+    barrier = threading.Barrier(2)
+    traces = {}
+    errs = []
+
+    def run(name, n_spans, sleeps):
+        try:
+            barrier.wait(timeout=10.0)
+            with tracer.trace_root(name) as tr:
+                traces[name] = tr
+                for i in range(n_spans):
+                    with tracer.span(f"{name}.outer{i}"):
+                        with tracer.span(f"{name}.inner{i}"):
+                            time.sleep(sleeps[i])
+        except Exception as e:  # noqa: BLE001 — surfaced via errs
+            errs.append(e)
+
+    threads = []
+    for name in ("alpha", "beta"):
+        n = rng.randint(4, 12)
+        sleeps = [rng.random() * 0.002 for _ in range(n)]
+        threads.append(threading.Thread(target=run, args=(name, n, sleeps)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errs
+    assert len(RING) == 2
+    for name, tr in traces.items():
+        own = set(map(id, tr.spans))
+        for s in tr.spans:
+            # every span in this trace was born on this trace's thread
+            assert s.name == name or s.name.startswith(name + "."), s.name
+            assert s.tid == tr.root_tid
+            # and its parent chain never leaves the trace
+            p = s.parent
+            while p is not None:
+                assert id(p) in own, f"{s.name} parent chain escaped {name}"
+                p = p.parent
+        # ring entry is internally consistent: self times partition root
+        root = next(s for s in tr.spans if s.name == name)
+        assert sum(s.self_ns for s in tr.spans) == root.dur_ns
+    assert tracer.orphan_spans() == 0
