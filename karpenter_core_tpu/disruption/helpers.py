@@ -52,7 +52,8 @@ class PDBLimits:
 
 def has_do_not_disrupt_pod(candidate: Candidate) -> Optional[Pod]:
     for p in candidate.pods:
-        if podutils.has_do_not_disrupt(p) and not podutils.is_terminating(p) and not podutils.is_terminal(p):
+        # rv-memoized (active ∧ do-not-disrupt) — see disruption_screen_flags
+        if podutils.disruption_screen_flags(p)[1]:
             return p
     return None
 
@@ -134,7 +135,7 @@ def get_candidates(
             continue
     pods_by_node: Dict[str, list] = {}
     for p in kube_client.list("Pod"):
-        if p.spec.node_name and podutils.is_active(p):
+        if p.spec.node_name and podutils.disruption_screen_flags(p)[0]:
             pods_by_node.setdefault(p.spec.node_name, []).append(p)
     candidates = []
     for node in cluster.deep_copy_nodes():

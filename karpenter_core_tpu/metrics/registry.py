@@ -327,6 +327,14 @@ class Metrics:
             "Pack jobs through the LP-relaxation backend, by guard outcome (lp_won | ffd_kept)",
             ["outcome"],
         )
+        # pod-axis sharded mega-solves (solver/sharding.py): mesh
+        # padding is never silent — wasted slot fraction of the last
+        # solve's pod-chunk padding and type-shard padding
+        self.shard_padding_waste = r.gauge(
+            f"{ns}_tpu_shard_padding_waste",
+            "Padded-slot fraction wasted by the last sharded solve's mesh tiling (axis = pods | types)",
+            ["axis"],
+        )
         # serving pipeline (serving/pipeline.py): the decision-latency
         # SLO (pod-pending → plan emitted), per-stage durations, and
         # stage-queue depths (backpressure visibility)

@@ -89,6 +89,22 @@ class Requirements(Dict[str, Requirement]):
         self._fp = (guard, fp)
         return fp
 
+    def fingerprint_digest(self) -> bytes:
+        """Process-stable 128-bit digest of ``fingerprint()``, cached on
+        the same write-invalidated slot (``self._fp`` rides a 3-tuple
+        when the digest has been materialized). Hot fingerprint
+        consumers (the per-solve catalog content check) feed this digest
+        instead of re-walking the nested fingerprint tuple per call."""
+        fp = self.fingerprint()  # revalidates/refreshes self._fp
+        cached = self._fp
+        if len(cached) == 3:
+            return cached[2]
+        from ..solver.stablehash import stable_hash
+
+        digest = stable_hash(fp)
+        self._fp = (cached[0], fp, digest)
+        return digest
+
     def keys_set(self) -> frozenset:
         return frozenset(self.keys())
 

@@ -304,6 +304,10 @@ class ServingPipeline:
         self._mu = threading.Lock()
         self._ticks = 0
         self._step_inflight = False
+        # ticks whose telemetry record has landed in the tick log — the
+        # quiesce barrier compares this against _ticks so a "quiesced"
+        # pipeline's /debug payload is settled (no undrained tick)
+        self._telemetry_drained = 0
         self._ingested = 0
         # the in-flight decision's TraceContext (the prewarm handshake's
         # trace half): stamped by the decision root's on_root hook on
@@ -510,6 +514,7 @@ class ServingPipeline:
             )
         with self._mu:
             self._tick_log.append(rec)
+            self._telemetry_drained += 1
 
     # -- prewarm stage (the double buffer) -----------------------------------
 
@@ -682,14 +687,18 @@ class ServingPipeline:
 
     def quiesce(self, timeout: float = 30.0, require_empty: bool = True) -> bool:
         """Wait until the decision stream drains: no queued batches, no
-        in-flight step, and (require_empty) no undecided pending pods.
+        in-flight step, no undrained telemetry (a quiesced pipeline's
+        /debug payload is settled — the tick log must already hold every
+        completed tick), and (require_empty) no undecided pending pods.
         Returns False on timeout."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._mu:
                 busy = self._step_inflight
+                drained = self._telemetry_drained >= self._ticks
             if (
                 not busy
+                and drained
                 and self.solve_q.depth() == 0
                 and (not require_empty or self.latency.pending_count() == 0)
             ):

@@ -519,9 +519,18 @@ def pack_engine_token(mesh) -> tuple:
     """The pack-engine configuration a job result depends on."""
     from .. import native
     from .pack import NATIVE_K_OPEN
+    from .sharding import pod_shard_token
 
     return (
         bool(native.available()),
         int(mesh.devices.size) if mesh is not None else 0,
         int(NATIVE_K_OPEN),
+        # pod-axis mega-shard config (ISSUE 11): with a mesh active, a
+        # job at/past the shard threshold is chunk-packed, and (engine,
+        # threshold, mesh size) decide that partition — so the chunk
+        # config is key material. Its env reads happen inside the pack
+        # dispatch, invisible to the cachesound read-set slice (the
+        # PR-7 sim_drained precedent); the no-alias invariant is held
+        # by tests/test_sharding.py::TestShardEngineMemoKeys instead.
+        pod_shard_token(mesh),
     )

@@ -228,16 +228,21 @@ def assign_cheapest_types(
         return native.cheapest_types_native(node_usage, allocatable, prices)
     # numpy fallback chunks the node axis: the full (N, T, R) broadcast
     # at consolidation-screen scale (5k nodes x 2k types x 6 resources)
-    # would materialize a ~120 MB transient
+    # would materialize a ~120 MB transient. The block height adapts to
+    # the type axis so the live transient stays bounded (~32M elements)
+    # at mega-shard scale too (10k types x 1M pods — ISSUE 11: no
+    # (P, T, R)-shaped transient past host-RAM limits)
     N = node_usage.shape[0]
+    T_, R_ = allocatable.shape
+    step = max(1, min(1024, 32_000_000 // max(1, T_ * R_)))
     best = np.empty(N, dtype=np.int32)
-    for s in range(0, max(N, 1), 1024):
-        blk = node_usage[s : s + 1024]
+    for s in range(0, max(N, 1), step):
+        blk = node_usage[s : s + step]
         fits = np.all(blk[:, None, :] <= allocatable[None, :, :], axis=-1)  # (n, T)
         priced = np.where(fits, prices[None, :], np.inf)
         b = np.argmin(priced, axis=1).astype(np.int32)
         b[~fits.any(axis=1)] = -1
-        best[s : s + 1024] = b
+        best[s : s + step] = b
     return best
 
 
@@ -288,6 +293,28 @@ def batch_pack(jobs: list, engine: str = "auto", mesh=None) -> list:
 
 
 def _batch_pack(jobs: list, engine: str, mesh) -> list:
+    if mesh is not None:
+        # pod-axis mega jobs (ISSUE 11): a single job at or past the
+        # shard threshold chunks its POD axis across the mesh — the
+        # chunking decision depends only on (mesh, P, threshold, shard
+        # engine), never on native availability, so the partition is
+        # deterministic for a fixed configuration (and all of it is
+        # job-memo key material: incremental.pack_engine_token)
+        from .sharding import shard_min_pods, sharded_pod_pack
+
+        min_pods = shard_min_pods()
+        mega = [g for g, j in enumerate(jobs) if j[0].shape[0] >= min_pods]
+        if mega:
+            results: list = [None] * len(jobs)
+            for g in mega:
+                reqs, frontier, cap = jobs[g]
+                results[g] = sharded_pod_pack(mesh, reqs, frontier, cap)
+            rest = [g for g in range(len(jobs)) if results[g] is None]
+            if rest:
+                sub = _batch_pack([jobs[g] for g in rest], engine, mesh)
+                for slot, g in enumerate(rest):
+                    results[g] = sub[slot]
+            return results
     if mesh is not None and engine in ("device", "sharded"):
         return _batch_pack_sharded(mesh, jobs)
     if engine in ("auto", "native"):

@@ -19,6 +19,7 @@ Intersects with the both-negative carve-out).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Sequence, Tuple
 
 import jax
@@ -28,6 +29,21 @@ import numpy as np
 LANE = 128  # TPU lane width; per-key chunks are padded to this
 TILE_S = 128
 TILE_T = 128
+
+
+def compat_row_block(T: int) -> int:
+    """Signature rows per compat_pallas dispatch so the kernel's padded
+    (Sp, Tp) f32 output — its only (S, T)-shaped HBM transient — stays
+    under the tile budget (KARPENTER_TPU_COMPAT_TILE_MB, default 64 MB).
+    At mega-shard scale (10k types) this caps one dispatch at ~1.6k
+    signature rows instead of letting S grow the transient unboundedly
+    (ISSUE 11: tiled compat past HBM limits)."""
+    try:
+        mb = float(os.environ.get("KARPENTER_TPU_COMPAT_TILE_MB", "64"))
+    except ValueError:
+        mb = 64.0
+    rows = int(mb * 1e6 / 4.0 / max(T, 1))
+    return max(TILE_S, (rows // TILE_S) * TILE_S)
 
 
 def pack_masks(
@@ -192,15 +208,27 @@ def compat_via_pallas(
     sp, sh, sn, offsets, widths = pack_masks(sig_masks, sig_has, sig_neg, keys)
     tp, th, tn, t_offsets, t_widths = pack_masks(type_masks, type_has, type_neg, keys)
     assert offsets == t_offsets and widths == t_widths, "sig/type chunk layouts must agree"
-    ok = compat_pallas(
-        jnp.asarray(sp),
-        jnp.asarray(tp),
-        jnp.asarray(sh),
-        jnp.asarray(sn),
-        jnp.asarray(th),
-        jnp.asarray(tn),
-        offsets,
-        widths,
-        interpret=interpret,
-    )
+    T = tp.shape[0]
+    tpj, thj, tnj = jnp.asarray(tp), jnp.asarray(th), jnp.asarray(tn)
+    block = compat_row_block(T)
+    S = sp.shape[0]
+    rows = []
+    # row-blocked over signatures: each dispatch's padded (Sp, Tp) f32
+    # output stays under the tile budget; the type side uploads once
+    for s0 in range(0, max(S, 1), block):
+        s1 = min(s0 + block, S)
+        rows.append(
+            compat_pallas(
+                jnp.asarray(sp[s0:s1]),
+                tpj,
+                jnp.asarray(sh[s0:s1]),
+                jnp.asarray(sn[s0:s1]),
+                thj,
+                tnj,
+                offsets,
+                widths,
+                interpret=interpret,
+            )
+        )
+    ok = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
     return ok & jnp.asarray(sig_arrays["valid"])[:, None]

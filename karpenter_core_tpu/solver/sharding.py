@@ -7,6 +7,33 @@ Scaling axes, in jax.sharding terms:
 - **types** (tensor-parallel-like): the S×T compat kernel shards the
   type axis; each device computes a T-shard of the masks, results
   all-gather (XLA inserts the collective from shardings).
+- **pods** (ISSUE 11 tentpole): one giant job's pod axis chunks into
+  contiguous shards across the mesh — each device FFD-packs its chunk,
+  per-shard node ids renumber into one global id space on the host, and
+  the per-shard partial plans merge downstream through the existing
+  vectorized merge engine (a chunk's underfull tail nodes are ordinary
+  merge records). This is what takes a single solve to 500k–1M pods ×
+  10k types: no (P, T, R)-shaped transient ever materializes — the pack
+  state per device is (K, F, R), the compat matrices stay tiled
+  (type-axis shards here, (TILE_S, TILE_T) VMEM blocks in
+  pallas_kernels), and the host-side type assignment is row-blocked
+  under a byte budget.
+
+Engine switch (the PR-2/PR-7 pattern): the pod-axis chunk pack runs
+``KARPENTER_TPU_SHARD_ENGINE={sharded,unsharded}`` — ``sharded`` is the
+shard_map dispatch across the mesh, ``unsharded`` the vmap twin of the
+SAME chunked computation on one device, so the two engines are
+plan-identical by construction and ``unsharded`` is the parity oracle
+at subsampled shapes. The chunk threshold is
+``KARPENTER_TPU_SHARD_MIN_PODS`` (chunking changes the pod→node
+partition, so both knobs are job-memo key material:
+``incremental.pack_engine_token``).
+
+Padding is never silent (the PR-7 ``family_capped`` discipline): both
+the type-axis padding of ``prepare_sharded_catalog`` and the pod-axis
+chunk padding accumulate into per-solve shard stats
+(``TPUScheduler.last_shard_stats``, bench ``shard_*`` columns) and the
+``karpenter_tpu_shard_padding_waste`` gauge.
 
 Fleet-level repack for consolidation reuses the same mesh with a psum
 over candidate-subset scores.
@@ -15,7 +42,9 @@ over candidate-subset scores.
 from __future__ import annotations
 
 import os
-from functools import partial
+import threading
+import time
+from functools import lru_cache, partial
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -23,7 +52,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .pack import ffd_pack
+from . import devicetime
+from .pack import ffd_pack, ffd_pack_batched
+from ..tracing import tracer
 
 # jax.shard_map landed at top level only in newer jax; older images ship
 # it under jax.experimental.shard_map. Feature-detect once so the
@@ -57,6 +88,98 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+# ---------------------------------------------------------------------------
+# pod-axis mega-shard configuration (ISSUE 11)
+
+def shard_engine() -> str:
+    """``sharded`` (shard_map across the mesh) or ``unsharded`` (the
+    vmap twin of the same chunked pack on one device — the parity
+    oracle). Read per dispatch, the PR-2 engine-switch pattern; unknown
+    values degrade to ``sharded``."""
+    eng = os.environ.get("KARPENTER_TPU_SHARD_ENGINE", "sharded").strip().lower()
+    return eng if eng in ("sharded", "unsharded") else "sharded"
+
+
+def shard_min_pods() -> int:
+    """Pod count at which a single pack job chunks across the mesh.
+    Chunking changes the pod→node partition (each chunk packs its own
+    nodes; tails re-merge downstream), so this is job-memo key material
+    — see ``incremental.pack_engine_token``."""
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_SHARD_MIN_PODS", "65536")))
+    except ValueError:
+        return 65536
+
+
+def pod_shard_token(mesh) -> tuple:
+    """The pod-axis chunk configuration a pack result depends on, for
+    job-memo keys: with a mesh active, (engine, threshold, mesh size)
+    decide whether/how a job chunks. Returns () single-device so
+    meshless keys stay stable."""
+    if mesh is None:
+        return ()
+    return (shard_engine(), shard_min_pods(), int(mesh.devices.size))
+
+
+# ---------------------------------------------------------------------------
+# per-solve shard padding stats — padding is NEVER silent (the PR-7
+# family_capped discipline). Thread-local: concurrent solvers (fleet
+# lanes, disruption sims) each accumulate their own solve's stats.
+
+_PAD_TLS = threading.local()
+
+
+def _shard_acc() -> dict:
+    acc = getattr(_PAD_TLS, "acc", None)
+    if acc is None:
+        acc = _PAD_TLS.acc = {}
+    return acc
+
+
+def reset_shard_stats() -> None:
+    """Start a fresh per-solve accumulator on this thread (the solver
+    calls this at solve entry)."""
+    _PAD_TLS.acc = {}
+
+
+def record_shard_padding(
+    axis: str, used: int, padded: int, accumulate: bool = True, **extra
+) -> None:
+    """Record one padding event: ``used`` real slots inside ``padded``
+    total slots along ``axis`` (``pods`` | ``types``). ``accumulate``
+    sums across events (many chunk packs per solve); False replaces
+    (the type axis is a property of the active catalog, re-observed per
+    solve). ``extra`` merges scalar context (engine, n_devices)."""
+    acc = _shard_acc()
+    a = acc.get(axis)
+    if a is None or not accumulate:
+        acc[axis] = {"used": int(used), "padded": int(padded)}
+    else:
+        a["used"] += int(used)
+        a["padded"] += int(padded)
+    for k, v in extra.items():
+        acc[k] = v
+
+
+def consume_shard_stats() -> dict:
+    """Drain this thread's accumulator into the per-solve stats shape:
+    ``{axis}_used`` / ``{axis}_padded`` / ``{axis}_waste`` (wasted-slot
+    fraction) per recorded axis, plus any scalar context."""
+    acc = _shard_acc()
+    _PAD_TLS.acc = {}
+    out: dict = {}
+    for axis in ("pods", "types"):
+        a = acc.pop(axis, None)
+        if a is None:
+            continue
+        used, padded = a["used"], a["padded"]
+        out[f"{axis}_used"] = used
+        out[f"{axis}_padded"] = padded
+        out[f"{axis}_waste"] = round(1.0 - used / padded, 4) if padded else 0.0
+    out.update(acc)
+    return out
+
+
 _MESH: Optional[Mesh] = None
 
 
@@ -80,15 +203,12 @@ def active_mesh(backend: str) -> Optional[Mesh]:
     return _MESH
 
 
-def sharded_batch_pack(
-    mesh: Mesh,
-    requests: jnp.ndarray,  # (G, Pmax, R) int32 — padded groups
-    frontiers: jnp.ndarray,  # (G, F, R) int32
-    max_per_node: jnp.ndarray,  # (G,) int32
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Pack G groups across the mesh; returns (node_ids (G, Pmax),
-    node_counts (G,), fleet_total ()). The fleet total is a real ICI
-    collective (psum over the groups axis)."""
+@lru_cache(maxsize=16)
+def _sharded_pack_fn(mesh: Mesh):
+    """The jitted shard_map group pack for one mesh, cached — a fresh
+    jit-of-closure per call would recompile on every solve (Mesh is
+    hashable, so the mesh IS the cache key; shapes re-specialize inside
+    jit's own cache)."""
 
     def per_device(reqs, fronts, caps):
         node_ids, counts = jax.vmap(
@@ -104,7 +224,213 @@ def sharded_batch_pack(
         in_specs=(P("groups"), P("groups"), P("groups")),
         out_specs=(P("groups"), P("groups"), P()),
     )
-    return jax.jit(shard(per_device))(requests, frontiers, max_per_node)
+    return jax.jit(shard(per_device))
+
+
+def sharded_batch_pack(
+    mesh: Mesh,
+    requests: jnp.ndarray,  # (G, Pmax, R) int32 — padded groups
+    frontiers: jnp.ndarray,  # (G, F, R) int32
+    max_per_node: jnp.ndarray,  # (G,) int32
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pack G groups across the mesh; returns (node_ids (G, Pmax),
+    node_counts (G,), fleet_total ()). The fleet total is a real ICI
+    collective (psum over the groups axis)."""
+    return _sharded_pack_fn(mesh)(requests, frontiers, max_per_node)
+
+
+def sharded_pod_pack(
+    mesh: Optional[Mesh],
+    requests: np.ndarray,  # (P, R) int32, pre-sorted descending by primary
+    frontier: np.ndarray,  # (F, R) int32
+    max_per_node,
+    engine: Optional[str] = None,
+) -> Tuple[np.ndarray, int]:
+    """Pod-axis sharded FFD pack of ONE mega job (ISSUE 11 tentpole).
+
+    The sorted pod axis chunks into D contiguous shards (chunk d holds
+    pods [d·Pc, (d+1)·Pc) — each chunk is itself sorted, so each
+    device's scan is a well-formed FFD); every device packs its chunk
+    independently, and the per-shard node ids renumber into one global
+    id space via an exclusive cumsum of shard node counts. Chunk tails
+    re-merge downstream through the ordinary merge records, so the
+    chunked partition costs at most D-1 underfull tails before the
+    merge engine folds them.
+
+    ``engine`` (default: ``shard_engine()``): ``sharded`` dispatches
+    one shard_map across the mesh; ``unsharded`` runs the vmap twin of
+    the SAME chunked computation on one device — identical chunking,
+    identical per-chunk scan (k_open=16 both ways), so the engines are
+    plan-identical by construction. No shard_map in this jax build (or
+    no mesh) degrades to ``unsharded`` explicitly.
+
+    Padding pods (chunk tail slots) exceed the frontier max, emit -1
+    without touching scan state, and are recorded — never silent —
+    into the per-solve shard stats.
+
+    → (node_ids (P,) int32 global ids [-1 ⇒ unschedulable], node_count).
+    """
+    if engine is None:
+        engine = shard_engine()
+    D = int(mesh.devices.size) if mesh is not None else 1
+    if engine == "sharded" and (mesh is None or _shard_map is None):
+        engine = "unsharded"  # explicit degrade, recorded in the stats
+    P, R = requests.shape
+    Pc = -(-P // D)
+    fmax = frontier.max(axis=0)
+    padded = np.empty((D * Pc, R), dtype=np.int32)
+    padded[:P] = requests
+    padded[P:] = fmax + 1  # sentinel: padding packs nowhere
+    reqs = padded.reshape(D, Pc, R)
+    fronts = np.broadcast_to(frontier, (D,) + frontier.shape)
+    caps = np.full(D, max_per_node, dtype=np.int32)
+    with tracer.span(
+        "pack.shard.dispatch", pods=P, chunks=D, chunk_len=Pc, engine=engine
+    ):
+        with devicetime.track():
+            if engine == "sharded":
+                ids, counts, _fleet = sharded_batch_pack(
+                    mesh, jnp.asarray(reqs), jnp.asarray(fronts), jnp.asarray(caps)
+                )
+            else:
+                ids, counts = ffd_pack_batched(
+                    jnp.asarray(reqs), jnp.asarray(fronts), jnp.asarray(caps)
+                )
+            # the ONE host sync of the mega dispatch, after all chunks
+            ids = np.asarray(ids)  # analysis: allow-host-sync
+            counts = np.asarray(counts, dtype=np.int64)  # analysis: allow-host-sync
+    offsets = np.zeros(D, dtype=np.int64)
+    np.cumsum(counts[:-1], out=offsets[1:])
+    gids = np.where(ids >= 0, ids + offsets[:, None].astype(np.int32), -1)
+    record_shard_padding(
+        "pods", P, D * Pc, engine=engine, n_devices=D, chunks=D
+    )
+    return gids.reshape(-1)[:P].astype(np.int32), int(counts.sum())
+
+
+def sharded_mega_solve(
+    mesh: Optional[Mesh],
+    requests: np.ndarray,  # (P, R) int32 pod requests, any order
+    alloc: np.ndarray,  # (T, R) int32 allocatable per type
+    prices: np.ndarray,  # (T,) f64
+    sig_masks: Optional[np.ndarray] = None,  # (S, W) f32 — compat screen
+    type_masks: Optional[np.ndarray] = None,  # (T, W) f32
+    max_per_node: int = 2**31 - 1,
+    engine: Optional[str] = None,
+    trace_ctx=None,
+) -> dict:
+    """One giant-tenant solve at the tensor level: the 500k–1M-pod ×
+    10k-type scale path (bench config 12, ``profile_solve --shard``).
+
+    Stages, each tiled so no (P, T, R)-shaped transient materializes:
+
+    1. compat screen (optional): the class's shared viable-type set via
+       the type-axis-sharded overlap matmul (``sharded_compat``) — each
+       device holds a T-shard, the (S, T) result comes back from the
+       output sharding's all-gather, and the class intersection folds
+       it to (T,). Tiled further in (TILE_S, TILE_T) VMEM blocks when
+       the pallas compat path is enabled (pallas_kernels).
+    2. frontier: Pareto points of the viable allocatable rows (F ≪ T).
+    3. pack: pod-axis sharded chunk pack (``sharded_pod_pack``).
+    4. assign: cheapest viable type per packed node, row-blocked under
+       the transient byte budget (``pack.assign_cheapest_types``).
+
+    ``trace_ctx`` (PR-10): a driver thread passes its decision's
+    TraceContext so the shard lane's spans land under that decision
+    instead of orphaning; on the owning thread adopt degrades to a
+    plain span.
+
+    Plan identity: for fixed inputs the result is engine-independent —
+    ``unsharded`` is the subsampled-shape parity oracle. Returns the
+    plan arrays plus per-stage wall times and the shard padding stats.
+    """
+    from .pack import assign_cheapest_types, node_usage_from_assignment, pareto_frontier
+
+    reset_shard_stats()
+    out: dict = {}
+    with tracer.adopt(trace_ctx, "shard.mega.adopt", pods=int(requests.shape[0])):
+        with tracer.span("shard.mega", pods=int(requests.shape[0])):
+            t0 = time.perf_counter()
+            viable = np.ones(alloc.shape[0], dtype=bool)
+            if sig_masks is not None and type_masks is not None:
+                with tracer.span("shard.mega.compat"):
+                    if mesh is not None:
+                        # pad the type axis to the mesh multiple (padded
+                        # rows are all-zero ⇒ no overlap ⇒ not viable),
+                        # sliced back off below — and recorded, never
+                        # silent (the pad_t discipline)
+                        D = int(mesh.devices.size)
+                        T = type_masks.shape[0]
+                        Tp = -(-T // D) * D
+                        tm = type_masks
+                        if Tp != T:
+                            tm = np.concatenate(
+                                [tm, np.zeros((Tp - T,) + tm.shape[1:], tm.dtype)]
+                            )
+                        record_shard_padding(
+                            "types", T, Tp, accumulate=False, n_devices=D
+                        )
+                        overlap = sharded_compat(
+                            mesh, jnp.asarray(sig_masks), jnp.asarray(tm)
+                        )
+                        # sync folds the all-gathered (S, T) once
+                        compat = (
+                            np.asarray(overlap)[:, :T] > 0.0  # analysis: allow-host-sync
+                        )
+                    else:
+                        compat = (sig_masks @ type_masks.T) > 0.0
+                    # the merged class admits a type iff EVERY signature
+                    # does (solver._prepare_class_jobs class semantics)
+                    viable = compat.all(axis=0)
+            t1 = time.perf_counter()
+            viable_idx = np.flatnonzero(viable)
+            if viable_idx.size == 0:
+                return {
+                    "nodes": 0,
+                    "pods": int(requests.shape[0]),
+                    "scheduled": 0,
+                    "total_price": 0.0,
+                    "shard": consume_shard_stats(),
+                    "error": "no viable instance type",
+                }
+            valloc = np.ascontiguousarray(alloc[viable_idx], dtype=np.int32)
+            vprices = np.asarray(prices, dtype=np.float64)[viable_idx]
+            with tracer.span("shard.mega.frontier"):
+                frontier = pareto_frontier(valloc)
+            # descending by primary then secondary axis (queue.go:76)
+            order = np.lexsort((-requests[:, 1], -requests[:, 0]))
+            sorted_reqs = np.ascontiguousarray(requests[order], dtype=np.int32)
+            t2 = time.perf_counter()
+            node_ids, node_count = sharded_pod_pack(
+                mesh, sorted_reqs, frontier, np.int32(max_per_node), engine=engine
+            )
+            t3 = time.perf_counter()
+            with tracer.span("shard.mega.assign", nodes=node_count):
+                usage = node_usage_from_assignment(sorted_reqs, node_ids, node_count)
+                chosen = assign_cheapest_types(usage, valloc, vprices)
+            t4 = time.perf_counter()
+            ok = chosen >= 0
+            scheduled = int((node_ids >= 0).sum()) - int(
+                np.isin(node_ids, np.flatnonzero(~ok)).sum()
+            )
+            out.update(
+                nodes=int(ok.sum()),
+                pods=int(requests.shape[0]),
+                scheduled=scheduled,
+                total_price=float(vprices[chosen[ok]].sum()),
+                node_ids=node_ids,
+                node_order=order,
+                chosen_types=viable_idx[np.maximum(chosen, 0)][ok],
+                frontier_rows=int(frontier.shape[0]),
+                viable_types=int(viable_idx.size),
+                compat_ms=round((t1 - t0) * 1000.0, 2),
+                prep_ms=round((t2 - t1) * 1000.0, 2),
+                pack_ms=round((t3 - t2) * 1000.0, 2),
+                assign_ms=round((t4 - t3) * 1000.0, 2),
+                wall_ms=round((t4 - t0) * 1000.0, 2),
+                shard=consume_shard_stats(),
+            )
+    return out
 
 
 def sharded_prefix_screen(
@@ -177,11 +503,15 @@ def prepare_sharded_catalog(
     cache the result per catalog generation (solver._entry_sharded) so
     the full-catalog transfer happens once, not per solve — the pinned-
     buffer design _entry_device_packed already uses for pallas. Padded
-    type rows have no available offering, so they read as disallowed."""
+    type rows have no available offering, so they read as disallowed —
+    but the padding is never silent: the wasted type slots land in this
+    solve's shard stats (and the solver re-records the active catalog's
+    padding per solve, cache hits included — see _encode_phase)."""
     axis = mesh.axis_names[0]
     D = int(mesh.devices.size)
     T = avail.shape[0]
     Tp = -(-T // D) * D
+    record_shard_padding("types", T, Tp, accumulate=False, n_devices=D)
 
     def pad_t(a: np.ndarray) -> np.ndarray:
         a = np.asarray(a)

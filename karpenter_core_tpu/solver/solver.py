@@ -30,6 +30,7 @@ exposes node count and total price for comparison.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import math
 import os
@@ -68,7 +69,7 @@ from .encode import (
 )
 from .kernels import allowed_host, allowed_kernel, build_compat_inputs, zone_ct_masks
 from . import devicetime, incremental
-from .stablehash import stable_hash
+from .stablehash import feed as stable_feed, stable_hash
 from ..tracing import tracer
 from .pack import (
     assign_cheapest_types,
@@ -307,23 +308,40 @@ def _catalog_fingerprint(catalog: List[InstanceType]) -> bytes:
     depends on: requirements (by value — an id() check would alias a
     replaced object onto a freed one's recycled id and serve stale
     masks), capacity, and the full offering tuples. A process-stable
-    digest (stablehash), not builtin ``hash()``: the bench's restart-
-    shaped cold solver and any future checkpointed warm state must
-    reproduce it under a different PYTHONHASHSEED."""
-    return stable_hash(
-        tuple(
-            (
-                it.name,
-                _requirements_fingerprint(it.requirements),
-                tuple(sorted(it.capacity.items())),
-                tuple(
-                    (o.zone, o.capacity_type, o.available, o.price)
-                    for o in it.offerings
-                ),
-            )
-            for it in catalog
-        )
-    )
+    digest (the stablehash canonical encoding), not builtin ``hash()``:
+    the bench's restart-shaped cold solver and any future checkpointed
+    warm state must reproduce it under a different PYTHONHASHSEED.
+
+    Streams one blake2b walk (length-prefixed strings, stablehash
+    scalar encoding for numerics, per-Requirements digests cached on
+    the objects) instead of materializing the nested tuple per call:
+    generation-less providers pay this on EVERY solve, and the tuple
+    walk was the largest single host phase of the warm headline solve
+    (r06→r07 ledger creep — encode.catalog 87→108 ms)."""
+    h = hashlib.blake2b(digest_size=16)
+    up = h.update
+    for it in catalog:
+        nb = it.name.encode()
+        up(b"t%d:" % len(nb))
+        up(nb)
+        reqs = it.requirements
+        up(reqs.fingerprint_digest() if reqs is not None else b"N")
+        cap = it.capacity
+        for k in sorted(cap):
+            kb = k.encode()
+            up(b"c%d:" % len(kb))
+            up(kb)
+            stable_feed(h, cap[k])
+        for o in it.offerings:
+            zb = o.zone.encode()
+            cb = o.capacity_type.encode()
+            up(b"o%d:" % len(zb))
+            up(zb)
+            up(b"%d:" % len(cb))
+            up(cb)
+            up(b"T" if o.available else b"F")
+            stable_feed(h, o.price)
+    return h.digest()
 
 
 def _catalog_entry(
@@ -611,6 +629,11 @@ class TPUScheduler:
         self._cstats = incremental.CacheStats()
         self._warm: Optional[incremental.WarmState] = None
         self.last_cache_stats: Optional[dict] = None
+        # pod/type-axis shard padding of the most recent solve (ISSUE
+        # 11: mesh padding is never silent — solver/sharding.py stats
+        # + the karpenter_tpu_shard_padding_waste gauge); None when the
+        # solve never touched a mesh
+        self.last_shard_stats: Optional[dict] = None
         # prep-time topology ledger state (rebuilt per tensor pass;
         # empty defaults keep direct sub-method calls in tests working)
         self._batch_pods: List[Pod] = []
@@ -711,6 +734,25 @@ class TPUScheduler:
                 if tr is not None and (self._cstats.hits or self._cstats.misses):
                     # hit rates ride on the solve trace → /debug/traces
                     tr.args["cache"] = self.last_cache_stats
+                # mesh shard padding (ISSUE 11): drain this solve's
+                # accumulator — per-solve stats field, trace args, and
+                # the padding-waste gauge (never silent)
+                from .sharding import consume_shard_stats
+
+                shard_stats = consume_shard_stats()
+                self.last_shard_stats = shard_stats or None
+                if shard_stats:
+                    if tr is not None:
+                        tr.args["shard"] = shard_stats
+                    if self.metrics is not None and hasattr(
+                        self.metrics, "shard_padding_waste"
+                    ):
+                        for axis in ("pods", "types"):
+                            waste = shard_stats.get(f"{axis}_waste")
+                            if waste is not None:
+                                self.metrics.shard_padding_waste.set(
+                                    float(waste), axis=axis
+                                )
                 if self.metrics is not None:
                     self.metrics.solver_duration.observe(total)
                     self.metrics.solver_device_duration.observe(device)
@@ -742,6 +784,10 @@ class TPUScheduler:
         # pack-backend outcome for this solve (solver/backends/): which
         # engine partitioned the jobs, LP guard wins, bound sums
         self._pack_backend_stats = {}
+        # fresh per-solve shard-padding accumulator (solver/sharding.py)
+        from .sharding import reset_shard_stats
+
+        reset_shard_stats()
         # cross-tick incremental state (solver/incremental.py): replay
         # probe first — a provably unchanged tick skips the pipeline
         # entirely; everything unprovable falls through to a full solve
@@ -1794,11 +1840,23 @@ class TPUScheduler:
                         # multi-chip: cached catalog T-shards live on the
                         # mesh, signatures replicate, XLA all-gathers the
                         # result
-                        from .sharding import allowed_sharded
+                        from .sharding import allowed_sharded, record_shard_padding
 
+                        prepared = _entry_sharded(e, mesh)
+                        # the ACTIVE catalog's type padding, re-recorded
+                        # per solve (the transfer-time record inside
+                        # prepare_sharded_catalog only fires on cache
+                        # misses — padding must never go silent on hits)
+                        record_shard_padding(
+                            "types",
+                            int(prepared[4]),
+                            int(prepared[3].shape[0]),
+                            accumulate=False,
+                            n_devices=int(mesh.devices.size),
+                        )
                         with devicetime.track():
                             fut = allowed_sharded(
-                                _entry_sharded(e, mesh), sig_arrays, zone_ok, ct_ok, keys
+                                prepared, sig_arrays, zone_ok, ct_ok, keys
                             )
                     elif (
                         backend == "tpu"
@@ -1946,6 +2004,10 @@ class TPUScheduler:
                     zone_ok[gi] = sub_zone[k]
                     ct_ok[gi] = sub_ct[k]
                 if missing and ws is not None:
+                    # the shard padding telemetry in the dispatch region
+                    # (record_shard_padding's `extra` kwargs) never flows
+                    # into the cached compat rows
+                    # analysis: allow-cache-key(extra)
                     self._cache_compat_rows(
                         e, pool_fps[pi], groups, missing,
                         sig_compats[pi], sub_allowed, sub_zone, sub_ct,

@@ -76,6 +76,14 @@ def _feed(h, value) -> None:
         )
 
 
+def feed(h, value) -> None:
+    """Stream one canonical scalar/tuple tree into an existing hasher —
+    the building block for hot fingerprint loops that digest many small
+    values without materializing a nested tuple per call (same encoding,
+    same normalization rules as ``stable_hash``)."""
+    _feed(h, value)
+
+
 def stable_hash(value, digest_size: int = 16) -> bytes:
     """128-bit content digest of a canonical scalar/tuple tree. Equal
     trees digest equally in every interpreter; unequal trees collide

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-SCHEMA = 1
+SCHEMA = 2  # 2: "shard" block added (pod/type-axis mesh padding, ISSUE 11)
 
 
 def _round3(v) -> float:
@@ -61,6 +61,7 @@ def solve_stats(solver, disruption=None) -> dict:
             "pairs_applied": int(ms.get("merge_pairs_applied", 0) or 0),
         },
         "pack_backend": dict(ps),
+        "shard": dict(ss) if (ss := getattr(solver, "last_shard_stats", None)) else None,
         "disruption": dict(dstats) if dstats else None,
     }
 
@@ -84,6 +85,9 @@ def bench_fields(stats: dict) -> dict:
     ps = stats.get("pack_backend", {})
     if ps and ps.get("backend") not in (None, "ffd"):
         out["pack_backend"] = dict(ps)
+    sh = stats.get("shard")
+    if sh:
+        out["shard"] = dict(sh)
     merge = stats.get("merge", {})
     out["merge_ms"] = round(merge.get("ms", 0.0), 2)
     out["merge_candidates_screened"] = merge.get("candidates_screened", 0)

@@ -110,6 +110,23 @@ def is_active(pod: Pod) -> bool:
     return not is_terminal(pod) and not is_terminating(pod)
 
 
+def disruption_screen_flags(pod: Pod) -> tuple:
+    """``(active, do_not_disrupt_block)`` — the two per-pod verdicts the
+    disruption candidate scan re-derives for every bound pod on every
+    pass (50k+ evaluations per decision at config-9 scale). Memoized on
+    the pod object behind its resource_version (the pod_eviction_cost
+    rv-guard pattern): any annotation/status/deletion edit moves the rv
+    and recomputes."""
+    cached = getattr(pod, "_karp_dscreen", None)
+    rv = pod.metadata.resource_version
+    if cached is not None and cached[0] == rv:
+        return cached[1]
+    active = not is_terminal(pod) and not is_terminating(pod)
+    flags = (active, active and has_do_not_disrupt(pod))
+    pod._karp_dscreen = (rv, flags)
+    return flags
+
+
 def is_reschedulable(pod: Pod) -> bool:
     """Pods that must be rescheduled elsewhere when their node is disrupted:
     active and not owned by the node / daemonset."""

@@ -97,6 +97,15 @@ def _parse_args():
                          "archetypes from bench.fleet_env)")
     ap.add_argument("--tenant-pods", type=int, default=200,
                     help="pods per tenant per round (with --fleet)")
+    ap.add_argument("--shard", type=int, default=0, metavar="N",
+                    help="mega-shard mode: profile one pod-axis sharded "
+                         "mega-solve over an N-way mesh (solver/sharding.py"
+                         " sharded_mega_solve; off-TPU this forces N XLA "
+                         "host devices before jax initializes)")
+    ap.add_argument("--shard-pods", type=int, default=500_000,
+                    help="pod count (with --shard)")
+    ap.add_argument("--shard-types", type=int, default=10_000,
+                    help="type count (with --shard)")
     return ap.parse_args()
 
 
@@ -106,9 +115,24 @@ def main():
         # mirrors --disrupt/--stream: one flag pins the engine for the
         # whole process (off-TPU: combine with BENCH_BACKEND=cpu)
         os.environ["KARPENTER_TPU_PACK_BACKEND"] = args.backend
+    if args.shard:
+        # the mesh width is an XLA init flag — force host devices
+        # BEFORE the first jax import (resolve_backend) when no real
+        # multi-device platform is pinned
+        platform = os.environ.get("JAX_PLATFORMS", "")
+        if os.environ.get("BENCH_BACKEND") == "cpu" or platform.startswith("cpu") or not platform:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={args.shard}"
+                ).strip()
+            os.environ.setdefault("BENCH_BACKEND", "cpu")
     out = {}
     backend = bench.resolve_backend(out)
     print("backend:", backend, file=sys.stderr)
+    if args.shard:
+        _shard_mode(args)
+        return
     if args.stream:
         _stream_mode(args)
         return
@@ -256,6 +280,48 @@ def _fleet_mode(args):
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)
+    print(s.getvalue())
+
+
+def _shard_mode(args):
+    """--shard N: one pod-axis sharded mega-solve (ISSUE 11) over an
+    N-way mesh at --shard-pods × --shard-types, cold + warm timings with
+    the per-stage split and padding stats, a sharded-vs-unsharded engine
+    identity check at a subsampled shape, then cProfile of one warm
+    sharded solve. Off-TPU this is the config-12 cell, in-process."""
+    import shardbench
+
+    from karpenter_core_tpu.solver.sharding import make_mesh, sharded_mega_solve
+
+    mesh = make_mesh(args.shard)
+    alloc, prices = shardbench.build_catalog(args.shard_types, 4, 12)
+    reqs = shardbench.build_pods(args.shard_pods, 4, 13)
+    sig_masks, type_masks = shardbench.build_masks(8, args.shard_types, 14)
+    t0 = time.perf_counter()
+    res = sharded_mega_solve(mesh, reqs, alloc, prices, sig_masks, type_masks)
+    print(f"cold: {(time.perf_counter()-t0)*1000:.1f} ms", file=sys.stderr)
+    for i in range(2):
+        res = sharded_mega_solve(mesh, reqs, alloc, prices, sig_masks, type_masks)
+        print(
+            f"warm {i}: {res['wall_ms']:.1f} ms (compat {res['compat_ms']}, "
+            f"pack {res['pack_ms']}, assign {res['assign_ms']}) "
+            f"{res['scheduled']} pods, {res['nodes']} nodes, "
+            f"frontier {res['frontier_rows']} rows",
+            file=sys.stderr,
+        )
+    print(f"shard stats: {res['shard']}", file=sys.stderr)
+    sub = shardbench.run_parity(mesh, min(args.shard_pods, 20_000), args.shard_types, 1)
+    print(
+        f"engine parity at {sub['pods']} pods: "
+        f"{sub['identical']}/{sub['cells']} identical",
+        file=sys.stderr,
+    )
+    pr = cProfile.Profile()
+    pr.enable()
+    sharded_mega_solve(mesh, reqs, alloc, prices, sig_masks, type_masks)
+    pr.disable()
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(45)
     print(s.getvalue())
 
 

@@ -1,0 +1,259 @@
+"""Pod-axis sharded mega-solve scaling bench (bench config 12, ISSUE 11).
+
+Drives ``solver.sharding.sharded_mega_solve`` — the giant-single-tenant
+scale path (one 500k–1M-pod × 10k-type solve chunked across the device
+mesh) — and prints ONE JSON line:
+
+  curve          — (pods × types × n_devices) cells: median warm wall,
+                   per-stage splits, nodes, pods/sec, shard padding
+  parity         — sharded vs unsharded engine plan identity at
+                   subsampled shapes (the unsharded vmap twin is the
+                   parity oracle), plus the chunk-overhead diagnostic
+                   vs the unchunked single-scan pack
+  plan_identical_all / mega_*_ms / speedup_8dev_vs_1dev — flat gate
+                   columns for hack/bench_ledger.py
+
+One measurement per process (the config-8 discipline). Off-TPU the
+process forces XLA host devices BEFORE importing jax
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N`` — the ISSUE 11
+"runnable off-TPU" contract); on a machine whose resolved platform
+already exposes enough devices it uses them as-is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _scale(n: int) -> int:
+    return max(1, int(n * float(os.environ.get("BENCH_SCALE", "1"))))
+
+
+def build_catalog(n_types: int, n_res: int, seed: int):
+    """Family-structured synthetic menu: ``n_types`` types drawn from 40
+    proportionally-scaled families (real menus are dominated chains —
+    the Pareto frontier stays small while the type axis is huge), plus
+    size-correlated prices with ±20% jitter."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    fam = rng.randint(0, 40, n_types)
+    base = rng.randint(4, 64, (40, n_res))
+    size = (1 + rng.randint(0, 250, n_types))[:, None]
+    alloc = (base[fam] * size).clip(1, 2**20).astype(np.int32)
+    prices = np.round(
+        (alloc.sum(axis=1, dtype=np.int64) / 100.0) * (0.8 + 0.4 * rng.rand(n_types)), 4
+    )
+    return alloc, prices
+
+
+def build_pods(n_pods: int, n_res: int, seed: int):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, 300, (n_pods, n_res)).astype(np.int32)
+
+
+def build_masks(n_sigs: int, n_types: int, seed: int, width: int = 64):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    sig = (rng.rand(n_sigs, width) < 0.7).astype(np.float32)
+    typ = (rng.rand(n_types, width) < 0.7).astype(np.float32)
+    return sig, typ
+
+
+def run_cell(mesh, pods: int, types: int, reps: int, seed: int = 12) -> dict:
+    import numpy as np
+
+    from karpenter_core_tpu.solver.sharding import sharded_mega_solve
+
+    alloc, prices = build_catalog(types, 4, seed)
+    reqs = build_pods(pods, 4, seed + 1)
+    sig_masks, type_masks = build_masks(8, types, seed + 2)
+    sharded_mega_solve(mesh, reqs, alloc, prices, sig_masks, type_masks)  # warm/compile
+    walls, last = [], None
+    for _ in range(reps):
+        last = sharded_mega_solve(mesh, reqs, alloc, prices, sig_masks, type_masks)
+        walls.append(last["wall_ms"])
+    wall = sorted(walls)[len(walls) // 2]
+    return {
+        "pods": pods,
+        "types": types,
+        "n_devices": int(mesh.devices.size),
+        "wall_ms": wall,
+        "compat_ms": last["compat_ms"],
+        "pack_ms": last["pack_ms"],
+        "assign_ms": last["assign_ms"],
+        "nodes": last["nodes"],
+        "scheduled": last["scheduled"],
+        "frontier_rows": last["frontier_rows"],
+        "pods_per_sec": round(pods / (wall / 1000.0), 1) if wall else 0.0,
+        "shard": last["shard"],
+    }
+
+
+def run_parity(mesh, pods: int, types: int, seeds: int) -> dict:
+    """Sharded vs unsharded engine identity at a subsampled shape, plus
+    the chunk-overhead diagnostic against the unchunked single scan."""
+    import numpy as np
+
+    from karpenter_core_tpu.solver.pack import ffd_pack, pareto_frontier
+    from karpenter_core_tpu.solver.sharding import sharded_mega_solve
+
+    cells = identical = 0
+    ratios = []
+    for seed in range(seeds):
+        alloc, prices = build_catalog(types, 4, 100 + seed)
+        reqs = build_pods(pods, 4, 200 + seed)
+        sig_masks, type_masks = build_masks(8, types, 300 + seed)
+        a = sharded_mega_solve(
+            mesh, reqs, alloc, prices, sig_masks, type_masks, engine="sharded"
+        )
+        b = sharded_mega_solve(
+            mesh, reqs, alloc, prices, sig_masks, type_masks, engine="unsharded"
+        )
+        cells += 1
+        identical += int(
+            np.array_equal(a["node_ids"], b["node_ids"])
+            and np.array_equal(a["chosen_types"], b["chosen_types"])
+            and abs(a["total_price"] - b["total_price"]) < 1e-9
+        )
+        # chunk overhead vs the unchunked scan (diagnostic, not a gate:
+        # the solve path re-merges chunk tails downstream)
+        order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+        frontier = pareto_frontier(alloc.astype(np.int32))
+        _, n_ref = ffd_pack(reqs[order], frontier, np.int32(2**31 - 1))
+        if a["nodes"]:
+            ratios.append(int(n_ref) / a["nodes"])
+    return {
+        "pods": pods,
+        "types": types,
+        "cells": cells,
+        "identical": identical,
+        "plan_parity": 1.0 if identical == cells else round(identical / max(cells, 1), 4),
+        "unchunked_node_ratio_min": round(min(ratios), 4) if ratios else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=8, help="mesh width to bench up to")
+    ap.add_argument("--pods", default="125000,250000,500000,1000000")
+    ap.add_argument("--types", default="2000,10000")
+    ap.add_argument("--mesh", default="1,2,4,8")
+    ap.add_argument("--parity-pods", type=int, default=20000)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument(
+        "--force-host",
+        choices=("auto", "1", "0"),
+        default="auto",
+        help="force N XLA host devices (auto: only when no real multi-device platform is pinned)",
+    )
+    ap.add_argument("--json", action="store_true", help="print one JSON line")
+    args = ap.parse_args(argv)
+
+    # device resolution BEFORE the first jax import: forcing host
+    # devices is an XLA init flag, not a runtime switch
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    force = args.force_host == "1" or (
+        args.force_host == "auto"
+        and (
+            os.environ.get("BENCH_BACKEND") == "cpu"
+            or platform.startswith("cpu")
+            or not platform
+        )
+    )
+    if force:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("KARPENTER_TPU_BACKEND", "cpu")
+
+    import jax
+
+    from karpenter_core_tpu.solver.sharding import make_mesh, shard_map_available
+
+    n_avail = len(jax.devices())
+    out: dict = {
+        "backend": jax.default_backend(),
+        "forced_host_devices": args.devices if force else 0,
+        "n_devices": n_avail,
+        "shard_map_available": shard_map_available(),
+    }
+    if not shard_map_available():
+        out["error"] = "no shard_map in this jax build"
+        print(json.dumps(out), flush=True)
+        return 1
+
+    pods_list = [_scale(int(p)) for p in args.pods.split(",") if p]
+    types_list = [int(t) for t in args.types.split(",") if t]
+    mesh_list = [d for d in (int(m) for m in args.mesh.split(",") if m) if d <= n_avail]
+    pods_hi, types_hi = max(pods_list), max(types_list)
+    anchor_pods = _scale(500_000)
+    if anchor_pods not in pods_list:
+        anchor_pods = pods_hi
+
+    # curve cells: device sweep at the anchor shape, pod sweep and type
+    # sweep at the widest mesh — a cross of the three axes, deduped
+    cells = []
+    seen = set()
+    widest = max(mesh_list)
+    for d in mesh_list:
+        cells.append((anchor_pods, types_hi, d))
+    for p in pods_list:
+        cells.append((p, types_hi, widest))
+    for t in types_list:
+        cells.append((anchor_pods, t, widest))
+    t_start = time.perf_counter()
+    curve = []
+    for p, t, d in cells:
+        if (p, t, d) in seen:
+            continue
+        seen.add((p, t, d))
+        curve.append(run_cell(make_mesh(d), p, t, args.reps))
+    out["curve"] = curve
+    out["curve_wall_s"] = round(time.perf_counter() - t_start, 1)
+
+    parity = run_parity(
+        make_mesh(widest), _scale(args.parity_pods), types_hi, args.seeds
+    )
+    out["parity"] = parity
+    out["plan_identical_all"] = parity["identical"] == parity["cells"]
+    out["plan_parity"] = parity["plan_parity"]
+
+    # flat gate columns for the ledger
+    def cell(p, t, d):
+        for c in curve:
+            if c["pods"] == p and c["types"] == t and c["n_devices"] == d:
+                return c
+        return None
+
+    anchor = cell(anchor_pods, types_hi, widest)
+    if anchor:
+        out["mega_500k_10k_ms"] = anchor["wall_ms"]
+        out["mega_pods_per_sec"] = anchor["pods_per_sec"]
+        out["shard_padding_waste_pods"] = anchor["shard"].get("pods_waste")
+        out["shard_padding_waste_types"] = anchor["shard"].get("types_waste")
+    one = cell(anchor_pods, types_hi, 1)
+    if anchor and one and anchor["wall_ms"]:
+        out["speedup_widest_vs_1dev"] = round(one["wall_ms"] / anchor["wall_ms"], 2)
+    biggest = cell(pods_hi, types_hi, widest)
+    if biggest:
+        out["mega_biggest_ms"] = biggest["wall_ms"]
+        out["mega_biggest_pods"] = biggest["pods"]
+
+    print(json.dumps(out) if args.json else json.dumps(out, indent=1), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -432,6 +432,7 @@ _MUT_FILES = [
     "karpenter_core_tpu/solver/backends/lp.py",
     "karpenter_core_tpu/fleet/registry.py",
     "karpenter_core_tpu/fleet/megasolve.py",
+    "karpenter_core_tpu/solver/sharding.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -485,8 +486,9 @@ _MUTANTS = [
      "fp = stable_hash(tuple(sorted(relevant)))",
      "fp = hash(tuple(sorted(relevant)))", "cache-determinism"),
     ("hash-catalog-fingerprint", "karpenter_core_tpu/solver/solver.py",
-     "    return stable_hash(\n        tuple(",
-     "    return hash(\n        tuple(", "cache-determinism"),
+     'up(reqs.fingerprint_digest() if reqs is not None else b"N")',
+     'up(str(hash(reqs.fingerprint())).encode() if reqs is not None else b"N")',
+     "cache-determinism"),
     ("set-iter-pool-fingerprint", "karpenter_core_tpu/solver/incremental.py",
      "tuple(\n            sorted((t.key, t.value, t.effect) for t in np_.spec.template.taints)\n        ),",
      "tuple({(t.key, t.value, t.effect) for t in np_.spec.template.taints}),",
@@ -540,6 +542,13 @@ _MUTANTS = [
     ("fleetenv-key-drop-tenant", "karpenter_core_tpu/fleet/megasolve.py",
      "key = (tenant_id, pool_name, gen)",
      "key = (pool_name, gen)", "cache-key"),
+    # ISSUE 11: the pod-shard chunk config (engine, threshold, mesh size)
+    # is job-memo key material via incremental.pack_engine_token
+    # (sharding.pod_shard_token). Its env reads happen inside the pack
+    # dispatch, invisible to the read-set slice (the PR-7 sim_drained
+    # precedent), so the no-alias invariant is held by
+    # tests/test_sharding.py::TestShardEngineMemoKeys instead of a
+    # mutant here.
     ("seed-key-drop-tenantscope", "karpenter_core_tpu/solver/solver.py",
      "skey = key + (\n                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope\n                )",
      "skey = key + (self._seed_exclusion_key(), self._sim_drained)", "cache-key"),

@@ -246,7 +246,7 @@ class TestDebugRoutes:
             # the stable top-level schema, always present
             assert set(doc) == {
                 "schema", "trace_id", "timings", "cache", "merge",
-                "pack_backend", "disruption",
+                "pack_backend", "shard", "disruption",
             }
             assert doc["timings"]["total_ms"] > 0
             assert doc["trace_id"] == solver.last_timings["trace_id"]

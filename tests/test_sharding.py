@@ -20,6 +20,8 @@ from karpenter_core_tpu.solver.sharding import (
     shard_map_available,
     sharded_batch_pack,
     sharded_compat,
+    sharded_mega_solve,
+    sharded_pod_pack,
     sharded_prefix_screen,
 )
 
@@ -224,3 +226,212 @@ class TestIntegratedShardedSolve:
         from karpenter_core_tpu.solver.sharding import active_mesh
 
         assert active_mesh("cpu") is None  # auto mode, non-TPU backend
+
+
+def _mega_inputs(seed: int, P: int, T: int, R: int = 4):
+    rng = np.random.RandomState(seed)
+    fam = rng.randint(0, 20, T)
+    base = rng.randint(4, 64, (20, R))
+    size = (1 + rng.randint(0, 100, T))[:, None]
+    alloc = (base[fam] * size).clip(1, 2**20).astype(np.int32)
+    prices = np.round((alloc.sum(axis=1, dtype=np.int64) / 100.0) * (0.8 + 0.4 * rng.rand(T)), 4)
+    reqs = rng.randint(1, 300, (P, R)).astype(np.int32)
+    W = 32
+    sig = (rng.rand(5, W) < 0.7).astype(np.float32)
+    typ = (rng.rand(T, W) < 0.7).astype(np.float32)
+    return reqs, alloc, prices, sig, typ
+
+
+class TestPodAxisMegaShard:
+    """ISSUE 11 tentpole: the pod-axis chunk pack across the mesh —
+    plan-identical to the unsharded vmap twin by construction, ragged
+    shapes included, degenerate meshes included, padding never silent."""
+
+    def test_ragged_shapes_3seed_plan_identity(self):
+        """Sharded vs unsharded engine identity at non-divisible pod AND
+        type counts, 3 seeds (the satellite's ragged-shape gate)."""
+        mesh = make_mesh(8)
+        for seed, (P, T) in enumerate([(10007, 1003), (5003, 517), (7777, 129)]):
+            reqs, alloc, prices, sig, typ = _mega_inputs(seed, P, T)
+            a = sharded_mega_solve(mesh, reqs, alloc, prices, sig, typ, engine="sharded")
+            b = sharded_mega_solve(mesh, reqs, alloc, prices, sig, typ, engine="unsharded")
+            np.testing.assert_array_equal(a["node_ids"], b["node_ids"])
+            np.testing.assert_array_equal(a["chosen_types"], b["chosen_types"])
+            assert a["total_price"] == pytest.approx(b["total_price"], abs=1e-9)
+            assert a["scheduled"] == b["scheduled"] == P
+
+    def test_one_device_mesh_degenerate(self):
+        """A 1-device mesh is a single chunk: the chunked pack IS the
+        plain ffd_pack, bit for bit."""
+        rng = np.random.RandomState(4)
+        P, R = 1001, 4
+        reqs = rng.randint(1, 200, (P, R)).astype(np.int32)
+        reqs = reqs[np.lexsort((-reqs[:, 1], -reqs[:, 0]))]
+        frontier = np.sort(rng.randint(500, 4000, (8, R)).astype(np.int32), axis=0)[::-1].copy()
+        ids, count = sharded_pod_pack(make_mesh(1), reqs, frontier, np.int32(1 << 30), engine="sharded")
+        ref_ids, ref_count = ffd_pack(reqs, frontier, np.int32(1 << 30))
+        np.testing.assert_array_equal(ids, np.asarray(ref_ids))
+        assert count == int(ref_count)
+
+    def test_shard_map_unavailable_falls_back(self, monkeypatch):
+        """No shard_map in the jax build: the sharded engine degrades to
+        the unsharded twin EXPLICITLY (same plan, stats say so) instead
+        of raising — the satellite's fallback gate."""
+        import karpenter_core_tpu.solver.sharding as sharding_mod
+
+        rng = np.random.RandomState(5)
+        reqs = rng.randint(1, 200, (333, 4)).astype(np.int32)
+        reqs = reqs[np.lexsort((-reqs[:, 1], -reqs[:, 0]))]
+        frontier = np.sort(rng.randint(500, 4000, (8, 4)).astype(np.int32), axis=0)[::-1].copy()
+        mesh = make_mesh(8)
+        want_ids, want_count = sharded_pod_pack(mesh, reqs, frontier, np.int32(1 << 30), engine="unsharded")
+        monkeypatch.setattr(sharding_mod, "_shard_map", None)
+        assert not sharding_mod.shard_map_available()
+        sharding_mod.reset_shard_stats()
+        got_ids, got_count = sharded_pod_pack(mesh, reqs, frontier, np.int32(1 << 30), engine="sharded")
+        np.testing.assert_array_equal(got_ids, want_ids)
+        assert got_count == want_count
+        stats = sharding_mod.consume_shard_stats()
+        assert stats["engine"] == "unsharded"  # the degrade is recorded
+
+    def test_padding_is_never_silent(self):
+        """Ragged pod/type counts must surface their padded-slot waste
+        in the mega-solve stats (the prepare_sharded_catalog pad_t
+        discipline, applied to both axes)."""
+        mesh = make_mesh(8)
+        reqs, alloc, prices, sig, typ = _mega_inputs(9, 1005, 103)
+        out = sharded_mega_solve(mesh, reqs, alloc, prices, sig, typ)
+        sh = out["shard"]
+        assert sh["pods_used"] == 1005 and sh["pods_padded"] == 1008
+        assert sh["types_used"] == 103 and sh["types_padded"] == 104
+        assert sh["pods_waste"] > 0 and sh["types_waste"] > 0
+        assert sh["n_devices"] == 8
+
+
+class TestIntegratedMegaShardSolve:
+    """The full TPUScheduler path: a job past KARPENTER_TPU_SHARD_MIN_PODS
+    chunk-packs across the mesh, chunk tails re-merge through the
+    ordinary merge engine, and the two shard engines stay plan-identical
+    end to end."""
+
+    def _solve(self, pods, n_types=30, metrics=None):
+        from helpers import make_nodepool
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_core_tpu.solver import TPUScheduler
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(n_types)
+        solver = TPUScheduler([make_nodepool()], provider, metrics=metrics)
+        return solver, solver.solve(pods)
+
+    def _pods(self, seed, n):
+        from helpers import make_pod
+
+        rng = np.random.RandomState(seed)
+        return [
+            make_pod(
+                requests={
+                    "cpu": ["250m", "500m", "1", "2"][rng.randint(4)],
+                    "memory": ["512Mi", "1Gi", "2Gi"][rng.randint(3)],
+                }
+            )
+            for _ in range(n)
+        ]
+
+    @staticmethod
+    def _plan_key(res):
+        return sorted(
+            (p.instance_type.name, p.zone, p.capacity_type, round(p.price, 9), tuple(p.pod_indices))
+            for p in res.node_plans
+        )
+
+    def test_full_solve_engines_plan_identical_3seed(self, monkeypatch):
+        import karpenter_core_tpu.native as native_mod
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARDED", "on")
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_MIN_PODS", "64")
+        monkeypatch.setenv("KARPENTER_TPU_INCREMENTAL", "0")
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        for seed in range(3):
+            n = 301 + seed  # ragged: never divisible by the 8-way mesh
+            monkeypatch.setenv("KARPENTER_TPU_SHARD_ENGINE", "sharded")
+            s1, a = self._solve(self._pods(seed, n))
+            monkeypatch.setenv("KARPENTER_TPU_SHARD_ENGINE", "unsharded")
+            s2, b = self._solve(self._pods(seed, n))
+            assert a.pods_scheduled == b.pods_scheduled == n
+            assert self._plan_key(a) == self._plan_key(b)
+            # the mega path actually ran, and padding is surfaced
+            assert s1.last_shard_stats is not None
+            assert s1.last_shard_stats["engine"] == "sharded"
+            assert s1.last_shard_stats["pods_used"] >= n // 2
+            assert s2.last_shard_stats["engine"] == "unsharded"
+
+    def test_padding_waste_gauge(self, monkeypatch):
+        from karpenter_core_tpu.metrics import Metrics
+
+        import karpenter_core_tpu.native as native_mod
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARDED", "on")
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_MIN_PODS", "64")
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        metrics = Metrics()
+        _, res = self._solve(self._pods(0, 251), metrics=metrics)
+        assert res.pods_scheduled == 251
+        for axis in ("pods", "types"):
+            assert metrics.shard_padding_waste.get(axis=axis) is not None
+
+
+class TestShardEngineMemoKeys:
+    """The pod-shard configuration is job-memo key material
+    (incremental.pack_engine_token pod_shard_token): flipping the shard
+    engine or threshold between ticks must never serve the other
+    configuration's cached skeleton. Read-set-invisible to cachesound
+    (env reads inside the pack dispatch), so the no-alias invariant
+    lives here (the PR-7 sim_drained precedent)."""
+
+    def test_shard_config_never_aliases_job_memo(self, monkeypatch):
+        from helpers import make_nodepool, make_pod
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_core_tpu.solver import TPUScheduler, incremental
+
+        import karpenter_core_tpu.native as native_mod
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARDED", "on")
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_MIN_PODS", "64")
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_ENGINE", "sharded")
+        monkeypatch.setenv("KARPENTER_TPU_INCREMENTAL", "1")
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        incremental.reset()
+
+        def pods():
+            # fresh content-identical objects per tick: the whole-solve
+            # replay layer (identity-keyed) misses, the content-keyed
+            # job memo is what serves the repeat
+            return [
+                make_pod(requests={"cpu": ["250m", "500m"][i % 2], "memory": "512Mi"})
+                for i in range(200)
+            ]
+
+        provider = FakeCloudProvider()
+        provider.instance_types = instance_types(20)
+        solver = TPUScheduler([make_nodepool()], provider)
+        solver.solve(pods())
+        solver.solve(pods())
+        hits_after_warm = (solver.last_cache_stats or {}).get("hits", {}).get("job", 0)
+        assert hits_after_warm >= 1  # same config: the skeleton replays
+
+        # flip the chunk threshold: the partition changes, so the memo
+        # key must change — a hit here would replay the WRONG partition
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_MIN_PODS", "1024")
+        solver.solve(pods())
+        stats = solver.last_cache_stats or {}
+        assert stats.get("hits", {}).get("job", 0) == 0
+        assert stats.get("misses", {}).get("job", 0) >= 1
+
+        # flip the engine: conservative no-alias (the engines are
+        # plan-identical by construction, but their keys stay distinct)
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_MIN_PODS", "64")
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_ENGINE", "unsharded")
+        solver.solve(pods())
+        stats = solver.last_cache_stats or {}
+        assert stats.get("hits", {}).get("job", 0) == 0
