@@ -1837,6 +1837,318 @@ def config11() -> dict:
 # ---------------------------------------------------------------------------
 
 
+def constraint_env(scenario: str, n_pods: int, seed: int = 13):
+    """One constraint-dense scenario (ISSUE 12, config 13): →
+    (pods, provider, nodepool, kube_client, state_nodes_factory).
+
+    - ``spread_skew``: zonal topology spread under a skewed seeded
+      distribution (blocker pods pre-bound across zones);
+    - ``anti_dense``: deployments carrying required zone/hostname
+      anti-affinity against batch-external services, mixed with plain
+      pods — the class the pre-ISSUE-12 router sent wholesale to the
+      per-pod oracle;
+    - ``stateful_dense``: statefulset-shaped pods with generic-ephemeral
+      PVCs against CSI-attach-limited existing nodes, plus host-port
+      deployments with overlapping and disjoint ports.
+    The state-node factory returns FRESH deep copies per solve so
+    repeated measurements never see mutated capacity."""
+    from karpenter_core_tpu.apis import labels as wk
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_core_tpu.kube.client import KubeClient
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        Node,
+        PodAffinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        Affinity,
+        StorageClass,
+        TopologySpreadConstraint,
+        Volume,
+    )
+    from karpenter_core_tpu.state.statenode import StateNode
+
+    rng = np.random.RandomState(seed)
+    provider = FakeCloudProvider()
+    provider.instance_types = instance_types(200)
+    nodepool = NodePool()
+    nodepool.metadata.name = "default"
+    kube = KubeClient()
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+    def seed_pod(name, labels, zone):
+        node_name = f"seed-{zone}"
+        if kube.get("Node", node_name) is None:
+            n = Node()
+            n.metadata.name = node_name
+            n.metadata.labels = {wk.LABEL_TOPOLOGY_ZONE: zone}
+            kube.create(n)
+        p = _mk_pod(name, "100m", "128Mi", labels=labels)
+        p.metadata.name = f"seed-{name}"
+        p.spec.node_name = node_name
+        p.status.phase = "Running"
+        p.status.conditions = []
+        kube.create(p)
+
+    pods = []
+    state_source: list = []
+    if scenario == "spread_skew":
+        # skewed seeds: zone-1 heavy for half the services
+        for d in range(20):
+            if d % 2 == 0:
+                for k in range(d % 5 + 1):
+                    seed_pod(f"skew-{d}-{k}", {"app": f"svc-{d}"}, zones[0])
+        for i in range(n_pods):
+            d = rng.randint(20)
+            pods.append(
+                _mk_pod(
+                    i,
+                    ["250m", "500m", "1"][rng.randint(3)],
+                    ["256Mi", "1Gi"][rng.randint(2)],
+                    labels={"app": f"svc-{d}"},
+                    spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=LabelSelector(
+                                match_labels={"app": f"svc-{d}"}
+                            ),
+                        )
+                    ],
+                )
+            )
+    elif scenario == "anti_dense":
+        # external anchor services the anti terms count (never in batch)
+        for s in range(8):
+            seed_pod(f"ext-{s}", {"app": f"ext-{s}"}, zones[s % 3])
+        for i in range(n_pods):
+            roll = rng.rand()
+            d = rng.randint(24)
+            if roll < 0.55:
+                # required zone anti-affinity against an external service
+                p = _mk_pod(
+                    i,
+                    ["250m", "500m", "1"][rng.randint(3)],
+                    ["256Mi", "1Gi"][rng.randint(2)],
+                    labels={"team": f"t-{d}"},
+                )
+                p.spec.affinity = Affinity(
+                    pod_anti_affinity=PodAntiAffinity(
+                        required=[
+                            PodAffinityTerm(
+                                topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": f"ext-{d % 8}"}
+                                ),
+                            )
+                        ]
+                    )
+                )
+                pods.append(p)
+            elif roll < 0.70:
+                # multi-term required anti-affinity (ISSUE 12): exclude
+                # the zones of TWO external services, plus a non-self
+                # hostname term (masks existing anchors only — a fresh
+                # node is an empty hostname domain)
+                p = _mk_pod(
+                    i,
+                    ["250m", "500m"][rng.randint(2)],
+                    ["256Mi", "512Mi"][rng.randint(2)],
+                    labels={"team": f"m-{d}"},
+                )
+                s1, s2 = d % 8, (d + 3) % 8
+                p.spec.affinity = Affinity(
+                    pod_anti_affinity=PodAntiAffinity(
+                        required=[
+                            PodAffinityTerm(
+                                topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": f"ext-{s1}"}
+                                ),
+                            ),
+                            PodAffinityTerm(
+                                topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": f"ext-{s2}"}
+                                ),
+                            ),
+                            PodAffinityTerm(
+                                topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": f"ext-{s1}"}
+                                ),
+                            ),
+                        ]
+                    )
+                )
+                pods.append(p)
+            else:
+                pods.append(_mk_pod(i, "500m", "512Mi"))
+    elif scenario == "stateful_dense":
+        sc = StorageClass(provisioner="ebs.csi.bench")
+        sc.metadata.name = "bench-standard"
+        sc.metadata.annotations = {
+            "storageclass.kubernetes.io/is-default-class": "true"
+        }
+        kube.create(sc)
+        for i in range(n_pods):
+            roll = rng.rand()
+            if roll < 0.4:
+                # statefulset pod: one generic-ephemeral PVC
+                p = _mk_pod(
+                    i,
+                    ["250m", "500m"][rng.randint(2)],
+                    ["512Mi", "1Gi"][rng.randint(2)],
+                )
+                p.spec.volumes = [Volume(name="data", ephemeral=True)]
+                pods.append(p)
+            elif roll < 0.7:
+                # host-port deployment: 12 distinct services, ports
+                # overlap across some services (conflicts) and not others
+                port = 8000 + rng.randint(12)
+                p = _mk_pod(i, "250m", "256Mi")
+                from karpenter_core_tpu.kube.objects import ContainerPort
+
+                p.spec.containers[0].ports = [ContainerPort(host_port=int(port))]
+                pods.append(p)
+            else:
+                pods.append(_mk_pod(i, "500m", "512Mi"))
+
+        def make_nodes():
+            out = []
+            for m in range(16):
+                n = Node()
+                n.metadata.name = f"csi-node-{m}"
+                n.metadata.labels = {
+                    wk.NODEPOOL_LABEL_KEY: "default",
+                    wk.LABEL_HOSTNAME: f"csi-node-{m}",
+                    wk.LABEL_TOPOLOGY_ZONE: zones[m % 3],
+                    wk.NODE_REGISTERED_LABEL_KEY: "true",
+                    wk.NODE_INITIALIZED_LABEL_KEY: "true",
+                }
+                n.status.capacity = {
+                    "cpu": 16 * 10**9,
+                    "memory": 64 * 1024**3,
+                    "pods": 110,
+                }
+                n.status.allocatable = dict(n.status.capacity)
+                sn = StateNode(node=n)
+                sn.volume_usage.csi_limits = {"ebs.csi.bench": 8}
+                out.append(sn)
+            return out
+
+        return pods, provider, nodepool, kube, make_nodes
+    else:
+        raise ValueError(f"unknown constraint scenario: {scenario}")
+    return pods, provider, nodepool, kube, lambda: []
+
+
+def constraint_run(scenario: str, n_pods: int, engine: str, reps: int = 3):
+    """Median wall + route stats of ``reps`` cold-shaped solves of one
+    constraint scenario under one engine → (ms_p50, route_stats, res)."""
+    from karpenter_core_tpu.solver import TPUScheduler, incremental
+
+    pods, provider, nodepool, kube, nodes_factory = constraint_env(scenario, n_pods)
+    os.environ["KARPENTER_TPU_CONSTRAINT_ENGINE"] = engine
+    try:
+        walls = []
+        res = solver = None
+        for _ in range(reps):
+            incremental.reset()
+            solver = TPUScheduler([nodepool], provider, kube_client=kube)
+            sns = nodes_factory()
+            with nogc():
+                t0 = time.perf_counter()
+                res = solver.solve(list(pods), state_nodes=sns)
+                walls.append((time.perf_counter() - t0) * 1000.0)
+        walls.sort()
+        return walls[len(walls) // 2], dict(solver.last_route_stats or {}), res
+    finally:
+        os.environ.pop("KARPENTER_TPU_CONSTRAINT_ENGINE", None)
+
+
+def _constraint_parity(scenario: str, n_pods: int, subsample: int) -> dict:
+    """Greedy-oracle plan parity on a stratified subsample of the
+    scenario (the full reference walk at 10k pods is minutes)."""
+    from karpenter_core_tpu.scheduler.builder import build_scheduler
+    from karpenter_core_tpu.solver import TPUScheduler, incremental
+
+    pods, provider, nodepool, kube, nodes_factory = constraint_env(scenario, n_pods)
+    sel = pods
+    if subsample < len(pods):
+        step = len(pods) / float(subsample)
+        sel = [pods[int(i * step)] for i in range(subsample)]
+    incremental.reset()
+    tpu = TPUScheduler([nodepool], provider, kube_client=kube).solve(
+        list(sel), state_nodes=nodes_factory()
+    )
+    oracle = build_scheduler(
+        kube, None, [nodepool], provider, list(sel), state_nodes=nodes_factory()
+    ).solve(list(sel))
+    o_nodes = len(oracle.new_node_claims)
+    o_sched = sum(len(c.pods) for c in oracle.new_node_claims) + sum(
+        len(e.pods) for e in oracle.existing_nodes
+    )
+    if tpu.pods_scheduled < o_sched:
+        parity = 0.0
+    elif tpu.node_count <= o_nodes:
+        parity = 1.0
+    else:
+        parity = o_nodes / tpu.node_count
+    return {
+        "parity": round(parity, 4),
+        "parity_oracle_nodes": o_nodes,
+        "parity_tpu_nodes": tpu.node_count,
+        "parity_pods": len(sel),
+    }
+
+
+def config13() -> dict:
+    """ISSUE 12: three constraint-dense scenarios, each with a greedy-
+    oracle plan-parity gate, the tensor-vs-oracle-path latency ratio
+    (oracle path = KARPENTER_TPU_CONSTRAINT_ENGINE=oracle, the
+    pre-ISSUE-12 routing), and the oracle-routed pod share."""
+    n = _scale(int(os.environ.get("BENCH_CONSTRAINT_PODS", "10000")))
+    sub = _scale(int(os.environ.get("BENCH_CONSTRAINT_PARITY_PODS", "1200")))
+    out: dict = {"config": "13: constraint-dense scenarios (ISSUE 12)", "pods": n}
+    speedups = []
+    shares = []
+    parities = []
+    for scenario in ("spread_skew", "anti_dense", "stateful_dense"):
+        t_ms, t_route, t_res = constraint_run(scenario, n, "tensor")
+        o_ms, o_route, _ = constraint_run(scenario, n, "oracle", reps=2)
+        parity = _constraint_parity(scenario, n, sub)
+        cell = {
+            "tensor_ms_p50": round(t_ms, 1),
+            "oracle_path_ms_p50": round(o_ms, 1),
+            "speedup": round(o_ms / t_ms, 2) if t_ms > 0 else 0.0,
+            "tensor_oracle_share": t_route.get("oracle_share", 0.0),
+            "legacy_oracle_share": o_route.get("oracle_share", 0.0),
+            "pods_scheduled": t_res.pods_scheduled,
+            "pod_errors": len(t_res.pod_errors),
+            **parity,
+        }
+        out[scenario] = cell
+        parities.append(cell["parity"])
+        if scenario != "spread_skew":
+            # spread was tensor BEFORE this issue — nothing to beat
+            speedups.append(cell["speedup"])
+            shares.append(cell["tensor_oracle_share"])
+    out["speedup_min"] = round(min(speedups), 2) if speedups else 0.0
+    out["oracle_share_max"] = round(max(shares), 4) if shares else 0.0
+    out["plan_parity_min"] = round(min(parities), 4) if parities else 0.0
+    # gates: identity on every cell, covered-class residue < 10%,
+    # tensor path ≥3x the legacy oracle path at scenario scale
+    out["gates"] = {
+        "plan_parity_min>=1.0": out["plan_parity_min"] >= 1.0,
+        "oracle_share_max<0.10": out["oracle_share_max"] < 0.10,
+        "speedup_min>=3.0": out["speedup_min"] >= 3.0,
+    }
+    return out
+
+
 def config12() -> dict:
     """Pod-axis sharded mega-solve scaling curve (ISSUE 11): one giant
     tenant's 125k–1M pods × 2k–10k types chunked across the device mesh
@@ -2005,7 +2317,7 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12, config13):
             try:
                 if fn in (config7, config8, config9, config11, config12):  # measure the incremental/serving/disruption/fleet/shard paths
                     configs.append(fn())

@@ -89,6 +89,11 @@ RELATIVE_GATES: List[Tuple[str, str, str]] = [
     # fingerprint), so the ratio alone no longer isolates batched-lane
     # regressions
     ("config11", "batched_pods_per_sec_at_128_small", "up"),
+    # ISSUE 12: the constraint-dense tensor path gated on its own wall
+    # (the speedup ratio's oracle denominator is the frozen legacy
+    # path, so only the tensor lane can regress it)
+    ("config13", "anti_dense.tensor_ms_p50", "down"),
+    ("config13", "stateful_dense.tensor_ms_p50", "down"),
 ]
 ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     # (config, metric, "floor"|"ceiling", bound)
@@ -109,6 +114,12 @@ ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     # shapes — losing it means the mesh path stopped being memoization
     ("config12", "plan_identical_all", "floor", 1.0),
     ("config12", "plan_parity", "floor", 1.0),
+    # ISSUE 12: greedy-oracle plan parity on every constraint-dense
+    # cell, the covered-class oracle residue, and the published 3x
+    # tensor-vs-legacy-path floor
+    ("config13", "plan_parity_min", "floor", 1.0),
+    ("config13", "oracle_share_max", "ceiling", 0.10),
+    ("config13", "speedup_min", "floor", 3.0),
 ]
 
 

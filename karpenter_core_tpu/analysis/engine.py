@@ -91,6 +91,10 @@ class AnalysisConfig:
         # pod-axis mega-shard (ISSUE 11): pod_shard_token contributes
         # job-memo key material (consumed by incremental.pack_engine_token)
         "karpenter_core_tpu/solver/sharding.py",
+        # constraint tensorization (ISSUE 12): the port/volume mask
+        # builders whose outputs ride job-memo keys (port_features) and
+        # existing-pack masks
+        "karpenter_core_tpu/solver/constraint_tensors.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)

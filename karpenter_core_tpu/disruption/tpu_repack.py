@@ -143,6 +143,39 @@ def _encode_candidates(candidates: List[Candidate]):
     return candidate_names, axis, loads, free
 
 
+def _stateful_screen_inputs(ctx, candidates, candidate_names, loads, free):
+    """Append the ISSUE-12 stateful axes (host-port feature columns,
+    CSI-driver attach columns) to the screen matrices, and return the
+    matching fleet/new-node extension builders. Soundness direction is
+    preserved (loads under-approximate, capacities over-approximate —
+    see solver/constraint_tensors.py), so k_hi == 0 still proves the
+    no-op. No-op (zero extra columns) for port/volume-free fleets."""
+    from ..solver.constraint_tensors import (
+        screen_axes_for_candidates,
+        screen_axes_for_fleet,
+    )
+
+    feats, drivers, s_loads, s_free, s_new = screen_axes_for_candidates(
+        candidates, getattr(ctx, "kube_client", None)
+    )
+    if s_new.size == 0:
+        return loads, free, None, None
+    loads = np.hstack([loads, s_loads])
+    free = np.hstack([free, s_free])
+
+    def fleet_ext() -> np.ndarray:
+        nodes = [
+            n
+            for n in ctx.cluster.deep_copy_nodes()
+            if not n.marked_for_deletion
+            and n.name() not in candidate_names
+            and n.initialized()
+        ]
+        return screen_axes_for_fleet(feats, drivers, nodes)
+
+    return loads, free, fleet_ext, s_new
+
+
 def screen_singles(ctx, candidates: List[Candidate]) -> np.ndarray:
     """(N,) bool feasibility screen for single-candidate consolidation.
     Screen-infeasible candidates cannot consolidate (capacity is a
@@ -157,6 +190,12 @@ def screen_singles(ctx, candidates: List[Candidate]) -> np.ndarray:
     candidate_names, axis, loads, free = _encode_candidates(candidates)
     fleet_free = _fleet_free(ctx, axis, candidate_names)
     new_node_cap = _largest_launchable(ctx, axis)
+    loads, free, fleet_ext, s_new = _stateful_screen_inputs(
+        ctx, candidates, candidate_names, loads, free
+    )
+    if s_new is not None:
+        fleet_free = np.concatenate([fleet_free, fleet_ext()])
+        new_node_cap = np.concatenate([new_node_cap, s_new])
     return np.asarray(
         single_screen_kernel(
             jnp.asarray(loads),
@@ -180,6 +219,12 @@ def screen_subsets(ctx, candidates: List[Candidate], masks: np.ndarray) -> np.nd
     candidate_names, axis, loads, free = _encode_candidates(candidates)
     fleet_free = _fleet_free(ctx, axis, candidate_names)
     new_node_cap = _largest_launchable(ctx, axis)
+    loads, free, fleet_ext, s_new = _stateful_screen_inputs(
+        ctx, candidates, candidate_names, loads, free
+    )
+    if s_new is not None:
+        fleet_free = np.concatenate([fleet_free, fleet_ext()])
+        new_node_cap = np.concatenate([new_node_cap, s_new])
     return np.asarray(
         subset_screen_kernel(
             jnp.asarray(masks.astype(np.float32)),
@@ -296,6 +341,41 @@ def repack_feasible(ctx, candidates: List[Candidate]) -> np.ndarray:
                 avail = node.available()
                 if not any(v < 0 for v in avail.values()):
                     free[m] = quantize_capacity(avail, axis)
+            # ISSUE 12: displaced host-port pods ride as feature columns
+            # (conflicts with fleet reservations AND between displaced
+            # pods are native to the scan); volume-limited nodes mask
+            # out per signature. Both only REMOVE placements, so the
+            # repack stays a valid lower bound.
+            from ..solver.constraint_tensors import (
+                PortFeatures,
+                node_reserved_ports,
+                volume_admit_matrix,
+                resolve_group_volumes,
+            )
+
+            sig_ports = [g.host_ports() for g in groups]
+            if any(sig_ports):
+                feats = PortFeatures(sig_ports)
+                if feats.count:
+                    sig_loads = feats.load_matrix(sig_ports)
+                    reqs = np.ascontiguousarray(
+                        np.hstack([reqs, sig_loads[sig_of]]), dtype=np.int32
+                    )
+                    free = np.ascontiguousarray(
+                        np.hstack(
+                            [
+                                free,
+                                feats.free_matrix(
+                                    [node_reserved_ports(n) for n in fleet_nodes]
+                                ),
+                            ]
+                        ),
+                        dtype=np.int32,
+                    )
+            kc = getattr(ctx, "kube_client", None)
+            if kc is not None and any(g.has_volumes for g in groups):
+                gvs = [resolve_group_volumes(kc, g) for g in groups]
+                compat = compat.astype(bool) & volume_admit_matrix(gvs, fleet_nodes)
             if compat.any():
                 assign, _ = run_pack_existing(reqs, sig_of, compat, free)
 
@@ -305,10 +385,12 @@ def repack_feasible(ctx, candidates: List[Candidate]) -> np.ndarray:
         left = assign < 0
         leftover_load = np.zeros((N, axis.count), dtype=np.int64)
         pod_fits_new = np.ones(N, dtype=bool)
+        reqs_res = reqs[:, : axis.count]  # resource slice (port columns
+        # are per-node state, meaningless on the one-replacement bound)
         for j in np.flatnonzero(left):
             ci = owner[j]
-            leftover_load[ci] += reqs[j].astype(np.int64)
-            if np.any(reqs[j] > new_node_cap):
+            leftover_load[ci] += reqs_res[j].astype(np.int64)
+            if np.any(reqs_res[j] > new_node_cap):
                 pod_fits_new[ci] = False
         cum = np.cumsum(leftover_load, axis=0)
         feasible = np.all(cum <= new_node_cap.astype(np.int64)[None, :], axis=1)
@@ -332,6 +414,12 @@ def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
     # the largest instance a replacement could be (upper bound; the oracle
     # verification enforces the real price/compat constraints)
     new_node_cap = _largest_launchable(ctx, axis)
+    loads, free, fleet_ext, s_new = _stateful_screen_inputs(
+        ctx, candidates, candidate_names, loads, free
+    )
+    if s_new is not None:
+        fleet_free = np.concatenate([fleet_free, fleet_ext()])
+        new_node_cap = np.concatenate([new_node_cap, s_new])
 
     feasible = np.asarray(
         prefix_screen_kernel(

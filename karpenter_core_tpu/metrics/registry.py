@@ -327,6 +327,15 @@ class Metrics:
             "Pack jobs through the LP-relaxation backend, by guard outcome (lp_won | ffd_kept)",
             ["outcome"],
         )
+        # constraint tensorization (ISSUE 12): per-solve pod routing
+        # split — how many pods ran on the tensor path vs parked
+        # (post-pack affinity) vs the greedy-oracle fallback; the
+        # oracle-routed share is the gated residue
+        self.solver_route_pods = r.counter(
+            f"{ns}_tpu_solver_route_pods",
+            "Pods per solve by constraint route (tensor | parked | oracle)",
+            ["route"],
+        )
         # pod-axis sharded mega-solves (solver/sharding.py): mesh
         # padding is never silent — wasted slot fraction of the last
         # solve's pod-chunk padding and type-shard padding

@@ -122,6 +122,10 @@ class AutoBackend(PackBackend):
     def _route(self, job: tuple, meta: dict) -> PackBackend:
         from ..calibrate import lp_min_job_work
 
+        if job[0].shape[1] != meta["alloc"].shape[1]:
+            # stateful port columns (ISSUE 12): FFD enforces them
+            # natively; the LP lane would just guard-reject
+            return self._ffd
         work = int(job[0].shape[0]) * int(len(meta["viable_idx"]))
         return self._lp if work >= lp_min_job_work() else self._ffd
 

@@ -324,6 +324,13 @@ class LPBackend(PackBackend):
         with tracer.span("lp.relax", jobs=n):
             for job, meta in zip(jobs, metas):
                 reqs = job[0]
+                if reqs.shape[1] != meta["alloc"].shape[1]:
+                    # stateful job (appended host-port feature columns,
+                    # ISSUE 12): the assignment LP prices the RESOURCE
+                    # axes only — keep FFD's partition, whose kernel
+                    # enforces the port columns natively
+                    routes.append(None)
+                    continue
                 prices = np.asarray(job_prices(meta), dtype=np.float64)
                 finite = np.isfinite(prices)
                 if not finite.any() or reqs.shape[0] == 0:

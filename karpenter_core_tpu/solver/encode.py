@@ -452,6 +452,15 @@ class SignatureGroup:
     # retries), which bypass every incremental cache.
     sig_id: Optional[int] = None
 
+    def _is_inns_term(self, term) -> bool:
+        """Term scoped to the pod's own namespace (no namespace selector,
+        namespaces empty or the pod's own) — cross-namespace scoping
+        stays on the oracle."""
+        if term.namespace_selector is not None:
+            return False
+        ns = list(term.namespaces)
+        return not ns or ns == [self.exemplar.namespace]
+
     def _is_self_term(self, term) -> bool:
         """The term's selector matches the exemplar's own labels in its
         own namespace — the per-deployment co-location/isolation pattern
@@ -460,18 +469,15 @@ class SignatureGroup:
         sel = term.label_selector
         if sel is None or not sel.matches(self.exemplar.metadata.labels):
             return False
-        if term.namespace_selector is not None:
-            return False
-        ns = list(term.namespaces)
-        return not ns or ns == [self.exemplar.namespace]
+        return self._is_inns_term(term)
 
-    def tensor_pod_affinity(self) -> Optional[str]:
-        """Topology key of a single REQUIRED pod-affinity term on
-        zone/hostname with no other affinity shape, whether or not the
-        selector matches the group itself — the shape the tensor path's
-        post-pack affinity resolution handles (cross-selector anchors
-        resolve against the batch's committed placements). Terms scoped
-        beyond the pod's own namespace stay on the oracle."""
+    def tensor_affinity_terms(self) -> Optional[list]:
+        """The group's REQUIRED pod-affinity terms when the whole set
+        has the tensorizable shape (ISSUE 12: multi-term required
+        affinity resolves post-pack by intersecting per-term domain
+        masks): every term on zone/hostname, in-namespace, selector
+        present, no preferred terms, no anti-affinity or spread mix —
+        else None (oracle residue)."""
         a = self.exemplar.spec.affinity
         if a is None or a.pod_affinity is None:
             return None
@@ -479,34 +485,59 @@ class SignatureGroup:
             return None  # affinity+anti interactions stay on the oracle
         if self.exemplar.spec.topology_spread_constraints:
             return None  # affinity+spread interactions stay on the oracle
-        if a.pod_affinity.preferred or len(a.pod_affinity.required) != 1:
+        if a.pod_affinity.preferred or not a.pod_affinity.required:
             return None
-        term = a.pod_affinity.required[0]
-        if term.topology_key not in (wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME):
+        hostname_terms = 0
+        for term in a.pod_affinity.required:
+            if term.topology_key not in (wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME):
+                return None
+            if term.topology_key == wk.LABEL_HOSTNAME:
+                hostname_terms += 1
+            if term.label_selector is None:
+                # nil selector semantics differ between worlds (the
+                # reference treats it as match-nothing) — oracle
+                return None
+            if not self._is_inns_term(term):
+                return None
+        if hostname_terms > 1:
+            # two host-scoped terms can interleave anchored and
+            # bootstrap states mid-group (each placement re-anchors the
+            # other term) — that walk stays on the oracle
             return None
-        if term.label_selector is None:
-            # nil selector semantics differ between worlds (the reference
-            # treats it as match-nothing) — keep on the oracle
+        return list(a.pod_affinity.required)
+
+    def tensor_pod_affinity(self) -> Optional[str]:
+        """Primary topology key of the tensorizable required affinity
+        terms: LABEL_HOSTNAME when any term is host-scoped (the hostname
+        post-pass zone-filters through the zone terms), else
+        LABEL_TOPOLOGY_ZONE; None when the shape stays on the oracle."""
+        terms = self.tensor_affinity_terms()
+        if terms is None:
             return None
-        if term.namespace_selector is not None:
-            return None
-        ns = list(term.namespaces)
-        if ns and ns != [self.exemplar.namespace]:
-            return None
-        return term.topology_key
+        if any(t.topology_key == wk.LABEL_HOSTNAME for t in terms):
+            return wk.LABEL_HOSTNAME
+        return wk.LABEL_TOPOLOGY_ZONE
+
+    def affinity_terms(self) -> list:
+        """The required pod-affinity terms behind tensor_pod_affinity
+        (call only when it returned a key)."""
+        return list(self.exemplar.spec.affinity.pod_affinity.required)
 
     def affinity_term(self):
-        """The single required pod-affinity term behind
-        tensor_pod_affinity (call only when it returned a key)."""
+        """First required pod-affinity term (single-term callers)."""
         return self.exemplar.spec.affinity.pod_affinity.required[0]
 
     def affinity_self_selecting(self) -> bool:
-        """Whether the group's pods match their own affinity selector —
-        gates the bootstrap-one-domain rule (topologygroup.go:226-232:
-        only self-selecting pods may seed an empty domain)."""
-        term = self.affinity_term()
-        sel = term.label_selector
-        return sel is None or sel.matches(self.exemplar.metadata.labels)
+        """Whether the group's pods match EVERY one of their own
+        affinity selectors — gates the bootstrap-one-domain rule
+        (topologygroup.go:226-232: only self-selecting pods may seed an
+        empty domain; with multiple terms, every anchor-less term must
+        be seedable by the pod itself)."""
+        return all(
+            t.label_selector is None
+            or t.label_selector.matches(self.exemplar.metadata.labels)
+            for t in self.affinity_terms()
+        )
 
     def self_pod_affinity(self) -> Optional[str]:
         """Topology key of a single self-selecting REQUIRED pod-affinity
@@ -529,18 +560,84 @@ class SignatureGroup:
                 return True
         return False
 
+    def tensor_anti_terms(self) -> Optional[list]:
+        """The group's REQUIRED anti-affinity terms when the whole set
+        tensorizes (ISSUE 12): every term on zone/hostname and
+        in-namespace, no preferred terms, no pod-affinity mix. Self
+        terms keep the pods-per-domain=1 paths; non-self terms become
+        static domain-exclusion masks from the seeded counts (the
+        routing layer additionally sends any group whose term selector
+        matches another BATCH group to the oracle — in-batch counted
+        placements need the oracle's interleaving, topology.go:190-219).
+        Spread mix: allowed only for the historical hostname-self shape
+        (max_per_node composes); anything else stays on the oracle.
+        Nil-selector terms match nothing (metav1 semantics) and ride
+        along as no-ops."""
+        a = self.exemplar.spec.affinity
+        if a is None or a.pod_anti_affinity is None:
+            return None
+        if a.pod_anti_affinity.preferred:
+            return None
+        if a.pod_affinity is not None:
+            return None  # affinity+anti interactions stay on the oracle
+        req = list(a.pod_anti_affinity.required)
+        if not req:
+            return None
+        for term in req:
+            if term.topology_key not in (wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME):
+                return None
+            if term.label_selector is not None and not self._is_inns_term(term):
+                return None
+        if self.exemplar.spec.topology_spread_constraints and not all(
+            t.topology_key == wk.LABEL_HOSTNAME and self._is_self_term(t)
+            for t in req
+            if t.label_selector is not None
+        ):
+            return None  # only hostname-self anti composes with spread
+        return req
+
+    def anti_exclusion_terms(self) -> list:
+        """Non-self tensor anti terms (selector anchors to OTHER pods):
+        the domain-exclusion mask inputs. Empty when none tensorize."""
+        terms = self.tensor_anti_terms()
+        if terms is None:
+            return []
+        return [
+            t
+            for t in terms
+            if t.label_selector is not None and not self._is_self_term(t)
+        ]
+
     @property
     def has_relational(self) -> bool:
         """Pod affinity/anti-affinity needs the oracle (SURVEY §7 hard
-        parts) — except the self-selecting shapes that tensorize:
-        anti-affinity on hostname (pods-per-node=1) or zone
-        (pods-per-zone=1), and single-term required affinity on
-        zone/hostname (anchor the whole group into one domain)."""
+        parts) — except the shapes that tensorize: required anti-
+        affinity on zone/hostname (self terms → pods-per-domain=1,
+        non-self terms → seeded domain-exclusion masks, ISSUE 12) and
+        multi-term required affinity on zone/hostname (post-pack
+        intersected anchor masks)."""
         a = self.exemplar.spec.affinity
         if a is None:
             return False
         if a.pod_affinity is not None and (a.pod_affinity.required or a.pod_affinity.preferred):
-            if self.tensor_pod_affinity() is None:
+            if self.tensor_affinity_terms() is None:
+                return True
+        if a.pod_anti_affinity is not None:
+            if self.tensor_anti_terms() is None:
+                return True
+        return False
+
+    @property
+    def has_relational_legacy(self) -> bool:
+        """The pre-ISSUE-12 routing predicate, kept verbatim as the
+        KARPENTER_TPU_CONSTRAINT_ENGINE=oracle identity reference:
+        only self-selecting single-term shapes tensorize."""
+        a = self.exemplar.spec.affinity
+        if a is None:
+            return False
+        if a.pod_affinity is not None and (a.pod_affinity.required or a.pod_affinity.preferred):
+            terms = self.tensor_affinity_terms()
+            if terms is None or len(terms) != 1:
                 return True
         if a.pod_anti_affinity is not None:
             req = a.pod_anti_affinity.required
@@ -560,9 +657,10 @@ class SignatureGroup:
 
     @property
     def has_stateful_node_constraints(self) -> bool:
-        """Host ports / PVC volumes need per-node conflict state the pack
-        matrix doesn't model (hostportusage.go:70, volumeusage.go:79) —
-        these groups route to the oracle."""
+        """Host ports / PVC volumes carry per-node conflict state
+        (hostportusage.go:70, volumeusage.go:79). ISSUE 12 folds both
+        into the pack scan (port feature axes, volume admit masks) for
+        topology-free groups; see tensor_stateful."""
         spec = self.exemplar.spec
         for c in spec.containers + spec.init_containers:
             for p in c.ports:
@@ -572,6 +670,33 @@ class SignatureGroup:
             if v.persistent_volume_claim is not None or v.ephemeral:
                 return True
         return False
+
+    @property
+    def tensor_stateful(self) -> bool:
+        """Stateful (port/volume) group whose shape the tensor path
+        covers: no pod affinity/anti-affinity and no topology spread —
+        stateful × topology combinations remain oracle residue."""
+        if not self.has_stateful_node_constraints:
+            return False
+        spec = self.exemplar.spec
+        if spec.topology_spread_constraints:
+            return False
+        a = spec.affinity
+        return a is None or (a.pod_affinity is None and a.pod_anti_affinity is None)
+
+    def host_ports(self) -> tuple:
+        """Canonical (protocol, port, ip) triples of the group's host
+        ports (identical across members — ports ride the signature)."""
+        from .constraint_tensors import canonical_ports
+
+        return canonical_ports(self.exemplar)
+
+    @property
+    def has_volumes(self) -> bool:
+        return any(
+            v.persistent_volume_claim is not None or v.ephemeral
+            for v in self.exemplar.spec.volumes
+        )
 
     @property
     def hostname_isolated(self) -> bool:

@@ -338,14 +338,16 @@ def _batch_pack(jobs: list, engine: str, mesh) -> list:
     from .backend import default_backend
 
     default_backend()  # device boundary (see run_pack_existing)
-    R = jobs[0][0].shape[1]
     F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
+    # size classes ALSO split on the column count: stateful jobs carry
+    # appended host-port feature columns (ISSUE 12), so one solve can
+    # hold jobs of different widths — a vmapped batch cannot
     classes: dict = {}
     for g, job in enumerate(jobs):
-        classes.setdefault(_pad_class(job[0].shape[0]), []).append(g)
+        classes.setdefault((_pad_class(job[0].shape[0]), job[0].shape[1]), []).append(g)
 
     results: list = [None] * len(jobs)
-    for p_pad, members in classes.items():
+    for (p_pad, R), members in classes.items():
         G = len(members)
         requests = np.zeros((G, p_pad, R), dtype=np.int32)
         frontiers = np.zeros((G, F_pad, R), dtype=np.int32)
@@ -377,14 +379,14 @@ def _batch_pack_sharded(mesh, jobs: list) -> list:
     from .sharding import sharded_batch_pack
 
     D = int(mesh.devices.size)
-    R = jobs[0][0].shape[1]
     F_pad = 1 << max((max(len(j[1]) for j in jobs) - 1).bit_length(), 0)
     classes: dict = {}
     for g, job in enumerate(jobs):
-        classes.setdefault(_pad_class(job[0].shape[0]), []).append(g)
+        # split on column count too (stateful port columns, ISSUE 12)
+        classes.setdefault((_pad_class(job[0].shape[0]), job[0].shape[1]), []).append(g)
 
     results: list = [None] * len(jobs)
-    for p_pad, members in classes.items():
+    for (p_pad, R), members in classes.items():
         G = -(-len(members) // D) * D
         requests = np.ones((G, p_pad, R), dtype=np.int32)
         frontiers = np.zeros((G, F_pad, R), dtype=np.int32)

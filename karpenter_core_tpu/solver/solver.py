@@ -9,23 +9,29 @@ Pipeline per solve:
   host: cheapest-type/offering per packed node → NodePlans
 
 Remaining ORACLE-ONLY terms (everything else — including cross-selector
-topology spread and cross-selector single-term required pod affinity on
-zone/hostname, r5 — runs on the tensor path):
-  - pod ANTI-affinity whose selector matches pods outside the group
+topology spread, multi-term required pod affinity, required anti-
+affinity with batch-external selectors, and topology-free host-port /
+PVC-volume groups, ISSUE 12 — runs on the tensor path):
+  - pod ANTI-affinity whose selector matches another BATCH group
     (inverse-anti semantics, topology.go:190-219: later placements of
     the counted group could violate an earlier group's term — needs the
     oracle's per-pod interleaving)
   - anti-affinity with preferred terms, or on keys other than
     zone/hostname
-  - affinity+anti-affinity or affinity+spread combinations on one pod
-  - multi-term or preferred pod affinity
-  - affinity terms with namespace selectors / cross-namespace lists
+  - affinity+anti-affinity, affinity+spread, anti+spread (beyond the
+    hostname-self shape), and stateful×topology combinations
+  - preferred pod affinity
+  - affinity terms with namespace selectors / cross-namespace lists,
+    or nil affinity selectors
   - groups whose counting selectors interact with oracle-routed groups
     (either direction — the two worlds can't see each other's
     placements mid-solve)
-  - stateful node constraints (host ports, PVC volumes)
-The oracle also serves as the parity reference: ``SolverResult``
-exposes node count and total price for comparison.
+The newly tensorized classes keep the engine-switch discipline:
+``KARPENTER_TPU_CONSTRAINT_ENGINE={tensor,oracle}`` — ``oracle``
+restores the pre-ISSUE-12 routing and is the identity reference the
+parity suites and bench config 13 gate against. The oracle also serves
+as the parity reference: ``SolverResult`` exposes node count and total
+price for comparison.
 """
 
 from __future__ import annotations
@@ -155,21 +161,38 @@ class _DeferredHostCompat:
         return allowed_host(*self.args)
 
 
+def constraint_engine() -> str:
+    """ISSUE 12 engine switch, read per solve (the PR-2/PR-7 pattern):
+    ``tensor`` (default) routes the newly tensorized constraint classes
+    — non-self required anti-affinity, multi-term required affinity,
+    topology-free host-port/volume groups — through the device path;
+    ``oracle`` restores the pre-ISSUE-12 routing (the identity
+    reference the parity gates compare against)."""
+    eng = os.environ.get("KARPENTER_TPU_CONSTRAINT_ENGINE", "tensor").strip().lower()
+    return "oracle" if eng == "oracle" else "tensor"
+
+
 def _group_node_limits(group: SignatureGroup) -> list:
     """Hostname-level per-node constraints a node holding this group's
     pods must keep satisfying if other pods merge onto it:
     (selector, namespace, max matching pods per node) triples from
-    hostname topology spread and self hostname anti-affinity."""
+    hostname topology spread and hostname anti-affinity. A NON-self
+    hostname anti term contributes cap 0: the node must never gain a
+    selector-matching pod (routing guarantees no batch group matches,
+    so the limit is defense-in-depth on merges/joins)."""
     limits = []
     ns = group.exemplar.namespace
     hs = group.hostname_spread()
     if hs is not None:
         limits.append((hs.label_selector, ns, int(hs.max_skew)))
-    if group.hostname_isolated:
-        a = group.exemplar.spec.affinity
-        for term in a.pod_anti_affinity.required:
-            if term.topology_key == wk.LABEL_HOSTNAME:
-                limits.append((term.label_selector, ns, 1))
+    anti_terms = group.tensor_anti_terms() or []
+    for term in anti_terms:
+        if term.topology_key != wk.LABEL_HOSTNAME or term.label_selector is None:
+            continue
+        if group._is_self_term(term):
+            limits.append((term.label_selector, ns, 1))
+        else:
+            limits.append((term.label_selector, ns, 0))
     return limits
 
 
@@ -643,6 +666,14 @@ class TPUScheduler:
         self._postpass_matrix = None
         self._postpass_remaining: Optional[Dict[str, dict]] = None
         self._sim_drained: Optional[tuple] = None
+        # ISSUE 12: per-solve route split (tensor/parked/oracle pod
+        # counts + oracle share) — /debug/solve/stats "route" block,
+        # bench `route` column, solver_route_pods counter
+        self.last_route_stats: Optional[dict] = None
+        # ISSUE 12 per-solve constraint caches: anti-affinity excluded
+        # zones per group, resolved group volumes per group
+        self._anti_zone_excl_cache: Dict[int, frozenset] = {}
+        self._group_vols_cache: Dict[int, object] = {}
         # fleet tenancy (fleet/registry.py): a non-empty scope isolates
         # every identity/generation-scoped cross-solve memo this solver
         # touches — the warm state it resolves to, the topology seed
@@ -803,6 +834,14 @@ class TPUScheduler:
             else None
         )
         self._seed_excl: Optional[tuple] = None
+        self._anti_zone_excl_cache = {}
+        self._group_vols_cache = {}
+        # PV/StorageClass zone pins must reach the tensor path's compat
+        # algebra (the oracle injects them in build_scheduler): fold
+        # them into volume-bearing pods' node affinity BEFORE the memo
+        # read, skipping pods whose pin is already present (ISSUE 12)
+        if self.kube_client is not None:
+            self._inject_volume_zones(pods)
         from . import podcache
 
         with tracer.span("pod_memos"):
@@ -954,19 +993,26 @@ class TPUScheduler:
 
         The split is a pure function of the batch's ordered signature
         set (signatures embed every label key any selector in the batch
-        can match), so it is memoized across solves on the interned
-        signature-id tuple (solver/incremental.py)."""
+        can match) AND the constraint-engine switch, so it is memoized
+        across solves on the interned signature-id tuple plus the
+        engine token (solver/incremental.py). The env read itself is
+        read-set-invisible to the cachesound slice (the PR-7/PR-11
+        precedent); the no-alias invariant is held by
+        tests/test_constraint_tensors.py::TestRouteCacheEngineToken."""
         ws = self._warm
         key = incremental.route_key(groups) if ws is not None else None
         if key is not None:
+            key = key + (("ce", constraint_engine()),)
             cached = ws.routes.get(key, self._cstats)
             if cached is not None:
                 t_idx, p_idx, o_idx = cached
-                return (
+                split = (
                     [groups[i] for i in t_idx],
                     [groups[i] for i in p_idx],
                     [pods[i] for gi in o_idx for i in groups[gi].pod_indices],
                 )
+                self._observe_route_split(*split)
+                return split
         tensor_groups, parked, oracle_groups = self._route_groups_impl(pods, groups)
         if key is not None:
             pos = {id(g): i for i, g in enumerate(groups)}
@@ -981,7 +1027,29 @@ class TPUScheduler:
         oracle_pods: List[Pod] = [
             pods[i] for g in oracle_groups for i in g.pod_indices
         ]
+        self._observe_route_split(tensor_groups, parked, oracle_pods)
         return tensor_groups, parked, oracle_pods
+
+    def _observe_route_split(self, tensor_groups, parked, oracle_pods) -> None:
+        """ISSUE 12 satellite: the per-solve route split is visible and
+        gateable, never silent — per-solve stats (→ /debug/solve/stats,
+        bench `route` column) plus the
+        karpenter_tpu_solver_route_pods{route=} counter."""
+        counts = {
+            "tensor": sum(len(g.pod_indices) for g in tensor_groups),
+            "parked": sum(len(g.pod_indices) for g in parked),
+            "oracle": len(oracle_pods),
+        }
+        total = sum(counts.values())
+        self.last_route_stats = {
+            **counts,
+            "engine": constraint_engine(),
+            "oracle_share": round(counts["oracle"] / total, 4) if total else 0.0,
+        }
+        if self.metrics is not None and hasattr(self.metrics, "solver_route_pods"):
+            for route, n in counts.items():
+                if n:
+                    self.metrics.solver_route_pods.inc(n, route=route)
 
     def _route_groups_impl(
         self, pods: List[Pod], groups: List[SignatureGroup]
@@ -992,9 +1060,22 @@ class TPUScheduler:
             ids = {id(g) for g in subset}
             return [g for g in pool if id(g) not in ids]
 
-        relational = [
-            g for g in groups if g.has_relational or g.has_stateful_node_constraints
-        ]
+        engine = constraint_engine()
+        if engine == "oracle":
+            # identity reference: the pre-ISSUE-12 split (every stateful
+            # group and every non-self/multi-term shape → oracle)
+            relational = [
+                g
+                for g in groups
+                if g.has_relational_legacy or g.has_stateful_node_constraints
+            ]
+        else:
+            relational = [
+                g
+                for g in groups
+                if g.has_relational
+                or (g.has_stateful_node_constraints and not g.tensor_stateful)
+            ]
         tensor_groups = exclude(groups, relational)
         # pods *selected by* a relational pod's affinity terms must schedule
         # in the same (oracle) world, or affinity can't anchor to them
@@ -1036,13 +1117,27 @@ class TPUScheduler:
         for g in tensor_groups:
             sels = []
             a = g.exemplar.spec.affinity
-            if a is not None and (g.zone_anti_isolated or g.hostname_isolated):
-                if a.pod_anti_affinity is not None:
-                    sels.extend(
-                        t.label_selector
-                        for t in a.pod_anti_affinity.required
-                        if t.label_selector is not None
-                    )
+            if engine == "oracle":
+                if a is not None and (g.zone_anti_isolated or g.hostname_isolated):
+                    if a.pod_anti_affinity is not None:
+                        sels.extend(
+                            t.label_selector
+                            for t in a.pod_anti_affinity.required
+                            if t.label_selector is not None
+                        )
+            else:
+                # ISSUE 12: EVERY tensor-routed anti group (self or
+                # exclusion terms) whose selector matches another batch
+                # group needs the oracle's interleaving — the counted
+                # group's later placements could violate the term
+                # (topology.go:190-219 inverse-anti semantics); with no
+                # batch match the counts are static seeds, which is what
+                # makes the exclusion masks sound
+                sels.extend(
+                    t.label_selector
+                    for t in (g.tensor_anti_terms() or ())
+                    if t.label_selector is not None
+                )
             if sels and any(
                 sel.matches(h.exemplar.metadata.labels)
                 for h in groups
@@ -1063,7 +1158,15 @@ class TPUScheduler:
         # pods first" — their counts at placement time are exactly the
         # seeds+ledger, and later unconstrained-by-that-constraint
         # placements may unbalance them, as the reference permits.
-        parked = [g for g in tensor_groups if g.tensor_pod_affinity() is not None]
+        if engine == "oracle":
+            parked = [
+                g
+                for g in tensor_groups
+                if g.tensor_pod_affinity() is not None
+                and len(g.tensor_affinity_terms() or ()) == 1
+            ]
+        else:
+            parked = [g for g in tensor_groups if g.tensor_pod_affinity() is not None]
         tensor_groups = exclude(tensor_groups, parked)
         # hostname topologies stay tensor even with existing capacity:
         # hostname domains always see a global min of 0
@@ -1120,9 +1223,10 @@ class TPUScheduler:
             for g in parked:
                 if id(g) in moved_ids:
                     continue
-                sel = g.affinity_term().label_selector
-                if sel is not None and any(
-                    sel.matches(labels) for labels in frontier_labels
+                if any(
+                    t.label_selector is not None and t.label_selector.matches(labels)
+                    for t in g.affinity_terms()
+                    for labels in frontier_labels
                 ):
                     moved.append(g)
             if not moved:
@@ -1253,10 +1357,13 @@ class TPUScheduler:
                 or g.hostname_isolated
                 or g.tensor_pod_affinity() is not None
                 or g.zone_anti_isolated
+                or g.anti_exclusion_terms()
+                or g.has_stateful_node_constraints
             ):
-                # topology/affinity-constrained pods must go through
-                # their seeded domain-assignment paths; a plain backfill
-                # append ignores domain counts and per-node caps
+                # topology/affinity/stateful-constrained pods must go
+                # through their seeded domain-assignment / masked pack
+                # paths; a plain backfill append ignores domain counts,
+                # per-node caps, and port/volume conflict state
                 remaining.append(g)
                 continue
             pod_reqs = _pod_reqs(g.exemplar)
@@ -1452,7 +1559,10 @@ class TPUScheduler:
             return  # parked-only batch: ctx stashed for the post-pass
         # topology-constrained groups (zone spread, self-affinity, zone
         # anti-affinity) are domain-assigned before touching existing
-        # capacity — exclude them from this selector-blind pack
+        # capacity — exclude them from this selector-blind pack.
+        # Stateful (host-port / volume) groups pack AFTER it through the
+        # per-group masked path (_pack_stateful_existing): their
+        # per-node conflict state is live across placements (ISSUE 12).
         pack = [
             (gi, g)
             for gi, g in enumerate(groups)
@@ -1461,43 +1571,180 @@ class TPUScheduler:
             and not g.zone_anti_isolated
             and g.hostname_spread() is None
             and not g.hostname_isolated
+            and not g.has_stateful_node_constraints
         ]
-        if not pack:
-            return
-        sub_groups = [g for _, g in pack]
-        # signature × node admissibility (shared with the consolidation
-        # repack — disruption/tpu_repack.py)
-        compat = existing_node_compat(sub_groups, nodes)
-        if not compat.any():
-            return
+        stateful = [
+            (gi, g)
+            for gi, g in enumerate(groups)
+            if g.has_stateful_node_constraints
+            and g.zone_spread() is None
+            and g.tensor_pod_affinity() is None
+            and not g.zone_anti_isolated
+            and g.hostname_spread() is None
+            and not g.hostname_isolated
+        ]
+        if pack:
+            sub_groups = [g for _, g in pack]
+            # signature × node admissibility (shared with the consolidation
+            # repack — disruption/tpu_repack.py); non-self anti-affinity
+            # exclusion masks (zones/hosts with seeded matching pods) fold
+            # in per group
+            compat = existing_node_compat(sub_groups, nodes)
+            for s, g in enumerate(sub_groups):
+                excl = self._anti_exclusion_row(g, self._existing_ctx)
+                if excl is not None:
+                    compat[s] &= ~excl
+            if compat.any():
+                # global pack in the oracle's pod order: all pods
+                # descending by (primary, memory) — queue.go:76
+                pod_idx = np.array(
+                    [i for g in sub_groups for i in g.pod_indices], dtype=np.int64
+                )
+                sig_ids = np.array(
+                    [s for s, g in enumerate(sub_groups) for _ in g.pod_indices],
+                    dtype=np.int32,
+                )
+                reqs = build_requests_matrix_ids(
+                    self._req_ids[pod_idx], axis, self._req_map
+                )
+                order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+                pod_idx, sig_ids, reqs = pod_idx[order], sig_ids[order], reqs[order]
+                assign, free_out = run_pack_existing(reqs, sig_ids, compat, free)
+                self._existing_ctx["free"] = np.ascontiguousarray(
+                    free_out, dtype=np.int32
+                )
 
-        # global pack in the oracle's pod order: all pods descending by
-        # (primary, memory) — queue.go:76
-        pod_idx = np.array(
-            [i for g in sub_groups for i in g.pod_indices], dtype=np.int64
-        )
-        sig_ids = np.array(
-            [s for s, g in enumerate(sub_groups) for _ in g.pod_indices],
-            dtype=np.int32,
-        )
-        reqs = build_requests_matrix_ids(self._req_ids[pod_idx], axis, self._req_map)
-        order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
-        pod_idx, sig_ids, reqs = pod_idx[order], sig_ids[order], reqs[order]
-        assign, free_out = run_pack_existing(reqs, sig_ids, compat, free)
-        self._existing_ctx["free"] = np.ascontiguousarray(free_out, dtype=np.int32)
+                by_node: Dict[int, List[int]] = {}
+                for j in np.flatnonzero(assign >= 0):
+                    by_node.setdefault(int(assign[j]), []).append(int(pod_idx[j]))
+                if by_node:
+                    assigned = {i for members in by_node.values() for i in members}
+                    for gi, g in pack:
+                        leftover[gi] = [
+                            i for i in g.pod_indices if i not in assigned
+                        ]
+                    for m in sorted(by_node):
+                        result.existing_plans.append(
+                            ExistingNodePlan(
+                                state_node=nodes[m], pod_indices=by_node[m]
+                            )
+                        )
+        if stateful:
+            with tracer.span("existing_pack.stateful", groups=len(stateful)):
+                self._pack_stateful_existing(stateful, leftover, result)
 
-        by_node: Dict[int, List[int]] = {}
-        for j in np.flatnonzero(assign >= 0):
-            by_node.setdefault(int(assign[j]), []).append(int(pod_idx[j]))
-        if not by_node:
-            return
-        assigned = {i for members in by_node.values() for i in members}
-        for gi, g in pack:
-            leftover[gi] = [i for i in g.pod_indices if i not in assigned]
-        for m in sorted(by_node):
-            result.existing_plans.append(
-                ExistingNodePlan(state_node=nodes[m], pod_indices=by_node[m])
+    def _pack_stateful_existing(
+        self,
+        stateful: List[tuple],
+        leftover: Dict[int, List[int]],
+        result: SolverResult,
+    ) -> None:
+        """Pack host-port / volume groups onto existing capacity with
+        their per-node conflict state enforced IN the scan (ISSUE 12):
+
+        - host ports ride as pseudo-resource columns appended to the
+          free matrix (constraint_tensors feature axes — the exact
+          additive encoding of HostPort.matches), so conflicts with
+          node reservations AND between this dispatch's own placements
+          are both native to the first-fit kernel;
+        - volume admissibility is a per-(group, node) mask over the
+          union check (shared claim sets charge a node once), with
+          generic-ephemeral PVCs as additive per-driver columns; groups
+          run sequentially against a live usage overlay, so cross-group
+          driver interactions stay exact (the oracle's one-at-a-time
+          accounting, batched per group)."""
+        from .constraint_tensors import (
+            PortFeatures,
+            eph_free_columns,
+            node_reserved_ports,
+            volume_admit_row,
+        )
+        from ..scheduling.volumes import Volumes
+
+        ctx = self._existing_ctx
+        nodes = ctx["nodes"]
+        M = len(nodes)
+        # live port reservations: node's own + this pass's placements
+        reserved = [list(node_reserved_ports(n)) for n in nodes]
+        # live volume overlay: driver→ids added by this pass, per node
+        vol_overlay: Dict[int, Volumes] = {}
+
+        for gi, g in stateful:
+            idx = np.asarray(leftover.get(gi, list(g.pod_indices)), dtype=np.int64)
+            if idx.size == 0:
+                continue
+            row = self._existing_compat_row(g, ctx).astype(bool)
+            gv = self._group_volumes(g) if g.has_volumes else None
+            if gv is not None:
+                for m in np.flatnonzero(row):
+                    vu = nodes[m].volume_usage
+                    base = vu.volumes
+                    over = vol_overlay.get(int(m))
+                    merged = base.union(over) if over else base
+                    if not volume_admit_row(gv, merged, vu.csi_limits):
+                        row[m] = False
+            if not row.any():
+                continue
+            reqs = build_requests_matrix_ids(
+                self._req_ids[idx], ctx["axis"], self._req_map
             )
+            order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+            idx, reqs = idx[order], reqs[order]
+            ports = g.host_ports()
+            feats = PortFeatures([ports]) if ports else None
+            eph_drivers = sorted(gv.eph_counts) if gv is not None else []
+            free = ctx["free"]
+            cols = [free]
+            req_cols = [reqs]
+            if feats is not None and feats.count:
+                free_p = feats.free_matrix([reserved[m] for m in range(M)])
+                load_p = feats.load_row(ports)
+                cols.append(free_p)
+                req_cols.append(np.tile(load_p, (len(idx), 1)))
+            if eph_drivers:
+                free_v = eph_free_columns(eph_drivers, nodes, vol_overlay)
+                load_v = np.array(
+                    [gv.eph_counts[d] for d in eph_drivers], dtype=np.int32
+                )
+                cols.append(free_v)
+                req_cols.append(np.tile(load_v, (len(idx), 1)))
+            free_ext = np.ascontiguousarray(np.hstack(cols), dtype=np.int32)
+            reqs_ext = np.ascontiguousarray(np.hstack(req_cols), dtype=np.int32)
+            assign, free_out = run_pack_existing(
+                reqs_ext,
+                np.zeros(len(idx), dtype=np.int32),
+                row[None, :].astype(np.uint8),
+                free_ext,
+            )
+            ctx["free"] = np.ascontiguousarray(
+                free_out[:, : free.shape[1]], dtype=np.int32
+            )
+            placed = assign >= 0
+            by_node: Dict[int, List[int]] = {}
+            for j in np.flatnonzero(placed):
+                by_node.setdefault(int(assign[j]), []).append(int(idx[j]))
+            from .constraint_tensors import ports_from_triples
+
+            for m in sorted(by_node):
+                members = by_node[m]
+                if ports:
+                    reserved[m].extend(
+                        ports_from_triples(ports) * len(members)
+                    )
+                if gv is not None and not gv.empty:
+                    over = vol_overlay.setdefault(m, Volumes())
+                    for driver, ids in gv.shared.items():
+                        for pid in ids:
+                            over.add(driver, pid)
+                    for driver, n_per_pod in gv.eph_counts.items():
+                        for k, i in enumerate(members):
+                            pod = self._batch_pods[i]
+                            for e in range(n_per_pod):
+                                over.add(driver, f"{pod.namespace}/{pod.name}-eph{e}")
+                result.existing_plans.append(
+                    ExistingNodePlan(state_node=nodes[m], pod_indices=members)
+                )
+            leftover[gi] = [int(i) for i in idx[~placed]]
 
     # ------------------------------------------------------------------
 
@@ -2284,11 +2531,34 @@ class TPUScheduler:
         existing nodes never consult nodepools)."""
         chosen = None
         chosen_viable = None
+        chosen_zone_ok = None
         limit_starved: List[str] = []
+        # ISSUE 12: non-self required anti-affinity on zone — fold the
+        # seeded domain-exclusion mask into the pool's zone_ok/viable
+        # rows BEFORE the frontier (a copy: the cached compat rows are
+        # per-signature content, the exclusion is per-solve cluster
+        # state). A pool whose admissible zones empty out is skipped
+        # like an incompatible one (the oracle tries its next template).
+        excl_zones = (
+            self._anti_excluded_zones(group)
+            if group.anti_exclusion_terms()
+            else frozenset()
+        )
         for pi, pool in enumerate(pools):
             if not sig_compats[pi][gi].compatible:
                 continue
             compat_row = allowed_per_pool[pi][0][gi]
+            zone_row = allowed_per_pool[pi][1][gi]
+            if excl_zones:
+                enc = encoded[pi]
+                zmask = np.array([z in excl_zones for z in enc.zones], dtype=bool)
+                if zmask.any():
+                    zone_row = zone_row & ~zmask
+                    # re-derive the offering leg of the allowed mask on
+                    # the narrowed zones (compat leg is zone-independent)
+                    compat_row = compat_row & enc.offering_avail[:, zone_row, :][
+                        :, :, allowed_per_pool[pi][2][gi]
+                    ].any(axis=(1, 2))
             if limit_masks is not None and limit_masks[pi] is not None:
                 viable_row = compat_row & limit_masks[pi]
                 if compat_row.any() and not viable_row.any():
@@ -2299,6 +2569,7 @@ class TPUScheduler:
             if viable_row.any():
                 chosen = pi
                 chosen_viable = viable_row
+                chosen_zone_ok = zone_row
                 break
         if chosen is None:
             parts = []
@@ -2341,8 +2612,8 @@ class TPUScheduler:
             gi=gi,
             indices=indices,
             chosen=chosen,
-            viable=chosen_viable,  # (T,) bool, limit-filtered
-            zone_ok=allowed_per_pool[chosen][1][gi],  # (Z,)
+            viable=chosen_viable,  # (T,) bool, limit- and exclusion-filtered
+            zone_ok=chosen_zone_ok,  # (Z,) — anti-exclusion narrowed
             ct_ok=allowed_per_pool[chosen][2][gi],  # (C,)
             max_per_node=max_per_node,
             solo_cross_hostname=solo_cross_hostname,
@@ -2400,6 +2671,19 @@ class TPUScheduler:
             node_limits = _group_node_limits(members[0]["group"])
             daemon = daemon_requests[pool.nodepool.name]
             requests_matrix = matrices[id(pool_entries[chosen])][1]
+            # host-port feature loads per pod (ISSUE 12): a class can mix
+            # port-bearing and portless groups — the job's appended port
+            # columns let the pack scan enforce every conflict natively
+            ports_of: Optional[Dict[int, tuple]] = None
+            if any(m["group"].has_stateful_node_constraints for m in members):
+                ports_of = {}
+                for m in members:
+                    p = m["group"].host_ports()
+                    if p:
+                        for i in m["indices"]:
+                            ports_of[int(i)] = p
+                if not ports_of:
+                    ports_of = None
 
             spread = [m for m in members if m["group"].zone_spread() is not None]
             plain = [m for m in members if m["group"].zone_spread() is None]
@@ -2445,7 +2729,7 @@ class TPUScheduler:
                 self._prepare_job(
                     idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                     pool, pods, result, jobs, metas, merged=merged,
-                    per_node_limits=node_limits,
+                    per_node_limits=node_limits, pod_ports=ports_of,
                 )
                 continue
 
@@ -2465,7 +2749,7 @@ class TPUScheduler:
                     self._prepare_job(
                         idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                         pool, pods, result, jobs, metas, merged=merged,
-                        per_node_limits=node_limits,
+                        per_node_limits=node_limits, pod_ports=ports_of,
                     )
                 continue
 
@@ -2499,7 +2783,7 @@ class TPUScheduler:
                 self._prepare_job(
                     idx, reqs, enc, viable, zone_ok, ct_ok, daemon, max_per_node,
                     pool, pods, result, jobs, metas, merged=merged,
-                    per_node_limits=node_limits,
+                    per_node_limits=node_limits, pod_ports=ports_of,
                 )
             for z in zones:
                 if buckets[z]:
@@ -2508,6 +2792,7 @@ class TPUScheduler:
                         idx, reqs, enc, zone_types[z], zone_ok, ct_ok, daemon,
                         max_per_node, pool, pods, result, jobs, metas, zone=z,
                         merged=merged, per_node_limits=node_limits,
+                        pod_ports=ports_of,
                     )
 
     # ------------------------------------------------------------------
@@ -2563,6 +2848,134 @@ class TPUScheduler:
                     ws.seeds_put(skey, gen, seeds, self._cstats)
             self._seed_cache[key] = seeds
         return seeds
+
+    # ------------------------------------------------------------------
+    # ISSUE 12: residual constraint algebra on the tensor path
+
+    def _inject_volume_zones(self, pods: List[Pod]) -> None:
+        """Tensor-path twin of build_scheduler's VolumeTopology.inject:
+        PVC-pinned zone requirements join the pod's node affinity so the
+        compat algebra sees them. Pods whose computed pin is already
+        injected are skipped (no memo churn on steady ticks); pods with
+        volumes but no pin never mutate at all."""
+        from ..scheduler.volumetopology import VolumeTopology
+
+        vt = None
+        for pod in pods:
+            if not pod.spec.volumes:
+                continue
+            if vt is None:
+                vt = VolumeTopology(self.kube_client)
+            reqs = []
+            for volume in pod.spec.volumes:
+                reqs.extend(vt._requirements_for_volume(pod, volume))
+            if not reqs:
+                continue
+            key = tuple(sorted((r.key, r.operator, tuple(r.values)) for r in reqs))
+            if pod.__dict__.get("_karp_volzone_key") == key:
+                continue  # pin already injected and unchanged
+            vt.inject(pod)
+            pod.__dict__["_karp_volzone_key"] = key
+
+    def _anti_seeds(self, group: SignatureGroup, term, topology_key: str) -> Dict[str, int]:
+        """Seeded matching-pod counts per domain for one anti-affinity
+        term (count_matching_pods_by_domain through the oracle's
+        TopologyGroup — no node filter, topologygroup.go:70-76), cached
+        per solve and cross-tick under the cluster-generation guard
+        (the _spread_seeds discipline)."""
+        from .encode import _selector_key
+        from .topology_tensor import seed_counts_for_selector
+
+        key = (
+            "anti",
+            topology_key,
+            _selector_key(term.label_selector),
+            group.exemplar.namespace,
+        )
+        seeds = self._seed_cache.get(key)
+        if seeds is None:
+            ws = self._warm
+            gen = getattr(self, "_cluster_gen", None)
+            skey = None
+            if ws is not None and gen is not None:
+                skey = key + (
+                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope
+                )
+                seeds = ws.seeds_get(skey, gen, self._cstats)
+            if seeds is None:
+                seeds = seed_counts_for_selector(
+                    self.kube_client, group.exemplar, topology_key,
+                    term.label_selector, self._batch_uids,
+                )
+                if skey is not None:
+                    # kube-visible pod/node state is witnessed by the
+                    # cluster-generation guard (state/cluster.py)
+                    # analysis: allow-cache-key(self.kube_client)
+                    ws.seeds_put(skey, gen, seeds, self._cstats)
+            self._seed_cache[key] = seeds
+        return seeds
+
+    def _anti_excluded_zones(self, group: SignatureGroup) -> frozenset:
+        """Zones a non-self required anti-affinity term forbids: any
+        zone already holding a selector-matching pod (counts are static
+        — routing guarantees no batch group matches the selector, so no
+        committed-placement fold is needed). Folded into the group's
+        zone_ok before the viable mask / frontier (ISSUE 12)."""
+        gid = id(group)
+        excl = self._anti_zone_excl_cache.get(gid)
+        if excl is None:
+            zones: set = set()
+            for term in group.anti_exclusion_terms():
+                if term.topology_key != wk.LABEL_TOPOLOGY_ZONE:
+                    continue
+                seeds = self._anti_seeds(group, term, wk.LABEL_TOPOLOGY_ZONE)
+                zones.update(z for z, n in seeds.items() if n > 0)
+            excl = frozenset(zones)
+            self._anti_zone_excl_cache[gid] = excl
+        return excl
+
+    def _anti_excluded_hosts(self, group: SignatureGroup) -> frozenset:
+        """Hostnames a non-self required anti-affinity term forbids
+        (existing nodes already holding a matching pod); fresh nodes are
+        always admissible — a new node is an empty hostname domain."""
+        hosts: set = set()
+        for term in group.anti_exclusion_terms():
+            if term.topology_key != wk.LABEL_HOSTNAME:
+                continue
+            seeds = self._anti_seeds(group, term, wk.LABEL_HOSTNAME)
+            hosts.update(h for h, n in seeds.items() if n > 0)
+        return frozenset(hosts)
+
+    def _anti_exclusion_row(self, group: SignatureGroup, ctx: dict) -> Optional[np.ndarray]:
+        """(M,) bool exclusion mask over existing nodes from the
+        group's non-self anti terms (zone- and hostname-level), or None
+        when the group carries none."""
+        if not group.anti_exclusion_terms():
+            return None
+        nodes = ctx["nodes"]
+        excl = np.zeros(len(nodes), dtype=bool)
+        zones = self._anti_excluded_zones(group)
+        if zones:
+            excl |= np.isin(ctx["node_zones"], sorted(zones))
+        hosts = self._anti_excluded_hosts(group)
+        if hosts:
+            excl |= np.array(
+                [(n.hostname() in hosts or n.name() in hosts) for n in nodes]
+            )
+        return excl
+
+    def _group_volumes(self, group: SignatureGroup):
+        """Per-solve memo of resolve_group_volumes (the PVC → SC →
+        driver chain reads the kube store; one resolution per
+        signature)."""
+        from .constraint_tensors import resolve_group_volumes
+
+        gid = id(group)
+        gv = self._group_vols_cache.get(gid)
+        if gv is None:
+            gv = resolve_group_volumes(self.kube_client, group)
+            self._group_vols_cache[gid] = gv
+        return gv
 
     @staticmethod
     def _sel_fp(sel) -> tuple:
@@ -2703,11 +3116,13 @@ class TPUScheduler:
                 seeds[z] = seeds.get(z, 0) + 1
         return seeds
 
-    @staticmethod
-    def _existing_compat_row(group: SignatureGroup, ctx: dict) -> np.ndarray:
+    def _existing_compat_row(self, group: SignatureGroup, ctx: dict) -> np.ndarray:
         row = ctx["compat_rows"].get(id(group))
         if row is None:
             row = existing_node_compat([group], ctx["nodes"])[0]
+            excl = self._anti_exclusion_row(group, ctx)
+            if excl is not None:
+                row = (row.astype(bool) & ~excl).astype(row.dtype)
             ctx["compat_rows"][id(group)] = row
         return row
 
@@ -2927,19 +3342,29 @@ class TPUScheduler:
     def _topo_order_parked(
         self, groups: List[SignatureGroup], parked_idx: List[int]
     ) -> List[int]:
-        """Anchor-dependency order: if A's affinity selector matches B's
-        labels, B resolves first (its placements are A's admissible
-        domains). Kahn's algorithm; cycles fall back to input order —
-        whichever cycle member goes first legitimately sees no in-batch
-        anchors (the reference fails the same way under that pod order)."""
-        sel_of = {gi: groups[gi].affinity_term().label_selector for gi in parked_idx}
+        """Anchor-dependency order: if any of A's affinity selectors
+        matches B's labels, B resolves first (its placements are A's
+        admissible domains). Kahn's algorithm; cycles fall back to input
+        order — whichever cycle member goes first legitimately sees no
+        in-batch anchors (the reference fails the same way under that
+        pod order)."""
+        sels_of = {
+            gi: [
+                t.label_selector
+                for t in groups[gi].affinity_terms()
+                if t.label_selector is not None
+            ]
+            for gi in parked_idx
+        }
         deps: Dict[int, set] = {gi: set() for gi in parked_idx}
         for gi in parked_idx:
-            sel = sel_of[gi]
-            if sel is None:
+            sels = sels_of[gi]
+            if not sels:
                 continue
             for gj in parked_idx:
-                if gj != gi and sel.matches(groups[gj].exemplar.metadata.labels):
+                if gj != gi and any(
+                    sel.matches(groups[gj].exemplar.metadata.labels) for sel in sels
+                ):
                     deps[gi].add(gj)
         order: List[int] = []
         placed: set = set()
@@ -3080,39 +3505,76 @@ class TPUScheduler:
         jobs: List[tuple],
         metas: List[dict],
     ) -> None:
-        """Zone pod-affinity against committed placements: pods may go to
-        any viable zone already holding a matching pod; with none, only a
-        self-selecting group may bootstrap one zone
-        (topologygroup.go:215-232)."""
+        """Zone pod-affinity against committed placements: pods may go
+        to any viable zone where EVERY required term already counts a
+        matching pod (per-term anchor masks intersected — ISSUE 12
+        multi-term); terms with no anchors anywhere must be
+        self-selecting and bootstrap — all of a bootstrapping group's
+        pods land in ONE zone, since the first placement re-anchors the
+        empty terms there (topologygroup.go:215-232)."""
         from .topology_tensor import seed_counts_for_selector
 
-        term = group.affinity_term()
+        terms = group.affinity_terms()
         zone_ok, ct_ok, viable = info["zone_ok"], info["ct_ok"], info["viable"]
         ctx = self._existing_ctx
         zones, zone_types = _viable_zones(enc, viable, zone_ok, ct_ok)
-        seeds = self._fold_committed(
-            seed_counts_for_selector(
-                self.kube_client,
-                group.exemplar,
-                wk.LABEL_TOPOLOGY_ZONE,
+
+        def zone_price(z: str) -> float:
+            zi = enc.zones.index(z)
+            p = enc.offering_price[zone_types[z], zi, :][:, ct_ok]
+            p = np.where(np.isfinite(p), p, np.inf)
+            return float(p.min()) if p.size else np.inf
+
+        own_labels = group.exemplar.metadata.labels
+        anchored_sets: List[set] = []
+        bootstrap_ok = True
+        for term in terms:
+            seeds = self._fold_committed(
+                seed_counts_for_selector(
+                    self.kube_client,
+                    group.exemplar,
+                    wk.LABEL_TOPOLOGY_ZONE,
+                    term.label_selector,
+                    self._batch_uids,
+                ),
                 term.label_selector,
-                self._batch_uids,
-            ),
-            term.label_selector,
-            group.exemplar.namespace,
-            pods,
-            result,
-        )
-        have_anchors = any(v > 0 for v in seeds.values())
-        anchors = [z for z in zones if seeds.get(z, 0) > 0]
-        if have_anchors and not anchors:
-            # matching pods exist, but only in zones this pool can't
-            # serve — the affinity pins the pods to those zones
+                group.exemplar.namespace,
+                pods,
+                result,
+            )
+            anchors_t = {z for z, v in seeds.items() if v > 0}
+            if anchors_t:
+                anchored_sets.append(anchors_t)
+            elif term.label_selector is not None and not term.label_selector.matches(
+                own_labels
+            ):
+                bootstrap_ok = False  # empty term, not self-seedable
+        if not bootstrap_ok:
+            # some term has no matching pod anywhere and the group
+            # cannot seed its own domain (nextDomainAffinity bootstraps
+            # only when the pod matches its own selector)
             for i in idx:
                 result.pod_errors[pods[i].uid] = (
-                    "pod affinity anchors are outside viable zones"
+                    "pod affinity: no pod matches the affinity selector"
                 )
             return
+        has_bootstrap_terms = len(anchored_sets) < len(terms)
+        anchors: List[str] = []
+        if anchored_sets:
+            inter = set.intersection(*anchored_sets)
+            anchors = [z for z in zones if z in inter]
+            if not anchors:
+                # matching pods exist, but no viable zone satisfies every
+                # term jointly — the affinity pins the pods elsewhere
+                for i in idx:
+                    result.pod_errors[pods[i].uid] = (
+                        "pod affinity anchors are outside viable zones"
+                    )
+                return
+            if has_bootstrap_terms:
+                # the first placement seeds the empty terms in its zone;
+                # later pods must then co-locate — one zone for the group
+                anchors = [min(anchors, key=zone_price)]
         if anchors:
             part = idx
             if ctx is not None:
@@ -3159,12 +3621,6 @@ class TPUScheduler:
         if zones:
             # bootstrap exactly one zone — cheapest viable offering (the
             # oracle picks an arbitrary viable domain; a refinement)
-            def zone_price(z: str) -> float:
-                zi = enc.zones.index(z)
-                p = enc.offering_price[zone_types[z], zi, :][:, ct_ok]
-                p = np.where(np.isfinite(p), p, np.inf)
-                return float(p.min()) if p.size else np.inf
-
             z_star = min(zones, key=zone_price)
             part = idx
             if ctx is not None:
@@ -3209,13 +3665,47 @@ class TPUScheduler:
         nodes holding matching members (joinable with instance-type
         growth, as the oracle's in-flight claims re-size). With no
         anchors, a self-selecting group bootstraps one co-located node;
-        anyone else fails (topologygroup.go:215-232)."""
+        anyone else fails (topologygroup.go:215-232). With additional
+        ZONE terms (ISSUE 12 multi-term), anchor nodes/plans must also
+        sit in the zones every zone term admits."""
         from .topology_tensor import seed_counts_for_selector
 
-        term = group.affinity_term()
+        terms = group.affinity_terms()
+        host_term = next(
+            t for t in terms if t.topology_key == wk.LABEL_HOSTNAME
+        )
+        zone_terms = [t for t in terms if t.topology_key == wk.LABEL_TOPOLOGY_ZONE]
         ns = group.exemplar.namespace
-        sel = term.label_selector
+        sel = host_term.label_selector
         ctx = self._existing_ctx
+        own_labels = group.exemplar.metadata.labels
+        zone_filter: Optional[set] = None
+        zone_bootstrap = False
+        for zt in zone_terms:
+            zseeds = self._fold_committed(
+                seed_counts_for_selector(
+                    self.kube_client, group.exemplar, wk.LABEL_TOPOLOGY_ZONE,
+                    zt.label_selector, self._batch_uids,
+                ),
+                zt.label_selector, ns, pods, result,
+            )
+            anchors_t = {z for z, v in zseeds.items() if v > 0}
+            if anchors_t:
+                zone_filter = anchors_t if zone_filter is None else (zone_filter & anchors_t)
+            elif zt.label_selector is not None and not zt.label_selector.matches(own_labels):
+                for i in idx:
+                    result.pod_errors[pods[i].uid] = (
+                        "pod affinity: no pod matches the affinity selector"
+                    )
+                return
+            else:
+                zone_bootstrap = True  # self-seedable empty zone term
+        if zone_filter is not None and not zone_filter:
+            for i in idx:
+                result.pod_errors[pods[i].uid] = (
+                    "pod affinity anchors are outside viable zones"
+                )
+            return
         seeds = seed_counts_for_selector(
             self.kube_client,
             group.exemplar,
@@ -3233,12 +3723,33 @@ class TPUScheduler:
                 seeds[name] = seeds.get(name, 0) + 1
 
         planned_anchors = [
-            p for p in result.node_plans if self._plan_has_match(p, sel, ns, pods)
+            p
+            for p in result.node_plans
+            if self._plan_has_match(p, sel, ns, pods)
+            and (zone_filter is None or p.zone in zone_filter)
         ]
+        if zone_bootstrap and (seeds or planned_anchors):
+            # an empty self-seedable zone term pins the whole group to
+            # ONE zone once the first pod lands: take the first anchor's
+            # zone (node order, then plan order — the oracle's first-fit
+            # p1 choice) and narrow the filter to it
+            z_star = None
+            if ctx is not None and seeds:
+                for n, z in zip(ctx["nodes"], ctx["node_zones"]):
+                    if (n.hostname() in seeds or n.name() in seeds) and (
+                        zone_filter is None or z in zone_filter
+                    ):
+                        z_star = str(z)
+                        break
+            if z_star is None and planned_anchors:
+                z_star = planned_anchors[0].zone
+            if z_star is not None:
+                zone_filter = {z_star}
+                planned_anchors = [p for p in planned_anchors if p.zone == z_star]
         left = idx
         if seeds and ctx is not None and left.size:
             left = self._pack_affinity_hostname_existing(
-                left, group, seeds, ctx, result
+                left, group, seeds, ctx, result, zone_filter=zone_filter
             )
         if planned_anchors and left.size:
             left = self._join_planned_nodes(
@@ -3260,9 +3771,24 @@ class TPUScheduler:
             return
         if not seeds and not planned_anchors:
             if group.affinity_self_selecting():
+                binfo = info
+                if zone_filter is not None:
+                    zmask = info["zone_ok"] & np.array(
+                        [z in zone_filter for z in enc.zones], dtype=bool
+                    )
+                    v = info["viable"] & enc.offering_avail[:, zmask, :][
+                        :, :, info["ct_ok"]
+                    ].any(axis=(1, 2))
+                    if not v.any():
+                        for i in left:
+                            result.pod_errors[pods[i].uid] = (
+                                "pod affinity anchors are outside viable zones"
+                            )
+                        return
+                    binfo = dict(info, zone_ok=zmask, viable=v)
                 sub = np.isin(idx, left)
                 self._pack_affinity_hostname_new(
-                    idx[sub], reqs[sub], enc, pool, daemon, info, pods, result
+                    idx[sub], reqs[sub], enc, pool, daemon, binfo, pods, result
                 )
                 return
             for i in left:
@@ -3561,14 +4087,19 @@ class TPUScheduler:
         seeds: Dict[str, int],
         ctx: dict,
         result: SolverResult,
+        zone_filter: Optional[set] = None,
     ) -> np.ndarray:
         """First-fit the group onto existing nodes already holding a
-        matching pod (the only admissible domains once anchors exist)."""
+        matching pod (the only admissible domains once anchors exist);
+        ``zone_filter`` narrows anchors to the zones the group's zone
+        terms admit (ISSUE 12 multi-term)."""
         row = self._existing_compat_row(group, ctx).astype(bool)
         anchor = np.array(
             [n.hostname() in seeds or n.name() in seeds for n in ctx["nodes"]]
         )
         mask = row & anchor
+        if zone_filter is not None:
+            mask &= np.isin(ctx["node_zones"], sorted(zone_filter))
         if not mask.any():
             return idx
         reqs = build_requests_matrix_ids(
@@ -3842,6 +4373,7 @@ class TPUScheduler:
         merged=None,
         per_node_limits: Optional[list] = None,
         no_merge: bool = False,
+        pod_ports: Optional[Dict[int, tuple]] = None,
     ) -> None:
         viable_idx = np.flatnonzero(viable)
         if len(viable_idx) == 0:
@@ -3863,7 +4395,34 @@ class TPUScheduler:
         if frontier is None:
             frontier = pareto_frontier(alloc)
             _cache_put(enc, cache_key, frontier)
-        jobs.append((reqs, frontier, np.int32(max_per_node)))
+        # host-port feature columns (ISSUE 12): appended to the job's
+        # request matrix and frontier so the pack kernel enforces port
+        # conflicts natively — every frontier point carries the fresh-
+        # node port capacities (constant columns preserve dominance).
+        # meta["reqs"]/["alloc"] stay resource-only: finalize prices and
+        # usage never see the pseudo axes.
+        job_reqs, job_frontier = reqs, frontier
+        port_features: tuple = ()
+        port_sets = None
+        if pod_ports:
+            sets = [pod_ports.get(int(i), ()) for i in idx]
+            if any(sets):
+                from .constraint_tensors import PortFeatures
+
+                feats = PortFeatures(sets)
+                if feats.count:
+                    port_sets = sets
+                    port_features = tuple(feats.features)
+                    job_reqs = np.ascontiguousarray(
+                        np.hstack([reqs, feats.load_matrix(sets)]), dtype=np.int32
+                    )
+                    job_frontier = np.ascontiguousarray(
+                        np.hstack(
+                            [frontier, np.tile(feats.caps, (frontier.shape[0], 1))]
+                        ),
+                        dtype=np.int32,
+                    )
+        jobs.append((job_reqs, job_frontier, np.int32(max_per_node)))
         metas.append(
             dict(
                 idx=idx,
@@ -3880,6 +4439,8 @@ class TPUScheduler:
                 merged=merged,
                 per_node_limits=per_node_limits or [],
                 no_merge=no_merge,
+                port_features=port_features,
+                pod_port_sets=port_sets,
             )
         )
 
@@ -4067,6 +4628,15 @@ class TPUScheduler:
             merged.fingerprint() if merged is not None else None,
             limits_key,
             bool(meta["no_merge"]),
+            # host-port content (ISSUE 12): the appended feature COLUMNS
+            # ride the reqs digest, but two different port universes can
+            # produce byte-identical matrices (TCP:80 vs TCP:81 wildcard
+            # columns) — the feature labels disambiguate, and the merge
+            # pass's conflict guard reads them through the emitted
+            # records, so skeleton streams must never alias across them
+            # (a field subscript, not .get(): a dict-rooted read would
+            # widen the cachesound witness over every meta field)
+            tuple(meta["port_features"] or ()),
             incremental.pack_engine_token(mesh),
             # pack-backend identity: which engine partitioned this job
             # (plus its configuration, e.g. the LP iteration budget) —
@@ -4204,9 +4774,11 @@ class TPUScheduler:
         job_limits = list(meta["per_node_limits"])
         max_per_node = meta["max_per_node"]
         pool, zone = meta["pool"], meta["zone"]
+        port_sets = meta.get("pod_port_sets")
         positions, bounds = skel.positions, skel.bounds
         for n in range(skel.node_count):
-            members = idx[positions[bounds[n] : bounds[n + 1]]].tolist()
+            pos_slice = positions[bounds[n] : bounds[n + 1]]
+            members = idx[pos_slice].tolist()
             if not skel.ok[n]:
                 for i in members:
                     result.pod_errors[pods[i].uid] = "packed node has no fitting instance type"
@@ -4227,6 +4799,15 @@ class TPUScheduler:
                     max_per_node=max_per_node,
                     limits=job_limits,
                 )
+                if port_sets is not None:
+                    # the node's reserved ports ride the record so the
+                    # merge pass can reject conflicting combinations
+                    # (constraint_tensors.ports_conflict)
+                    node_ports = sorted(
+                        {t for p in pos_slice for t in port_sets[int(p)]}
+                    )
+                    if node_ports:
+                        rec["ports"] = tuple(node_ports)
                 if skel.cost_guard:
                     rec["_cost_guard"] = True
                 if key is not None:
@@ -4577,6 +5158,15 @@ class TPUScheduler:
             ct_ok = m["ct_ok"] & r["ct_ok"]
         if viable is None:
             viable = m["viable"] & r["viable"]
+        # host-port guard (ISSUE 12): two nodes whose reserved ports
+        # conflict can never fold — exactly the oracle's per-claim
+        # HostPortUsage.conflicts check on the combined membership
+        m_ports, r_ports = m.get("ports"), r.get("ports")
+        if m_ports and r_ports:
+            from .constraint_tensors import ports_conflict
+
+            if ports_conflict(m_ports, r_ports):
+                return False
         if not skip_intersects:
             ikey = (m["merged"].fingerprint(), r["merged"].fingerprint())
             compat_ok = self._intersects_cache.get(ikey)
@@ -4585,6 +5175,22 @@ class TPUScheduler:
                 self._intersects_cache[ikey] = compat_ok
             if not compat_ok:
                 return False
+        limits = m.get("limits", []) + r.get("limits", [])
+        if limits:
+            # every hostname-level constraint of either side must
+            # hold on the merged membership (the oracle's per-node
+            # count check at placement time); per-side counts are
+            # cached so mega-memberships aren't rescanned per pair.
+            # Checked FIRST: on cap-dense workloads (ISSUE 12's
+            # anti-affinity-dense mix) limit rejects dominate, and this
+            # check is pure cached-dict work while the fits/offering
+            # checks below reduce over the type axis
+            for sel, ns, cap in limits:
+                count = self._record_limit_count(
+                    m, sel, ns, pods
+                ) + self._record_limit_count(r, sel, ns, pods)
+                if count > cap:
+                    return False
         usage = m["usage"] + r["usage"]
         alloc = self._alloc_full(enc, r["daemon"])
         fits = viable & np.all(usage[None, :] <= alloc, axis=1)
@@ -4609,18 +5215,6 @@ class TPUScheduler:
             merged_price = float(pm.min())
             if merged_price > self._record_price(m) + self._record_price(r) + 1e-9:
                 return False
-        limits = m.get("limits", []) + r.get("limits", [])
-        if limits:
-            # every hostname-level constraint of either side must
-            # hold on the merged membership (the oracle's per-node
-            # count check at placement time); per-side counts are
-            # cached so mega-memberships aren't rescanned per pair
-            for sel, ns, cap in limits:
-                count = self._record_limit_count(
-                    m, sel, ns, pods
-                ) + self._record_limit_count(r, sel, ns, pods)
-                if count > cap:
-                    return False
         combined = Requirements(*m["merged"].values_list())
         combined.add(*r["merged"].values_list())
         # merge the per-selector count caches additively BEFORE the
@@ -4657,6 +5251,8 @@ class TPUScheduler:
         )
         m["members"].extend(r["members"])
         m["_limit_counts"] = counts
+        if m_ports or r_ports:
+            m["ports"] = tuple(sorted(set(m_ports or ()) | set(r_ports or ())))
         if m.get("_cost_guard") or r.get("_cost_guard"):
             m["_cost_guard"] = True
             m["_price"] = merged_price

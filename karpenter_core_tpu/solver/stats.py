@@ -20,7 +20,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-SCHEMA = 2  # 2: "shard" block added (pod/type-axis mesh padding, ISSUE 11)
+SCHEMA = 3  # 3: "route" block added (tensor/parked/oracle pod split per
+# solve + oracle share, ISSUE 12); 2: "shard" block (mesh padding)
 
 
 def _round3(v) -> float:
@@ -62,6 +63,7 @@ def solve_stats(solver, disruption=None) -> dict:
         },
         "pack_backend": dict(ps),
         "shard": dict(ss) if (ss := getattr(solver, "last_shard_stats", None)) else None,
+        "route": dict(rs) if (rs := getattr(solver, "last_route_stats", None)) else None,
         "disruption": dict(dstats) if dstats else None,
     }
 
@@ -88,6 +90,9 @@ def bench_fields(stats: dict) -> dict:
     sh = stats.get("shard")
     if sh:
         out["shard"] = dict(sh)
+    rt = stats.get("route")
+    if rt:
+        out["route"] = dict(rt)
     merge = stats.get("merge", {})
     out["merge_ms"] = round(merge.get("ms", 0.0), 2)
     out["merge_candidates_screened"] = merge.get("candidates_screened", 0)

@@ -106,6 +106,15 @@ def _parse_args():
                     help="pod count (with --shard)")
     ap.add_argument("--shard-types", type=int, default=10_000,
                     help="type count (with --shard)")
+    ap.add_argument("--constraints", metavar="SCENARIO", default=None,
+                    choices=("spread_skew", "anti_dense", "stateful_dense"),
+                    help="constraint-dense mode (ISSUE 12): profile one "
+                         "config-13 scenario through the tensor path "
+                         "(bench.constraint_env; --engine "
+                         "tensor|oracle picks the constraint engine; "
+                         "BENCH_BACKEND=cpu off-TPU)")
+    ap.add_argument("--constraint-pods", type=int, default=10_000,
+                    help="pod count (with --constraints)")
     return ap.parse_args()
 
 
@@ -141,6 +150,9 @@ def main():
         return
     if args.fleet:
         _fleet_mode(args)
+        return
+    if args.constraints:
+        _constraints_mode(args)
         return
 
     from karpenter_core_tpu.apis import labels as wk
@@ -229,6 +241,54 @@ def main():
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)
+    print(s.getvalue())
+
+
+def _constraints_mode(args):
+    """--constraints SCENARIO: profile one config-13 constraint-dense
+    solve (ISSUE 12) through the chosen constraint engine — the route
+    split and stateful/exclusion mask costs show up as pack.*, merge
+    and existing_pack.stateful phases (tensor) or oracle_fallback
+    (engine=oracle, the legacy path)."""
+    import time as _time
+
+    from karpenter_core_tpu.solver import TPUScheduler, incremental
+
+    engine = args.engine if args.engine in ("tensor", "oracle") else "tensor"
+    os.environ["KARPENTER_TPU_CONSTRAINT_ENGINE"] = engine
+    pods, provider, nodepool, kube, nodes_factory = bench.constraint_env(
+        args.constraints, args.constraint_pods
+    )
+    print(
+        f"constraints: scenario={args.constraints} pods={len(pods)} "
+        f"engine={engine}",
+        file=sys.stderr,
+    )
+    # cold solve outside the profile (compile + catalog encode)
+    incremental.reset()
+    solver = TPUScheduler([nodepool], provider, kube_client=kube)
+    t0 = _time.perf_counter()
+    res = solver.solve(list(pods), state_nodes=nodes_factory())
+    cold_ms = (_time.perf_counter() - t0) * 1000.0
+    print(
+        f"cold: {cold_ms:.1f} ms  nodes={res.node_count} "
+        f"errors={len(res.pod_errors)} route={solver.last_route_stats}"
+    )
+    incremental.reset()
+    solver = TPUScheduler([nodepool], provider, kube_client=kube)
+    pr = cProfile.Profile()
+    pr.enable()
+    t0 = _time.perf_counter()
+    res = solver.solve(list(pods), state_nodes=nodes_factory())
+    wall_ms = (_time.perf_counter() - t0) * 1000.0
+    pr.disable()
+    print(
+        f"profiled: {wall_ms:.1f} ms  nodes={res.node_count} "
+        f"oracle_share={solver.last_route_stats.get('oracle_share')}"
+    )
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(40)
     print(s.getvalue())
 
 

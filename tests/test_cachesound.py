@@ -433,6 +433,7 @@ _MUT_FILES = [
     "karpenter_core_tpu/fleet/registry.py",
     "karpenter_core_tpu/fleet/megasolve.py",
     "karpenter_core_tpu/solver/sharding.py",
+    "karpenter_core_tpu/solver/constraint_tensors.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -445,6 +446,15 @@ _MUTANTS = [
      "key = () if ws is not None else None", "cache-key"),
     ("job-key-drop-viable", "karpenter_core_tpu/solver/solver.py",
      '            meta["viable_idx"].tobytes(),\n', "", "cache-key"),
+    # ISSUE 12 acceptance: a dropped MASK input from the job-memo key
+    # (zone_ok also carries the anti-affinity domain-exclusion
+    # narrowing, so losing it aliases excluded and unexcluded solves).
+    # The port_features component and the route key's constraint-engine
+    # token are read-set-invisible (emit-side/env reads — the PR-7/
+    # PR-11 precedent) and are held by tests/test_constraint_tensors.py
+    # TestJobMemoPortKeys / TestRouteTelemetry instead.
+    ("job-key-drop-zonemask", "karpenter_core_tpu/solver/solver.py",
+     '            np.asarray(meta["zone_ok"]).tobytes(),\n', "", "cache-key"),
     ("merge-key-drop-stream", "karpenter_core_tpu/solver/solver.py",
      '                tuple(r["_rkey"] for r in records),\n', "", "cache-key"),
     ("emit-key-drop-trail", "karpenter_core_tpu/solver/solver.py",
@@ -567,6 +577,9 @@ _MANDATORY = {
     # ISSUE 9 acceptance: no cross-tenant cache aliasing — the mega-solve
     # envelope memo and the seed cache must witness the tenant
     "fleetenv-key-drop-tenant", "seed-key-drop-tenantscope",
+    # ISSUE 12 acceptance: the job memo must witness its mask inputs
+    # (zone_ok carries the anti-affinity exclusion narrowing)
+    "job-key-drop-zonemask",
 }
 
 

@@ -246,8 +246,11 @@ class TestDebugRoutes:
             # the stable top-level schema, always present
             assert set(doc) == {
                 "schema", "trace_id", "timings", "cache", "merge",
-                "pack_backend", "shard", "disruption",
+                "pack_backend", "shard", "route", "disruption",
             }
+            # ISSUE 12: the route block carries the per-solve pod split
+            assert doc["route"]["tensor"] == 8
+            assert doc["route"]["oracle_share"] == 0.0
             assert doc["timings"]["total_ms"] > 0
             assert doc["trace_id"] == solver.last_timings["trace_id"]
             # bench _split consumes the same document

@@ -140,18 +140,29 @@ class TestExistingPackTensorPath:
 
 
 class TestConservativeExclusions:
-    def test_host_port_pods_route_to_oracle(self):
+    def test_host_port_pods_stay_tensor(self):
+        # ISSUE 12: topology-free port-bearing groups run on the tensor
+        # path — the per-node port state rides the pack scan's feature
+        # columns; the two conflicting pods land on DIFFERENT nodes
         sns = [state_node(cpu="8")]
         pods = [make_pod(requests={"cpu": "1"}, host_ports=[8080]) for _ in range(2)]
         res = tpu_solve(pods, sns)
-        # port-bearing groups go to the oracle, which models per-node
-        # port state: the two conflicting pods land on DIFFERENT nodes
+        assert res.oracle_results is None
+        assert res.pods_scheduled == 2
+        on_existing = sum(len(e.pod_indices) for e in res.existing_plans)
+        assert on_existing == 1 and len(res.node_plans) == 1
+
+    def test_host_port_pods_oracle_engine_identity(self, monkeypatch):
+        # the engine switch restores the pre-ISSUE-12 oracle routing and
+        # both engines agree on the outcome shape (the identity gate)
+        monkeypatch.setenv("KARPENTER_TPU_CONSTRAINT_ENGINE", "oracle")
+        sns = [state_node(cpu="8")]
+        pods = [make_pod(requests={"cpu": "1"}, host_ports=[8080]) for _ in range(2)]
+        res = tpu_solve(pods, sns)
         assert res.oracle_results is not None
-        assert not res.existing_plans
         assert res.pods_scheduled == 2
         on_existing = sum(len(e.pods) for e in res.oracle_results.existing_nodes)
-        new_claims = res.oracle_results.new_node_claims
-        assert on_existing == 1 and len(new_claims) == 1
+        assert on_existing == 1 and len(res.oracle_results.new_node_claims) == 1
 
     def test_host_port_pods_never_copacked_on_new_node(self):
         # no existing capacity: conflicting-port pods must still split
@@ -173,7 +184,8 @@ class TestConservativeExclusions:
     def test_pvc_zone_pin_honored_via_tpu_entrypoint(self):
         """A pod whose bound PV pins a zone must land in that zone when
         scheduled through the TPU entry point (volumetopology.go:42-79;
-        PVC-bearing groups route to the oracle, which injects the pin)."""
+        ISSUE 12: the tensor path injects the pin itself — the group no
+        longer routes to the oracle)."""
         from karpenter_core_tpu.kube.objects import (
             PersistentVolume,
             PersistentVolumeClaim,
@@ -202,9 +214,9 @@ class TestConservativeExclusions:
         provider = _default_provider()
         res = TPUScheduler([make_nodepool()], provider, kube_client=kube).solve([pod])
         assert not res.pod_errors
-        assert res.oracle_results is not None  # PVC group routed to oracle
-        nc = res.oracle_results.new_node_claims[0]
-        assert nc.requirements.get_req(wk.LABEL_TOPOLOGY_ZONE).values == {"test-zone-2"}
+        assert res.oracle_results is None  # tensor path handled the PVC group
+        assert len(res.node_plans) == 1
+        assert res.node_plans[0].zone == "test-zone-2"
 
     def test_plain_group_matching_spread_selector_stays_tensor(self):
         # r5: a spread selector matching another in-batch group no longer
