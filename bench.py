@@ -2188,6 +2188,120 @@ def config12() -> dict:
     }
 
 
+def _restart_measure(args: list) -> dict:
+    """One restart-phase trafficgen invocation in its own subprocess
+    (each phase IS a process — the kill is a real process exit, the
+    resume a real fresh interpreter)."""
+    import subprocess
+
+    cmd = [sys.executable, "-m", "karpenter_core_tpu.serving.trafficgen"] + args
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600, check=False)
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or proc.stdout or "").strip()[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def config14() -> dict:
+    """Warm-state persistence (ISSUE 13): kill-the-process-mid-stream on
+    a config-7-shaped serving workload (restart_wave: team deployments,
+    steady redeploy churn, an early catalog price storm), 3 seeds x 4
+    processes each:
+
+      kill      — drive to the kill step, quiesce (snapshot on quiesce:
+                  quiesce() returns the snapshot path), dump the
+                  apiserver handoff, EXIT (the kill is the exit).
+      warm      — fresh process: rebuild from the handoff, restore the
+                  snapshot BEFORE the first tick, resume the stream.
+      cold      — same resume WITHOUT the restore (the unsnapshot
+                  cold-restart baseline).
+      reference — the same scenario unkilled, end to end.
+
+    Gates: warm first-solve host p50 >=3x faster than cold
+    (first_solve_speedup, the config-7 cold/warm convention), the
+    restored pipeline back at the killed process's steady p50 within
+    K=3 ticks (ticks_to_warm), and the concatenated killed-run plan
+    stream byte-identical (plan_sha256) to the unkilled reference —
+    across the kill point, for BOTH resumes — identity 1.0 on every
+    cell."""
+    import tempfile
+
+    scale = _scale(int(os.environ.get("BENCH_RESTART_SCALE", "600")))
+    n_types = _scale(480)
+    kill_step = int(os.environ.get("BENCH_RESTART_KILL_STEP", "6"))
+    seeds = (7, 17, 27)
+    out: dict = {
+        "config": f"14: warm-state persistence, restart_wave @ scale {scale} x {n_types} types, kill@{kill_step}, {len(seeds)} seeds",
+        "cells": {},
+    }
+    cold_first, warm_first, cold_host, warm_host = [], [], [], []
+    restore_ms, ticks_to_warm = [], []
+    identical = total = 0
+    for seed in seeds:
+        cell: dict = {}
+        with tempfile.TemporaryDirectory(prefix="bench-warmstore-") as workdir:
+            base = ["--scenario", "restart_wave", "--n-types", str(n_types)]
+            kill = _restart_measure(
+                base + ["--scale", str(scale), "--seed", str(seed),
+                        "--restart-kill-at", str(kill_step), "--workdir", workdir]
+            )
+            cell["kill"] = {k: kill.get(k) for k in ("plans_emitted", "steady_step_ms_p50", "error") if k in kill}
+            handoff = kill.get("handoff_path")
+            ref = _restart_measure(
+                base + ["--scale", str(scale), "--seed", str(seed), "--restart-reference"]
+            )
+            warm = (
+                _restart_measure(base + ["--restart-resume", handoff])
+                if handoff
+                else {"error": "kill phase failed"}
+            )
+            cold = (
+                _restart_measure(base + ["--restart-resume", handoff, "--cold"])
+                if handoff
+                else {"error": "kill phase failed"}
+            )
+        for mode, doc in (("warm", warm), ("cold", cold)):
+            total += 1
+            ident = bool(
+                ref.get("plan_sha256") and doc.get("plan_sha256") == ref.get("plan_sha256")
+            )
+            identical += ident
+            cell[mode] = {
+                "plan_identical": ident,
+                "first_solve_ms": doc.get("first_solve_ms"),
+                "first_solve_host_ms": doc.get("first_solve_host_ms"),
+                "ticks_to_warm": doc.get("ticks_to_warm"),
+            }
+            if "error" in doc:
+                cell[mode]["error"] = doc["error"]
+        cell["warm"]["restore_ms"] = warm.get("restore_ms")
+        cell["warm"]["warmstore"] = warm.get("warmstore")
+        out["cells"][f"seed{seed}"] = cell
+        if "error" not in warm and "error" not in cold:
+            warm_first.append(warm["first_solve_ms"]); cold_first.append(cold["first_solve_ms"])
+            warm_host.append(warm["first_solve_host_ms"]); cold_host.append(cold["first_solve_host_ms"])
+            restore_ms.append(warm["restore_ms"]); ticks_to_warm.append(warm["ticks_to_warm"])
+
+    def p50(a):
+        return round(float(np.median(np.asarray(a))), 2) if a else 0.0
+
+    out["cold_first_solve_ms_p50"] = p50(cold_first)
+    out["first_tick_warm_ms"] = p50(warm_first)
+    out["cold_first_solve_host_ms_p50"] = p50(cold_host)
+    out["warm_first_solve_host_ms_p50"] = p50(warm_host)
+    out["restore_ms"] = p50(restore_ms)
+    # the headline gate (config-7 cold/warm convention: host ms — the
+    # framework's restart cost, not the transport's/XLA's)
+    out["first_solve_speedup"] = (
+        round(out["cold_first_solve_host_ms_p50"] / out["warm_first_solve_host_ms_p50"], 2)
+        if out["warm_first_solve_host_ms_p50"] > 0
+        else 0.0
+    )
+    out["ticks_to_warm"] = int(max(ticks_to_warm)) if ticks_to_warm else 0
+    out["plan_identical_cells"] = identical
+    out["plan_identity"] = round(identical / total, 4) if total else 0.0
+    return out
+
+
 # ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
@@ -2317,9 +2431,9 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12, config13):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12, config13, config14):
             try:
-                if fn in (config7, config8, config9, config11, config12):  # measure the incremental/serving/disruption/fleet/shard paths
+                if fn in (config7, config8, config9, config11, config12, config14):  # measure the incremental/serving/disruption/fleet/shard/restart paths
                     configs.append(fn())
                 else:
                     with incremental_off():

@@ -94,6 +94,13 @@ RELATIVE_GATES: List[Tuple[str, str, str]] = [
     # path, so only the tensor lane can regress it)
     ("config13", "anti_dense.tensor_ms_p50", "down"),
     ("config13", "stateful_dense.tensor_ms_p50", "down"),
+    # ISSUE 13: the restored pipeline's restart lane — restore cost, the
+    # first post-restart warm tick, and how many ticks to steady state.
+    # Gated on their own trajectories (the speedup ratio's denominator
+    # is the cold path, which other PRs legitimately speed up)
+    ("config14", "restore_ms", "down"),
+    ("config14", "first_tick_warm_ms", "down"),
+    ("config14", "ticks_to_warm", "down"),
 ]
 ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     # (config, metric, "floor"|"ceiling", bound)
@@ -120,6 +127,12 @@ ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     ("config13", "plan_parity_min", "floor", 1.0),
     ("config13", "oracle_share_max", "ceiling", 0.10),
     ("config13", "speedup_min", "floor", 3.0),
+    # ISSUE 13: restart-shaped warm restore — plan identity across the
+    # kill point on every cell (both resumes vs the unkilled reference),
+    # the published >=3x first-solve floor, and the K=3 warm-up budget
+    ("config14", "plan_identity", "floor", 1.0),
+    ("config14", "first_solve_speedup", "floor", 3.0),
+    ("config14", "ticks_to_warm", "ceiling", 3.0),
 ]
 
 

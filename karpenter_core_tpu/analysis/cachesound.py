@@ -4,8 +4,8 @@ PR 4 rests the incremental solver on one invariant — a warm solve is
 plan-identical to a cold solve because every cache is content-addressed
 by the exact inputs of a deterministic computation. Until this rule
 family, that invariant was defended only by test coverage (the bench-7
-oracle, the invalidation matrix). These three project rules turn it into
-a static gate, the same way salsa/Adapton-style incremental systems make
+oracle, the invalidation matrix). These project rules turn it into a
+static gate, the same way salsa/Adapton-style incremental systems make
 key/read-set discipline structural:
 
 - **cache-key** (key-completeness): for every memo site on a registered
@@ -39,6 +39,14 @@ key/read-set discipline structural:
   ``repr`` of objects, float-through-``str`` feeding digests, and
   traced/device values flowing into a key (a tracer leak AND a soundness
   bug).
+
+- **cache-persist** (persisted-key re-anchoring, ISSUE 13): the
+  warm-state snapshot/restore seam (``solver/warmstore.py``) must
+  re-anchor restored planes against the LIVE world — never install a
+  persisted generation counter (another process's ordinal), never drop
+  the tenant scope while rebinding persisted keys, and never trust a
+  payload whose schema id / key-layout contract hash it has not
+  verified.
 
 The analysis is necessarily an approximation; its residual assumptions
 are (a) one level of call inlining — deeper callees are modeled as
@@ -2161,3 +2169,181 @@ def check_cache_determinism(pctx: ProjectContext):
     yield from sorted(
         dedup.values(), key=lambda f: (f.path, f.line, f.message)
     )
+
+
+# ---------------------------------------------------------------------------
+# rule 4: cache-persist (persisted-key re-anchoring, ISSUE 13)
+#
+# solver/warmstore.py serializes the memo planes to disk and restores
+# them into a DIFFERENT process. The in-memory rules above prove keys
+# witness their read-sets; persistence adds three ways to break the
+# same invariant that no in-memory analysis can see:
+#
+# - trusting a PERSISTED generation counter: generation guards are
+#   per-process ordinals — a restore must re-anchor to the LIVE world's
+#   counter (after a content-witness check), never install the dead
+#   process's value;
+# - dropping the tenant scope while rebinding persisted keys: a
+#   restored entry whose key lost its scope aliases scope-free lookups
+#   onto another tenant's state;
+# - trusting a payload without verifying the writer's schema id and
+#   key-layout contract hash: a reader that re-anchors keys it would
+#   misparse restores garbage silently.
+
+
+_PAYLOAD_PARAM_RE = re.compile(
+    r"(^|_)(payload|plane|snap|snapshot|entries|handoff|blob)($|_)"
+)
+
+
+def _payload_params(fn_node: ast.AST) -> Set[str]:
+    """Parameter names that carry persisted (snapshot-side) data, by
+    the warmstore naming contract."""
+    out: Set[str] = set()
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return out
+    for a in list(args.args) + list(args.kwonlyargs) + list(args.posonlyargs or []):
+        if _PAYLOAD_PARAM_RE.search(a.arg):
+            out.add(a.arg)
+    return out
+
+
+def _warmstore_functions(f: FileContext):
+    """(symbol, FunctionDef) pairs, nested included."""
+    out = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sym = f"{prefix}.{child.name}" if prefix else child.name
+                out.append((sym, child))
+                walk(child, sym)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}.{child.name}" if prefix else child.name)
+
+    walk(f.tree, "")
+    return out
+
+
+def _module_constant_names(f: FileContext) -> Set[str]:
+    names: Set[str] = set()
+    for node in f.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+@project_rule(
+    "cache-persist",
+    "persisted cache planes re-anchor on restore: live generations only, tenant scope preserved, schema/contract verified",
+)
+def check_cache_persist(pctx: ProjectContext):
+    files = pctx.matching(pctx.config.warmstore_modules)
+    for f in files:
+        fns = _warmstore_functions(f)
+
+        # (1) generation re-anchoring: any write to a ``seed_generation``
+        # attribute must not be rooted in a persisted payload — the
+        # stored counter value is another process's ordinal and
+        # witnesses nothing in this one
+        for sym, fn_node in fns:
+            payload = _payload_params(fn_node)
+            if not payload:
+                continue
+            for node in ast.walk(fn_node):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                if not any(
+                    isinstance(t, ast.Attribute) and t.attr == "seed_generation"
+                    for t in targets
+                ):
+                    continue
+                roots = {
+                    n.id
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                }
+                bad = roots & payload
+                if bad:
+                    yield Finding(
+                        rule="cache-persist",
+                        path=f.relpath,
+                        line=node.lineno,
+                        symbol=sym,
+                        message=(
+                            f"restore trusts the PERSISTED generation counter "
+                            f"(rooted at {sorted(bad)}) — generation guards are "
+                            f"per-process ordinals; re-anchor to the live "
+                            f"world's generation after its content witness "
+                            f"checks out"
+                        ),
+                        severity=SEV_ERROR,
+                    )
+
+        # (2) tenant-scope preservation: a restore/rebind helper that
+        # takes the snapshot's tenant scope must actually thread it into
+        # the keys it rebuilds — an unused scope parameter means the
+        # restored keys silently dropped their tenant
+        for sym, fn_node in fns:
+            args = fn_node.args
+            scope_params = [
+                a.arg
+                for a in list(args.args) + list(args.kwonlyargs)
+                if a.arg == "tenant_scope" or a.arg.endswith("_tenant_scope")
+            ]
+            if not scope_params:
+                continue
+            used = {
+                n.id
+                for n in ast.walk(fn_node)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            }
+            for p in scope_params:
+                if p not in used:
+                    yield Finding(
+                        rule="cache-persist",
+                        path=f.relpath,
+                        line=fn_node.lineno,
+                        symbol=sym,
+                        message=(
+                            f"'{p}' is never threaded into the rebuilt keys — "
+                            f"restored entries would drop their tenant scope, "
+                            f"and a scope-free lookup would alias another "
+                            f"tenant's persisted state"
+                        ),
+                        severity=SEV_ERROR,
+                    )
+
+        # (3) contract verification: a module that declares a snapshot
+        # schema/contract must compare BOTH against every payload it
+        # reads (somewhere in the module) — a reader that skips either
+        # check re-anchors keys it may misparse
+        consts = _module_constant_names(f)
+        declared = {c for c in ("SCHEMA", "CONTRACT") if c in consts}
+        if declared and any(
+            sym.split(".")[-1].startswith(("read_", "restore")) for sym, _ in fns
+        ):
+            compared: Set[str] = set()
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Compare):
+                    for n in ast.walk(node):
+                        if isinstance(n, ast.Name) and n.id in declared:
+                            compared.add(n.id)
+            for missing in sorted(declared - compared):
+                yield Finding(
+                    rule="cache-persist",
+                    path=f.relpath,
+                    line=1,
+                    symbol="",
+                    message=(
+                        f"snapshot reader never compares a payload against "
+                        f"{missing} — version/key-layout drift would restore "
+                        f"entries the reader misparses (drop the whole "
+                        f"snapshot on mismatch, and count it)"
+                    ),
+                    severity=SEV_ERROR,
+                )

@@ -96,6 +96,13 @@ class AnalysisConfig:
         # existing-pack masks
         "karpenter_core_tpu/solver/constraint_tensors.py",
     )
+    # warm-state persistence modules (ISSUE 13): the snapshot/restore
+    # seam whose restore paths the cache-persist rule holds to the
+    # re-anchoring contract (live generations only, tenant scope
+    # preserved, schema/contract verified before trusting a payload)
+    warmstore_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/warmstore.py",
+    )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)
     # provider modules whose catalog mutators must bump catalog_generation()

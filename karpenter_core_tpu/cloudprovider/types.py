@@ -96,6 +96,15 @@ class InstanceType:
     def allocatable(self) -> ResourceList:
         return dict(self._allocatable.get())
 
+    # pickle support (solver/warmstore.py persists catalog entries): the
+    # Lazy allocatable memo holds a lock and a closure — rebuild it on
+    # load instead of serializing it
+    def __getstate__(self) -> tuple:
+        return (self.name, self.requirements, self.offerings, self.capacity, self.overhead)
+
+    def __setstate__(self, state: tuple) -> None:
+        self.__init__(*state)
+
     def __repr__(self) -> str:
         return f"InstanceType({self.name})"
 

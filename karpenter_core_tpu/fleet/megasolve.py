@@ -195,6 +195,32 @@ class CatalogPlane:
         fp = self._fingerprint_for(tenant_id, pool_name, gen, its)
         return self._canonical_for(fp, its)
 
+    def export_canon(self) -> list:
+        """(fingerprint, canonical catalog) pairs for the warm-state
+        snapshot writer (solver/warmstore.py). The ``fleetenv`` envelope
+        memo is NOT exported: its keys are per-provider generation
+        counters that do not survive a restart — admission prewarm
+        recomputes them against the live counters (one fingerprint per
+        catalog generation, the same cost it pays today)."""
+        with self._mu:
+            return [(fp, canon[0]) for fp, canon in self._canon.items()]
+
+    def import_canon(self, entries: list) -> int:
+        """Install persisted canonical catalogs. Content-addressed by
+        construction (the fingerprint digests every field the encoding
+        reads), and plane generations are RE-MINTED — a restored
+        snapshot must never collide with generations this process
+        already handed out."""
+        n = 0
+        with self._mu:
+            for fp, catalog in entries:
+                if self._canon.get(fp) is None:
+                    self._next_gen += 1
+                    # analysis: allow-cache-key(entries)
+                    self._canon.put(fp, (list(catalog), ("fleet", self._next_gen)))
+                    n += 1
+        return n
+
     def debug_state(self) -> dict:
         with self._mu:
             return {
@@ -453,6 +479,9 @@ class FleetEngine:
         self.registry = registry
         self.metrics = metrics
         self.skeletons = SkeletonPlane()
+        # tenant warm-state restores (registry.add_tenant restore_from)
+        # also publish restored job skeletons into this content plane
+        registry.engine = self
         self._mu = threading.Lock()
         self._round = 0
         self.last_round: dict = {}

@@ -78,7 +78,9 @@ class FleetRegistry:
     """Thread-safe tenant directory; add/remove are steady-state
     operations (the fleet scheduler keeps running through them)."""
 
-    def __init__(self, plane=None, metrics=None):
+    def __init__(self, plane=None, metrics=None, warmstore_dir=None):
+        import os
+
         from .megasolve import CatalogPlane
 
         self._mu = threading.RLock()
@@ -89,6 +91,17 @@ class FleetRegistry:
         self.plane = plane or CatalogPlane()
         self.metrics = metrics
         self.generation = 0  # bumped by add/remove (debug/round snapshots)
+        # warm-state persistence (ISSUE 13, solver/warmstore.py): with a
+        # directory configured, tenant removal snapshots that tenant's
+        # cache planes before eviction, and re-admission restores them —
+        # tenant migration between schedulers rides the same seam
+        self.warmstore_dir = warmstore_dir or (
+            os.environ.get("KARPENTER_TPU_WARMSTORE_DIR", "").strip() or None
+        )
+        self.evicted_snapshots: Dict[str, str] = {}
+        # the FleetEngine serving this registry (attached by its
+        # constructor): tenant restores also warm its fleetjob plane
+        self.engine = None
 
     # -- membership ---------------------------------------------------------
 
@@ -99,7 +112,13 @@ class FleetRegistry:
         provider,
         cluster=None,
         kube_client=None,
+        restore_from: Optional[str] = None,
     ) -> TenantHandle:
+        """Register a tenant. ``restore_from`` (a warm-state snapshot
+        path — e.g. another registry's ``snapshot_tenant`` output, or
+        this registry's own pre-eviction snapshot, consulted
+        automatically) restores the tenant's cache planes into the new
+        solver so its first round is warm (tenant migration)."""
         from .megasolve import TenantCatalogView
 
         tenant_id = str(tenant_id)
@@ -151,14 +170,83 @@ class FleetRegistry:
             # catalog generation), keeping its first round's timeline
             # clean — see CatalogPlane.prewarm
             self.plane.prewarm(tenant_id, provider, nodepools)
+            # migration restore: an explicit snapshot path wins; else a
+            # snapshot this registry took when the tenant was evicted
+            # (re-admission = migration back). Restored planes re-anchor
+            # against the LIVE catalog/cluster world — content that no
+            # longer matches is dropped, never trusted (warmstore.py)
+            path = restore_from or self.evicted_snapshots.pop(tenant_id, None)
+            if path is not None:
+                from .megasolve import fleet_engine_name
+
+                solver.fleet_plane = (
+                    self.engine.skeletons if self.engine is not None else None
+                )
+                # resolve catalogs exactly as the configured engine's
+                # rounds will (batched = canonical content-deduped
+                # snapshots): the restored entries must rebind to the
+                # SAME objects the first round's encode will look up
+                was_active = self.plane.active()
+                self.plane.activate(fleet_engine_name() == "batched")
+                try:
+                    solver.restore(path)
+                finally:
+                    self.plane.activate(was_active)
+                    solver.fleet_plane = None
             return handle
+
+    def snapshot_tenant(self, tenant_id: str, directory: Optional[str] = None) -> Optional[str]:
+        """Snapshot one tenant's cache planes → path (or None when the
+        tenant is unknown or persistence is disabled). The snapshot
+        carries the tenant scope, so restoring it into another
+        scheduler's registry (``add_tenant(..., restore_from=path)``)
+        migrates the tenant warm."""
+        from .megasolve import fleet_engine_name
+
+        with self._mu:
+            handle = self._tenants.get(str(tenant_id))
+        if handle is None:
+            return None
+        # resolve catalogs exactly as the configured engine's rounds do
+        # (batched = canonical snapshots): the snapshotted entries must
+        # be the ones the tenant's solves actually warmed
+        was_active = self.plane.active()
+        self.plane.activate(fleet_engine_name() == "batched")
+        try:
+            return handle.solver.snapshot(directory=directory or self.warmstore_dir)
+        finally:
+            self.plane.activate(was_active)
+
+    def snapshot_plane(self, directory: Optional[str] = None) -> Optional[str]:
+        """Snapshot the fleet's canonical-catalog content plane → path
+        (content-addressed; restoring it into another registry's plane
+        saves the first-of-content catalog clone per archetype)."""
+        from ..solver import warmstore
+
+        return warmstore.snapshot_fleet_plane(
+            self.plane, directory or self.warmstore_dir
+        )
+
+    def restore_plane(self, path: str) -> dict:
+        from ..solver import warmstore
+
+        return warmstore.restore_fleet_plane(self.plane, path)
 
     def remove_tenant(self, tenant_id: str) -> bool:
         """Drop a tenant and its pinned caches. Safe during steady
         state: an in-flight round that already holds the handle finishes
-        its solve; subsequent rounds no longer see the tenant."""
+        its solve; subsequent rounds no longer see the tenant. With a
+        warmstore directory configured the tenant's planes are
+        snapshotted BEFORE eviction, so re-admission (migration)
+        restores them instead of starting cold."""
+        tenant_id = str(tenant_id)
+        if self.warmstore_dir:
+            path = self.snapshot_tenant(tenant_id)
+            if path is not None:
+                with self._mu:
+                    self.evicted_snapshots[tenant_id] = path
         with self._mu:
-            handle = self._tenants.pop(str(tenant_id), None)
+            handle = self._tenants.pop(tenant_id, None)
             if handle is None:
                 return False
             self._provider_owner.pop(id(handle.provider), None)

@@ -460,3 +460,21 @@ class Lease(KubeObject):
 
 def next_name(prefix: str) -> str:
     return f"{prefix}-{next(_sequence):05d}"
+
+
+def name_sequence_mark() -> int:
+    """Peek the generated-name counter without consuming a name (the
+    restart harness hands it to the resumed process so post-restart
+    claim/node names continue the killed process's sequence)."""
+    global _sequence
+    mark = next(_sequence)
+    _sequence = itertools.count(mark)
+    return mark
+
+
+def resume_name_sequence(mark: int) -> None:
+    """Fast-forward the generated-name counter (never rewinds: resumed
+    names must not collide with objects already in the store)."""
+    global _sequence
+    current = name_sequence_mark()
+    _sequence = itertools.count(max(current, int(mark)))

@@ -344,6 +344,20 @@ class Metrics:
             "Padded-slot fraction wasted by the last sharded solve's mesh tiling (axis = pods | types)",
             ["axis"],
         )
+        # warm-state persistence (solver/warmstore.py): per-plane
+        # restore outcomes — every restored entry re-anchored against
+        # the live world, every witness-failed entry dropped and
+        # counted (restores are never silent, ISSUE 13)
+        self.warmstore_restored = r.counter(
+            f"{ns}_tpu_warmstore_restored_entries",
+            "Warm-state snapshot entries restored per cache plane (re-anchored against the live catalog/cluster world)",
+            ["plane"],
+        )
+        self.warmstore_dropped = r.counter(
+            f"{ns}_tpu_warmstore_dropped_entries",
+            "Warm-state snapshot entries dropped per cache plane (version/contract/fingerprint witness mismatch — never trusted)",
+            ["plane"],
+        )
         # serving pipeline (serving/pipeline.py): the decision-latency
         # SLO (pod-pending → plan emitted), per-stage durations, and
         # stage-queue depths (backpressure visibility)

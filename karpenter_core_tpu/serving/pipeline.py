@@ -91,6 +91,17 @@ class PipelineConfig:
             _env_float("KARPENTER_TPU_SERVING_DISRUPT_EVERY", 0)
         )
     )
+    # warm-state persistence (ISSUE 13, solver/warmstore.py): with a
+    # directory configured, `quiesce()` snapshots the cache planes and
+    # returns the snapshot path; `warmstore_restore` (a snapshot path)
+    # is restored before the first tick so a restarted pipeline's first
+    # solve is a warm solve
+    warmstore_dir: Optional[str] = field(
+        default_factory=lambda: os.environ.get("KARPENTER_TPU_WARMSTORE_DIR", "").strip() or None
+    )
+    warmstore_restore: Optional[str] = field(
+        default_factory=lambda: os.environ.get("KARPENTER_TPU_WARMSTORE_RESTORE", "").strip() or None
+    )
 
     def to_dict(self) -> dict:
         return {
@@ -100,6 +111,8 @@ class PipelineConfig:
             "telemetry_queue_cap": self.telemetry_queue_cap,
             "prewarm": self.prewarm,
             "disrupt_every": self.disrupt_every,
+            "warmstore_dir": self.warmstore_dir,
+            "warmstore_restore": self.warmstore_restore,
         }
 
 
@@ -332,6 +345,9 @@ class ServingPipeline:
         self._prewarm_runs = 0
         self._catalog_prewarms = 0
         self._prewarm_solver = None  # (nodepool key, TPUScheduler)
+        # warm-state restore outcome (ISSUE 13): per-plane restored/
+        # dropped counts of the pre-first-tick restore, for /debug
+        self._warmstore_outcome: Optional[dict] = None
         self._threads: List[threading.Thread] = []
         self._watch_unsub = None
 
@@ -633,9 +649,65 @@ class ServingPipeline:
             self._prewarm_solver = (key, solver, list(nodepools))
         return solver
 
+    # -- warm-state persistence (ISSUE 13, solver/warmstore.py) --------------
+
+    def _warmstore_solver(self):
+        """The solver whose warm planes snapshot/restore operate on:
+        the provisioner's live solver when it exists, else a fresh one
+        over the SAME provider object — the warm state and the catalog
+        cache are provider-keyed module state, so a restore through it
+        warms exactly what the provisioner's next solver will read."""
+        cached = self.provisioner._tpu_solver
+        if cached is not None:
+            return cached[1]
+        nodepools = [
+            np_
+            for np_ in self.kube_client.list("NodePool")
+            if np_.metadata.deletion_timestamp is None
+        ]
+        if not nodepools:
+            return None
+        from ..solver import TPUScheduler
+
+        return TPUScheduler(
+            nodepools,
+            self.provisioner.cloud_provider,
+            kube_client=self.kube_client,
+            cluster=self.cluster,
+        )
+
+    def restore_warm_state(self, path: str) -> Optional[dict]:
+        """Restore a warm-state snapshot into this pipeline's solver
+        world (call before ``start()``; ``start()`` invokes it itself
+        when ``config.warmstore_restore`` is set). → outcome dict with
+        per-plane restored/dropped counts, or None when no solver can
+        be built yet."""
+        solver = self._warmstore_solver()
+        if solver is None:
+            return None
+        outcome = solver.restore(path)
+        with self._mu:
+            self._warmstore_outcome = outcome
+        return outcome
+
+    def snapshot_warm_state(self, directory: Optional[str] = None) -> Optional[str]:
+        """Snapshot the live solver's warm planes → path (or None when
+        persistence is disabled or nothing can be snapshotted)."""
+        solver = self._warmstore_solver()
+        if solver is None:
+            return None
+        return solver.snapshot(directory=directory or self.config.warmstore_dir)
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
+        # restore BEFORE the first tick: the plan thread's first solve
+        # must already see the restored planes (zero-cold-start restart)
+        if self.config.warmstore_restore:
+            try:
+                self.restore_warm_state(self.config.warmstore_restore)
+            except Exception:  # noqa: BLE001 — a bad snapshot degrades to a cold start
+                log.exception("warm-state restore failed; starting cold")
         self._stop_evt.clear()
         self.solve_q.reopen()
         self.telemetry_q.reopen()
@@ -685,12 +757,17 @@ class ServingPipeline:
         with self._mu:
             return self._ticks
 
-    def quiesce(self, timeout: float = 30.0, require_empty: bool = True) -> bool:
+    def quiesce(self, timeout: float = 30.0, require_empty: bool = True):
         """Wait until the decision stream drains: no queued batches, no
         in-flight step, no undrained telemetry (a quiesced pipeline's
         /debug payload is settled — the tick log must already hold every
         completed tick), and (require_empty) no undecided pending pods.
-        Returns False on timeout."""
+
+        Returns False on timeout. On success, with a warmstore directory
+        configured (``config.warmstore_dir``), the quiesced cache planes
+        are snapshotted and the SNAPSHOT PATH is returned (truthy) so
+        operators/trafficgen can hand it to a restarted process without
+        a side channel; otherwise True."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._mu:
@@ -702,6 +779,10 @@ class ServingPipeline:
                 and self.solve_q.depth() == 0
                 and (not require_empty or self.latency.pending_count() == 0)
             ):
+                if self.config.warmstore_dir:
+                    path = self.snapshot_warm_state()
+                    if path is not None:
+                        return path
                 return True
             time.sleep(0.002)
         return False
@@ -721,6 +802,7 @@ class ServingPipeline:
                 **self._prewarm_stats,
             }
             disrupt_log = list(self._disrupt_log)[-4:]
+            warmstore_outcome = self._warmstore_outcome
         return {
             "config": self.config.to_dict(),
             "ticks": ticks,
@@ -744,6 +826,7 @@ class ServingPipeline:
                 "burn_rate": self._step.recorder.burn_rates(),
                 "retained": len(self._step.recorder),
             },
+            "warmstore": warmstore_outcome,
         }
 
 

@@ -280,12 +280,65 @@ def scenario_churn10x(
     return Scenario("churn10x", seed, teams, steps)
 
 
+def scenario_restart_wave(
+    scale: int = 800, teams: int = 10, seed: int = 106, waves: int = 12
+) -> Scenario:
+    """Config-7-shaped steady redeploy churn for the restart scenario
+    (ISSUE 13): each wave rolls one team — evict its live pods, re-create
+    the SAME shape multiset under fresh names (steady replicas of a
+    stable deployment, the common production case; rollout's per-wave
+    revision bumps model the rarer size-changing deploy). Shapes are
+    drawn per (seed, team), so a wave's request matrices are
+    content-identical to that team's earlier waves — exactly the content
+    a restarted process's restored job memos can serve. One catalog
+    price mutation early in the run keeps the snapshotted world honest
+    (the snapshot must reflect post-mutation prices)."""
+    rng = np.random.RandomState(seed)
+    names = _NameGen("restart")
+    live = _LivePods()
+
+    def team_shapes(team: int, count: int) -> List[tuple]:
+        trng = np.random.RandomState(seed * 1009 + team)
+        return [
+            (
+                f"{[100, 250, 500, 1000, 2000, 4000][trng.randint(6)]}m",
+                f"{[128, 512, 1024, 2048, 4096][trng.randint(5)]}Mi",
+                "1" if trng.rand() < 0.1 else None,
+            )
+            for _ in range(count)
+        ]
+
+    per_team = max(1, scale // teams)
+    base: List[PodSpecLite] = []
+    for t in range(teams):
+        base.extend(
+            PodSpecLite(names.next(), cpu, mem, gpu, t)
+            for cpu, mem, gpu in team_shapes(t, per_team)
+        )
+    live.add(base)
+    steps = [Step(creates=base)]
+    for w in range(waves):
+        if w == 1:
+            steps.append(Step(mutate_catalog=True))
+        team = int(w % teams)
+        old = live.pick(rng, 1.0, teams=[team])
+        new = [
+            PodSpecLite(names.next(), cpu, mem, gpu, team)
+            for cpu, mem, gpu in team_shapes(team, len(old))
+        ]
+        live.remove([s.name for s in old])
+        live.add(new)
+        steps.append(Step(creates=new, evicts=[s.name for s in old]))
+    return Scenario("restart_wave", seed, teams, steps)
+
+
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "rollout": scenario_rollout,
     "spot_storm": scenario_spot_storm,
     "cascade": scenario_cascade,
     "diurnal": scenario_diurnal,
     "churn10x": scenario_churn10x,
+    "restart_wave": scenario_restart_wave,
 }
 
 
@@ -346,24 +399,54 @@ class TrafficHarness:
     """One self-contained serving world. Create one per run — plan
     identity is compared across runs, so runs must not share mutable
     state (each gets its own provider, and with it its own warm-state
-    entry)."""
+    entry).
 
-    def __init__(self, teams: int = 20, n_types: int = 96, metrics: Optional[Metrics] = None):
+    ``restore`` (a ``dump_state()`` payload from a killed process)
+    rebuilds the apiserver world instead of starting empty: objects
+    re-create in store order so informers rebuild identical cluster
+    state, name/claim sequences fast-forward, and pods re-enter WITHOUT
+    their ``_karp_memo`` — a restarted process reads pods from the
+    apiserver, and the old process's interned ids must never leak into
+    the new interner's id space."""
+
+    def __init__(
+        self,
+        teams: int = 20,
+        n_types: int = 96,
+        metrics: Optional[Metrics] = None,
+        restore: Optional[dict] = None,
+    ):
         self.kube = KubeClient()
         self.provider = FakeCloudProvider()
-        self.provider.instance_types = _catalog(n_types)
+        self.provider.instance_types = (
+            list(restore["catalog"]) if restore is not None else _catalog(n_types)
+        )
         self.provider.bump_catalog_generation()  # harness owns invalidation
         self.cluster = Cluster(self.kube, self.provider)
         self.informers = Informers(self.kube, self.cluster)
         self.informers.start()
         self.recorder = Recorder(self.kube)
         self.metrics = metrics or Metrics()
-        self.nodepool = NodePool()
-        self.nodepool.metadata.name = "default"
-        self.nodepool.spec.template.requirements = [
-            NodeSelectorRequirement("team", "In", [f"t{t}" for t in range(teams)])
-        ]
-        self.kube.create(self.nodepool)
+        if restore is None:
+            self.nodepool = NodePool()
+            self.nodepool.metadata.name = "default"
+            self.nodepool.spec.template.requirements = [
+                NodeSelectorRequirement("team", "In", [f"t{t}" for t in range(teams)])
+            ]
+            self.kube.create(self.nodepool)
+        else:
+            from ..kube.objects import resume_name_sequence
+            from ..solver import podcache
+
+            for kind, obj in restore["objects"]:
+                if kind == "Pod":
+                    obj.__dict__.pop("_karp_memo", None)
+                self.kube.create(obj)
+            self.nodepool = self.kube.get("NodePool", "default")
+            resume_name_sequence(restore["name_mark"])
+            # the memo maps must be empty (fresh-interpreter contract):
+            # any surviving memo would carry the dead process's ids
+            podcache.reset()
         self.provisioner = Provisioner(
             self.kube,
             self.provider,
@@ -372,7 +455,7 @@ class TrafficHarness:
             use_tpu_solver=True,
             metrics=self.metrics,
         )
-        self._node_seq = 0
+        self._node_seq = restore["node_seq"] if restore is not None else 0
         # catalog-event fanout: the serving pipeline's catalog ingest
         # (observe_catalog_event), wired per run mode
         self.on_catalog_event: Optional[Callable[[], None]] = None
@@ -380,6 +463,37 @@ class TrafficHarness:
         self.arrivals: Dict[str, Tuple[str, int]] = {}
         self.uid_to_name: Dict[str, str] = {}
         self._live: Dict[str, Pod] = {}  # name -> live Pod object
+        if restore is not None:
+            self.arrivals = {u: tuple(v) for u, v in restore["arrivals"].items()}
+            self.uid_to_name = dict(restore["uid_to_name"])
+            for name in restore["live_names"]:
+                pod = self.kube.get("Pod", name)
+                if pod is not None:
+                    self._live[name] = pod
+
+    def dump_state(self) -> dict:
+        """Serialize the apiserver world + harness bookkeeping for a
+        process handoff (the kill-the-process-mid-stream scenario): the
+        durable state a real restart would re-read from the apiserver
+        and the cloud provider, nothing from the solver's memory."""
+        from ..kube.objects import name_sequence_mark
+
+        objects = []
+        # claims before their nodes, nodes before the pods bound to them
+        # — re-creation replays the live flow's event order
+        for kind in ("NodePool", "DaemonSet", "NodeClaim", "Node", "Pod"):
+            for obj in self.kube.list(kind):
+                objects.append((kind, obj))
+        return {
+            "version": 1,
+            "objects": objects,
+            "catalog": list(self.provider.instance_types),
+            "node_seq": self._node_seq,
+            "name_mark": name_sequence_mark(),
+            "arrivals": {u: list(v) for u, v in self.arrivals.items()},
+            "uid_to_name": dict(self.uid_to_name),
+            "live_names": sorted(self._live),
+        }
 
     # -- injection ----------------------------------------------------------
 
@@ -483,6 +597,36 @@ class TrafficHarness:
 
         warm_pod = self._materialize(PodSpecLite("warmup-0", "250m", "256Mi", None, 0))
         TPUScheduler([self.nodepool], self.provider).solve([warm_pod])
+
+    def warmup_compile_only(self, n_pods: int = 64) -> None:
+        """Backend/jit warmup that does NOT touch this harness's
+        catalog entry: the restart phases (ISSUE 13) measure the first
+        post-restart solve, and the catalog re-encode is exactly the
+        cold cost the warm-state snapshot exists to skip — warming it
+        here would flatter the cold baseline. A content-DISJOINT
+        throwaway catalog of the same size (fresh names → fresh
+        fingerprint → its own cache entry) pays backend init and the
+        shape-keyed XLA kernel compiles both restart modes would
+        otherwise pay identically inside the first measured tick."""
+        from ..apis.nodepool import NodePool as _NodePool
+        from ..solver import TPUScheduler
+
+        provider = FakeCloudProvider()
+        warm_cat = _catalog(len(self.provider.instance_types))
+        for it in warm_cat:
+            it.name = f"warm-{it.name}"
+        provider.instance_types = warm_cat
+        provider.bump_catalog_generation()
+        np_ = _NodePool()
+        np_.metadata.name = "warmup"
+        pods = []
+        for i in range(max(1, n_pods)):
+            pod = self._materialize(
+                PodSpecLite(f"warmup-{i}", _CPUS[i % len(_CPUS)], _MEMS[i % len(_MEMS)], None, 0)
+            )
+            pod.spec.node_selector = {}
+            pods.append(pod)
+        TPUScheduler([np_], provider).solve(pods)
 
     def close(self) -> None:
         self.informers.stop()
@@ -724,6 +868,243 @@ def run_free(
 
 
 # ---------------------------------------------------------------------------
+# kill-the-process-mid-stream (ISSUE 13): snapshot on quiesce, restart
+# subprocess, restore, resume the stream. The kill phase and each resume
+# phase run in their OWN processes (the config-8 pyperf discipline —
+# a resumed process must inherit nothing but the handoff + snapshot
+# files); plan streams concatenate across the kill point and must hash
+# identical to an unkilled reference run.
+
+
+def _restart_config() -> PipelineConfig:
+    # prewarm off: the measurement is the FIRST authoritative solve
+    # after restart — a racing speculative encode would warm the caches
+    # between release and solve and blur the cold/warm contrast (plan
+    # identity is unaffected either way)
+    return PipelineConfig(
+        idle_seconds=0.02, max_seconds=1.0, solve_queue_cap=1,
+        telemetry_queue_cap=1024, prewarm=False,
+        warmstore_dir=None, warmstore_restore=None,
+    )
+
+
+def _drive_steps(pipe, harness, steps, first_index, quiesce_timeout):
+    """Lockstep-drive ``steps`` through a held pipeline; returns the
+    per-solve tick records (step_ms/solve_host_ms of ticks that decided
+    pods) and the last quiesce() return (the snapshot path when the
+    pipeline's warmstore_dir is set for the final step)."""
+    solve_ticks: List[dict] = []
+    seen = set()
+    out = True
+    for i, step in enumerate(steps):
+        harness.inject_step(step, first_index + i)
+        pipe.release()
+        out = pipe.quiesce(timeout=quiesce_timeout)
+        if not out:
+            raise TimeoutError(f"pipeline failed to quiesce at resumed step {first_index + i}")
+        pipe.hold()
+        for tick_rec in pipe.debug_state()["last_ticks"]:
+            if tick_rec.get("tick") in seen:
+                continue
+            seen.add(tick_rec.get("tick"))
+            if tick_rec.get("decided", 0) > 0:
+                solve_ticks.append(
+                    {
+                        "tick": tick_rec.get("tick"),
+                        "step_ms": tick_rec.get("step_ms", 0.0),
+                        "solve_host_ms": tick_rec.get("solve_host_ms", 0.0),
+                    }
+                )
+    return solve_ticks, out
+
+
+def run_restart_kill(
+    scenario_name: str,
+    kill_step: int,
+    workdir: str,
+    scale: int = 800,
+    seed: Optional[int] = None,
+    teams: Optional[int] = None,
+    n_types: int = 480,
+    quiesce_timeout: float = 120.0,
+) -> dict:
+    """Phase A of the kill scenario: drive steps [0, kill_step) through
+    a serving pipeline, quiesce (which snapshots the warm planes and
+    returns the snapshot path), dump the apiserver world + partial plan
+    stream to ``workdir/handoff.pkl``, and return a summary. The caller
+    then EXITS — everything the resumed process may use is on disk."""
+    sc = build_scenario(scenario_name, scale=scale, seed=seed)
+    if not 0 < kill_step < len(sc.steps):
+        raise ValueError(f"kill_step must be in (0, {len(sc.steps)}), got {kill_step}")
+    harness = TrafficHarness(teams=teams or sc.teams, n_types=n_types)
+    rec = _StreamRecorder(harness)
+    pipe = ServingPipeline(
+        harness.provisioner, metrics=harness.metrics, config=_restart_config(),
+        on_decision=rec,
+    )
+    harness.on_catalog_event = pipe.observe_catalog_event
+    harness.warmup_compile_only()
+    pipe.attach_watch()
+    pipe.hold()
+    pipe.start()
+    try:
+        solve_ticks, _ = _drive_steps(
+            pipe, harness, sc.steps[: kill_step - 1], 0, quiesce_timeout
+        )
+        # final pre-kill step: arm the snapshot — quiesce() returns the
+        # snapshot path (the satellite contract: no side channel needed
+        # to hand the restarted process its warm state)
+        pipe.config.warmstore_dir = workdir
+        last_ticks, path = _drive_steps(
+            pipe, harness, [sc.steps[kill_step - 1]], kill_step - 1, quiesce_timeout
+        )
+        solve_ticks.extend(last_ticks)
+        snapshot_path = path if isinstance(path, str) else None
+    finally:
+        pipe.stop()
+    steady = [t["step_ms"] for t in solve_ticks[1:]] or [t["step_ms"] for t in solve_ticks]
+    handoff = harness.dump_state()
+    handoff.update(
+        scenario=scenario_name, scale=scale, seed=sc.seed, teams=teams or sc.teams,
+        n_types=n_types, kill_step=kill_step,
+        plan_stream=rec.stream, decision_ticks=rec.decision_ticks,
+        snapshot_path=snapshot_path,
+        steady_step_ms_p50=float(np.median(steady)) if steady else 0.0,
+    )
+    handoff_path = os.path.join(workdir, "handoff.pkl")
+    import pickle
+
+    with open(handoff_path, "wb") as f:
+        pickle.dump(handoff, f, protocol=4)
+    harness.close()
+    return {
+        "phase": "kill",
+        "scenario": scenario_name,
+        "kill_step": kill_step,
+        "steps_driven": kill_step,
+        "snapshot_path": snapshot_path,
+        "handoff_path": handoff_path,
+        "plans_emitted": len(rec.stream),
+        "steady_step_ms_p50": handoff["steady_step_ms_p50"],
+    }
+
+
+def run_restart_resume(
+    handoff_path: str,
+    restore: bool = True,
+    quiesce_timeout: float = 120.0,
+) -> dict:
+    """Phase B: rebuild the world from the handoff (the durable state a
+    restarted operator re-reads), restore the warm-state snapshot
+    (``restore=False`` = the unsnapshot cold-restart baseline), resume
+    the stream from the kill step, and report the full-stream plan hash
+    plus the post-restart warm-up trajectory."""
+    import hashlib
+    import pickle
+
+    with open(handoff_path, "rb") as f:
+        handoff = pickle.load(f)
+    sc = build_scenario(handoff["scenario"], scale=handoff["scale"], seed=handoff["seed"])
+    kill_step = handoff["kill_step"]
+    harness = TrafficHarness(
+        teams=handoff["teams"] or sc.teams, n_types=handoff["n_types"], restore=handoff
+    )
+    rec = _StreamRecorder(harness)
+    pipe = ServingPipeline(
+        harness.provisioner, metrics=harness.metrics, config=_restart_config(),
+        on_decision=rec,
+    )
+    harness.on_catalog_event = pipe.observe_catalog_event
+    harness.warmup_compile_only()
+    restore_ms = 0.0
+    warmstore_outcome = None
+    snapshot_path = handoff.get("snapshot_path")
+    if restore and snapshot_path:
+        # restore BEFORE the first tick (the pipeline hook); timed
+        # separately so bench can report restore_ms on its own
+        t0 = time.perf_counter()
+        warmstore_outcome = pipe.restore_warm_state(snapshot_path)
+        restore_ms = (time.perf_counter() - t0) * 1000.0
+    pipe.attach_watch()
+    pipe.hold()
+    pipe.start()
+    try:
+        solve_ticks, _ = _drive_steps(
+            pipe, harness, sc.steps[kill_step:], kill_step, quiesce_timeout
+        )
+    finally:
+        pipe.stop()
+    harness.close()
+    full_stream = list(handoff["plan_stream"]) + list(rec.stream)
+    steady_p50 = handoff.get("steady_step_ms_p50") or 0.0
+    # warm-up trajectory: 1-indexed post-restart solve tick at which the
+    # pipeline is back to the killed process's steady p50 (x1.5 + 2 ms
+    # of jitter headroom); 0 = never within the driven window
+    ticks_to_warm = 0
+    for i, t in enumerate(solve_ticks):
+        if steady_p50 and t["step_ms"] <= steady_p50 * 1.5 + 2.0:
+            ticks_to_warm = i + 1
+            break
+    return {
+        "phase": "resume",
+        "mode": "warm" if (restore and snapshot_path) else "cold",
+        "scenario": handoff["scenario"],
+        "kill_step": kill_step,
+        "restored": warmstore_outcome is not None,
+        "restore_ms": round(restore_ms, 3),
+        "warmstore": warmstore_outcome,
+        "first_solve_ms": solve_ticks[0]["step_ms"] if solve_ticks else 0.0,
+        "first_solve_host_ms": solve_ticks[0]["solve_host_ms"] if solve_ticks else 0.0,
+        "post_restart_step_ms": [round(t["step_ms"], 3) for t in solve_ticks],
+        "steady_step_ms_p50": steady_p50,
+        "ticks_to_warm": ticks_to_warm,
+        "plans_emitted": len(full_stream),
+        "plan_sha256": hashlib.sha256(repr(full_stream).encode()).hexdigest(),
+    }
+
+
+def run_restart_reference(
+    scenario_name: str,
+    scale: int = 800,
+    seed: Optional[int] = None,
+    teams: Optional[int] = None,
+    n_types: int = 480,
+    quiesce_timeout: float = 120.0,
+) -> dict:
+    """The unkilled oracle: the same scenario driven end to end in one
+    process, same pipeline config and harness shape as the kill/resume
+    phases — its full-stream plan hash is what the concatenated
+    killed-run stream must equal (byte identity across the kill point)."""
+    import hashlib
+
+    sc = build_scenario(scenario_name, scale=scale, seed=seed)
+    harness = TrafficHarness(teams=teams or sc.teams, n_types=n_types)
+    rec = _StreamRecorder(harness)
+    pipe = ServingPipeline(
+        harness.provisioner, metrics=harness.metrics, config=_restart_config(),
+        on_decision=rec,
+    )
+    harness.on_catalog_event = pipe.observe_catalog_event
+    harness.warmup_compile_only()
+    pipe.attach_watch()
+    pipe.hold()
+    pipe.start()
+    try:
+        solve_ticks, _ = _drive_steps(pipe, harness, sc.steps, 0, quiesce_timeout)
+    finally:
+        pipe.stop()
+    harness.close()
+    return {
+        "phase": "reference",
+        "scenario": scenario_name,
+        "steps": len(sc.steps),
+        "plans_emitted": len(rec.stream),
+        "plan_sha256": hashlib.sha256(repr(list(rec.stream)).encode()).hexdigest(),
+        "solve_ticks": len(solve_ticks),
+    }
+
+
+# ---------------------------------------------------------------------------
 # fleet driver: N independent scenario streams against one device
 # (fleet/ — ISSUE 9). Each tenant gets its own provider/catalog archetype
 # and its own seeded scenario; steps are injected fleet-wide and decided
@@ -952,7 +1333,42 @@ def _cli(argv=None) -> int:
                          "--scenario through the fleet scheduler")
     ap.add_argument("--engine", default="batched", choices=("batched", "solo"),
                     help="fleet engine (with --fleet)")
+    # kill-the-process-mid-stream (ISSUE 13): each phase is one process
+    ap.add_argument("--restart-kill-at", type=int, default=0, metavar="K",
+                    help="drive steps [0, K), snapshot on quiesce, dump the "
+                         "handoff to --workdir, print the summary, exit "
+                         "(the kill IS the process exit)")
+    ap.add_argument("--restart-resume", metavar="HANDOFF", default=None,
+                    help="rebuild from a kill phase's handoff, restore the "
+                         "warm-state snapshot, resume the stream from the "
+                         "kill step")
+    ap.add_argument("--restart-reference", action="store_true",
+                    help="drive the whole scenario unkilled (the identity "
+                         "oracle for a kill/resume pair)")
+    ap.add_argument("--cold", action="store_true",
+                    help="with --restart-resume: skip the warm-state restore "
+                         "(the unsnapshot cold-restart baseline)")
+    ap.add_argument("--workdir", default=None,
+                    help="snapshot/handoff directory (with --restart-kill-at)")
+    ap.add_argument("--n-types", type=int, default=480,
+                    help="catalog size for the restart phases")
     args = ap.parse_args(argv)
+    if args.restart_kill_at or args.restart_resume or args.restart_reference:
+        if args.restart_resume:
+            out = run_restart_resume(args.restart_resume, restore=not args.cold)
+        elif args.restart_reference:
+            out = run_restart_reference(
+                args.scenario, scale=args.scale, seed=args.seed, n_types=args.n_types
+            )
+        else:
+            if not args.workdir:
+                ap.error("--restart-kill-at requires --workdir")
+            out = run_restart_kill(
+                args.scenario, args.restart_kill_at, args.workdir,
+                scale=args.scale, seed=args.seed, n_types=args.n_types,
+            )
+        print(json.dumps(out), flush=True)
+        return 0
     if args.fleet:
         out = run_fleet_measurement(
             n_tenants=args.fleet,

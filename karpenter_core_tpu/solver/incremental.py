@@ -167,6 +167,13 @@ class LRU:
         with self._mu:
             return len(self._d)
 
+    def items(self) -> list:
+        """Point-in-time (key, value) snapshot in LRU order, oldest
+        first — the warm-state snapshot writer (solver/warmstore.py)
+        serializes planes through this so iteration never races puts."""
+        with self._mu:
+            return list(self._d.items())
+
     def clear(self) -> None:
         with self._mu:
             self._d.clear()

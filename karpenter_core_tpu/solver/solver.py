@@ -686,6 +686,10 @@ class TPUScheduler:
         # shared fleet-wide under the tenant-free CONTENT prefix of the
         # job key (see _pack_and_finalize)
         self.fleet_plane = None
+        # warm-state persistence (ISSUE 13, solver/warmstore.py): the
+        # most recent snapshot/restore outcome — /debug/solve/stats
+        # "warmstore" block (stats.py SCHEMA=4) + bench `_split`
+        self.last_warmstore_stats: Optional[dict] = None
 
     # ------------------------------------------------------------------
 
@@ -1871,6 +1875,29 @@ class TPUScheduler:
         stats["pools"] = len(pools)
         stats["prewarm_ms"] = round((_time.perf_counter() - t0) * 1000.0, 3)
         return stats
+
+    # -- warm-state persistence (ISSUE 13, solver/warmstore.py) --------------
+
+    def snapshot(self, directory: Optional[str] = None) -> Optional[str]:
+        """Serialize this solver's cross-solve cache planes (catalog
+        entries + sig_rows, job/merge/emit skeletons, route LRU, seeds,
+        intersects) to a versioned on-disk snapshot → path, or None when
+        persistence is disabled/failed (never raises)."""
+        from . import warmstore
+
+        return warmstore.snapshot(self, directory=directory)
+
+    def restore(self, path: str) -> dict:
+        """Restore a snapshot into this solver's warm world with full
+        generation re-anchoring (catalog fingerprints and the cluster
+        witness are revalidated against the LIVE world; mismatches are
+        dropped and counted, never trusted). → outcome dict, also in
+        ``last_warmstore_stats``."""
+        from . import warmstore
+
+        return warmstore.restore(
+            self, path, metrics=self.metrics, fleet_plane=self.fleet_plane
+        )
 
     def _solve_tensor(
         self,

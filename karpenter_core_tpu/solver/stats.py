@@ -20,8 +20,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-SCHEMA = 3  # 3: "route" block added (tensor/parked/oracle pod split per
-# solve + oracle share, ISSUE 12); 2: "shard" block (mesh padding)
+SCHEMA = 4  # 4: "warmstore" block (snapshot/restore outcome — per-plane
+# restored/dropped counts, ISSUE 13); 3: "route" block added (tensor/
+# parked/oracle pod split per solve + oracle share, ISSUE 12); 2:
+# "shard" block (mesh padding)
 
 
 def _round3(v) -> float:
@@ -65,7 +67,22 @@ def solve_stats(solver, disruption=None) -> dict:
         "shard": dict(ss) if (ss := getattr(solver, "last_shard_stats", None)) else None,
         "route": dict(rs) if (rs := getattr(solver, "last_route_stats", None)) else None,
         "disruption": dict(dstats) if dstats else None,
+        "warmstore": _warmstore_block(solver),
     }
+
+
+def _warmstore_block(solver) -> Optional[dict]:
+    """The most recent snapshot/restore outcome. The solver's own stamp
+    wins; the process-level fallback covers the restore-before-first-
+    tick path, where the restore ran through a throwaway solver before
+    the provisioner built its live one (the planes are shared module
+    state either way — only the outcome record rides an instance)."""
+    wss = getattr(solver, "last_warmstore_stats", None)
+    if wss:
+        return dict(wss)
+    from . import warmstore
+
+    return warmstore.last_outcomes().get("restore")
 
 
 def bench_fields(stats: dict) -> dict:
@@ -93,6 +110,9 @@ def bench_fields(stats: dict) -> dict:
     rt = stats.get("route")
     if rt:
         out["route"] = dict(rt)
+    wss = stats.get("warmstore")
+    if wss:
+        out["warmstore"] = dict(wss)
     merge = stats.get("merge", {})
     out["merge_ms"] = round(merge.get("ms", 0.0), 2)
     out["merge_candidates_screened"] = merge.get("candidates_screened", 0)

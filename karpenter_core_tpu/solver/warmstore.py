@@ -1,0 +1,772 @@
+"""Warm-state persistence: snapshot/restore of the cross-solve cache
+planes (ISSUE 13 tentpole).
+
+At production scale rolling restarts are constant, and every restart
+pays the cold "restart-shaped" solve (bench config 7: cold p50 ~131 ms
+vs warm ~32 ms; config 9's disruption path ~20x). The memo planes are
+already content-addressed with process-stable blake2b fingerprints
+(solver/stablehash.py, PR 5) — this module serializes them to a
+versioned on-disk snapshot and re-anchors them into a fresh process so
+the first post-restart solve is a warm solve.
+
+What persists, per plane (the "snapshot contents" table in README):
+
+- **catalog entries** (``solver._CATALOG_CACHE``): vocab + axis + the
+  encoded tensors + the ``sig_rows`` compat-row LRU. Entries are keyed
+  on disk by CONTENT fingerprint only — the in-memory identity key is
+  an address and never persisted.
+- **job skeletons** (``WarmState.jobs``), **merge skeletons**
+  (``WarmState.merges``), **emit choices** (``WarmState.emits``) and
+  **merge screen rows** (``WarmState.screen_rows``): keys carry the
+  catalog entry's identity head ``(id(entry), fingerprint)`` — stored
+  as ``("encfp", fingerprint)`` and rebound on load.
+- **route split** (``WarmState.routes``): keys are interned signature
+  ids (process-local ordinals) — stored as the signature TUPLES and
+  re-interned through ``podcache.intern_sig`` on load.
+- **topology seeds** (``WarmState.seed_lru``): guarded by the live
+  ``Cluster.generation()`` counter, which does not survive a restart.
+  The snapshot records a content witness of the kube-visible pod/node
+  world instead; on load the witness must match the LIVE world, and the
+  plane re-anchors to the LIVE generation — the persisted counter value
+  is another process's counter and witnesses nothing here.
+- **intersects memo**: fingerprint-addressed, persisted as-is.
+- **fleet content planes** (``fleetenv``/``fleetcanon``/``fleetjob``,
+  fleet/megasolve.py): restored through the same job-key rebinding; the
+  per-tenant variant (``FleetRegistry.snapshot_tenant``) gives tenant
+  migration between schedulers the same way.
+
+Soundness discipline (the PR-5 cachesound rules, extended to persisted
+keys by ``analysis/cachesound.py``'s ``cache-persist`` rule): a
+restored entry must witness the same read-set as a freshly computed
+one. Any entry whose fingerprint witness does not match the live world
+is DROPPED, never trusted — and restores are never silent: every plane
+reports ``restored``/``dropped`` counts through
+``karpenter_tpu_warmstore_{restored,dropped}_entries{plane=...}``, the
+``/debug/solve/stats`` ``warmstore`` block (stats.py SCHEMA=4), and the
+bench ``_split`` output.
+
+Knobs: ``KARPENTER_TPU_WARMSTORE_DIR`` (snapshot directory; unset =
+persistence disabled), ``KARPENTER_TPU_WARMSTORE_MAX_MB`` (snapshot
+size cap — oversized planes are trimmed largest-first, never silently).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..tracing import tracer
+from . import incremental, podcache
+from .stablehash import stable_hash
+
+log = logging.getLogger("karpenter.warmstore")
+
+SCHEMA = 1
+
+#: The writer's key-layout contract, one line per plane. Any change to
+#: how a plane's keys are built MUST edit the matching line (and thereby
+#: the contract hash): a reader whose contract differs drops the whole
+#: snapshot instead of re-anchoring keys it would misparse.
+_KEY_CONTRACT = (
+    ("catalog", "content fp -> (vocab, axis, enc); sig_rows[(pool_fp, sig_tuple)]"),
+    ("compat", "(pool_fp, sig_tuple) -> SigRow on the owning catalog entry"),
+    ("route", "(sig_tuple..., ('ce', engine)) -> (tensor_idx, parked_idx, oracle_idx)"),
+    ("job", "(('encfp', fp), pool_fp, zone, reqs digest, masks..., engine+backend tokens) + tenant scope -> JobSkeleton"),
+    ("merge", "(engine, scan_cap, rkey stream) -> MergeSkeleton; rkey = (job key, node ordinal)"),
+    ("emit", "absorption trail (rkey...) -> emitted offering choice"),
+    ("mergerow", "rkey -> packed screen row"),
+    ("seeds", "(constraint key..., exclusion uids, sim_drained, tenant scope) -> domain counts; plane guard = cluster witness"),
+    ("intersects", "(reqs fp, reqs fp) -> bool"),
+    ("fleetjob", "tenant-free job-key content prefix -> JobSkeleton"),
+)
+CONTRACT = stable_hash(_KEY_CONTRACT).hex()
+
+_MAGIC = b"KTPU-WARMSTORE\n"
+
+# payload planes in trim order: when the snapshot exceeds
+# KARPENTER_TPU_WARMSTORE_MAX_MB the cheapest-to-recompute planes drop
+# first (screen rows re-derive from the merge pass; catalogs last — they
+# are the single biggest cold-solve cost)
+_TRIM_ORDER = ("screen_rows", "emits", "merges", "intersects", "jobs", "routes", "seeds", "catalogs")
+
+_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob")
+
+# most recent snapshot/restore outcome (observability; guarded — the
+# serving pipeline snapshots from its plan thread while debug routes
+# read from the server thread)
+_LAST_LOCK = threading.Lock()
+_LAST: Dict[str, Optional[dict]] = {"snapshot": None, "restore": None}
+
+
+def warmstore_dir() -> Optional[str]:
+    d = os.environ.get("KARPENTER_TPU_WARMSTORE_DIR", "").strip()
+    return d or None
+
+
+def max_bytes() -> int:
+    try:
+        mb = float(os.environ.get("KARPENTER_TPU_WARMSTORE_MAX_MB", "256"))
+    except ValueError:
+        mb = 256.0
+    return max(1, int(mb * 1024 * 1024))
+
+
+def last_outcomes() -> dict:
+    with _LAST_LOCK:
+        return {k: dict(v) if v else None for k, v in _LAST.items()}
+
+
+def _set_last(kind: str, outcome: dict) -> None:
+    with _LAST_LOCK:
+        _LAST[kind] = dict(outcome)
+
+
+# ---------------------------------------------------------------------------
+# key codecs: in-memory identity heads <-> content-addressed stored keys
+
+
+def _store_job_key(key: tuple) -> Optional[tuple]:
+    """Persisted form of one job-memo key: the identity head
+    ``(id(entry), fp)`` becomes ``("encfp", fp)`` and the trailing
+    tenant scope is split off (persisted once per snapshot — the key
+    layout contract says scope is LAST)."""
+    head = key[0]
+    if not (isinstance(head, tuple) and len(head) == 2 and isinstance(head[1], bytes)):
+        return None
+    return (("encfp", head[1]),) + key[1:-1]
+
+
+def _rebind_job_key(stored: tuple, enc_heads: Dict[bytes, tuple], tenant_scope: tuple) -> Optional[tuple]:
+    """Re-anchor one persisted job key to the live world: the stored
+    ``("encfp", fp)`` head rebinds to the live catalog entry's identity
+    head (fingerprint witness — no live entry with this content means
+    the key is dropped), and the snapshot's tenant scope rides the
+    rebuilt key unchanged. Dropping the scope would let a scope-free
+    lookup alias another tenant's restored entries — the
+    ``cache-persist`` rule holds this line."""
+    tag = stored[0]
+    if not (isinstance(tag, tuple) and len(tag) == 2 and tag[0] == "encfp"):
+        return None
+    head = enc_heads.get(tag[1])
+    if head is None:
+        return None
+    return (head,) + stored[1:] + (tenant_scope,)
+
+
+def _store_rkey(rkey: tuple) -> Optional[tuple]:
+    jk = _store_job_key(rkey[0])
+    return None if jk is None else (jk, int(rkey[1]))
+
+
+def _rebind_rkey(stored: tuple, enc_heads: Dict[bytes, tuple], tenant_scope: tuple) -> Optional[tuple]:
+    jk = _rebind_job_key(stored[0], enc_heads, tenant_scope)
+    return None if jk is None else (jk, int(stored[1]))
+
+
+def _sanitize_runtime_caches(caches: dict) -> dict:
+    """Persistable subset of an encoding's derived-tensor cache: numpy
+    values under content keys only. The ``("type_ord",)`` table maps
+    object ids (rebuilt lazily against the live catalog objects) and
+    must never cross a process boundary."""
+    out = {}
+    for k, v in caches.items():
+        if k == ("type_ord",) or not isinstance(v, np.ndarray):
+            continue
+        out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the cluster-world witness for the topology seed plane
+
+
+def cluster_witness(kube_client) -> Optional[bytes]:
+    """Content digest of the kube-visible pod/node/claim world the
+    topology seed counts derive from. Conservative by design: any
+    difference (including ones seeds would not observe) drops the seed
+    plane to a cold recompute — sound in the only direction that
+    matters."""
+    if kube_client is None:
+        return None
+    try:
+        pods = tuple(sorted(
+            (
+                p.namespace,
+                p.metadata.name,
+                tuple(sorted((p.metadata.labels or {}).items())),
+                p.spec.node_name or "",
+                getattr(p.status, "phase", "") or "",
+                p.metadata.deletion_timestamp is not None,
+            )
+            for p in kube_client.list("Pod")
+        ))
+        nodes = tuple(sorted(
+            (n.metadata.name, tuple(sorted((n.metadata.labels or {}).items())))
+            for n in kube_client.list("Node")
+        ))
+        claims = tuple(sorted(
+            (c.metadata.name, tuple(sorted((c.metadata.labels or {}).items())))
+            for c in kube_client.list("NodeClaim")
+        ))
+        return stable_hash((pods, nodes, claims))
+    except Exception:  # noqa: BLE001 — a witness failure must degrade to "no seeds", not crash
+        log.debug("cluster witness failed", exc_info=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# snapshot (writer)
+
+
+def _collect_catalog_entries(solver) -> List[tuple]:
+    """(fingerprint, entry) for every live catalog entry this solver's
+    pools resolve to (under _CATALOG_LOCK — entries are shared)."""
+    from .solver import _CATALOG_CACHE, _CATALOG_LOCK
+
+    _pools, pool_catalogs = solver._build_pools()
+    out: List[tuple] = []
+    seen = set()
+    with _CATALOG_LOCK:
+        for cat in pool_catalogs:
+            entry = _CATALOG_CACHE.get(tuple(map(id, cat)))  # analysis: allow-cache-determinism(id)
+            if entry is None or entry.fingerprint in seen:
+                continue
+            seen.add(entry.fingerprint)
+            out.append((entry.fingerprint, entry))
+    return out
+
+
+def build_payload(solver) -> dict:
+    """Assemble the (pre-pickle) snapshot payload from the solver's warm
+    state and its catalog entries. Pure read — never mutates the planes."""
+    from .solver import _CATALOG_LOCK
+
+    ws = incremental.warm_state_for(solver)
+    tenant_scope = tuple(getattr(solver, "_tenant_scope", ()) or ())
+    sig_names = podcache.sig_for_id()
+
+    catalogs: List[dict] = []
+    with _CATALOG_LOCK:
+        for fp, entry in _collect_catalog_entries(solver):
+            rows = []
+            for (pool_fp, sid), row in entry.sig_rows.items():
+                sig = sig_names.get(sid)
+                if sig is not None:  # intern table may have been cleared: drop, never guess
+                    rows.append((pool_fp, sig, row))
+            enc = entry.enc
+            catalogs.append(dict(
+                fingerprint=fp,
+                vocab=entry.vocab,
+                axis=entry.axis,
+                enc=enc,
+                runtime_caches=_sanitize_runtime_caches(enc.runtime_caches),
+                sig_rows=rows,
+            ))
+
+    payload: dict = {
+        "schema": SCHEMA,
+        "contract": CONTRACT,
+        "tenant": tenant_scope,
+        "catalogs": catalogs,
+        "routes": [],
+        "jobs": [],
+        "merges": [],
+        "emits": [],
+        "screen_rows": [],
+        "seeds": {"witness": None, "generation": None, "entries": []},
+        "intersects": [],
+    }
+    if ws is None:
+        return payload
+
+    for key, val in ws.routes.items():
+        sigs = []
+        ok = True
+        for part in key[:-1]:
+            sig = sig_names.get(part)
+            if sig is None:
+                ok = False
+                break
+            sigs.append(sig)
+        if ok:
+            payload["routes"].append((tuple(sigs), key[-1], val))
+
+    for key, skel in ws.jobs.items():
+        stored = _store_job_key(key)
+        if stored is not None:
+            payload["jobs"].append((stored, skel))
+
+    for key, skel in ws.merges.items():
+        engine, cap, rkeys = key
+        srk = [_store_rkey(rk) for rk in rkeys]
+        if any(s is None for s in srk):
+            continue
+        clusters = []
+        bad = False
+        for cluster in skel.clusters:
+            trail = [_store_rkey(rk) for rk in cluster[0]]
+            if any(t is None for t in trail):
+                bad = True
+                break
+            clusters.append((tuple(trail),) + tuple(cluster[1:]))
+        if not bad:
+            payload["merges"].append(
+                ((engine, cap, tuple(srk)), clusters, int(skel.applied))
+            )
+
+    for trail, emitted in ws.emits.items():
+        strail = [_store_rkey(rk) for rk in trail]
+        if not any(s is None for s in strail):
+            payload["emits"].append((tuple(strail), emitted))
+
+    for rkey, row in ws.screen_rows.items():
+        stored = _store_rkey(rkey)
+        if stored is not None:
+            payload["screen_rows"].append((stored, row))
+
+    with ws.lock:
+        payload["seeds"] = {
+            "witness": cluster_witness(solver.kube_client),
+            # snapshot-time counter value, recorded for debugging ONLY:
+            # restore re-anchors to the live cluster's counter and must
+            # never trust this one (cache-persist rule)
+            "generation": ws.seed_generation,
+            "entries": [(k, dict(v)) for k, v in ws.seed_lru.items()],
+        }
+    payload["intersects"] = list(ws.intersects.items())
+    return payload
+
+
+def _plane_counts(payload: dict) -> dict:
+    return {
+        "catalog": len(payload.get("catalogs", ())),
+        "compat": sum(len(c["sig_rows"]) for c in payload.get("catalogs", ())),
+        "route": len(payload.get("routes", ())),
+        "job": len(payload.get("jobs", ())),
+        "merge": len(payload.get("merges", ())),
+        "emit": len(payload.get("emits", ())),
+        "mergerow": len(payload.get("screen_rows", ())),
+        "seeds": len((payload.get("seeds") or {}).get("entries", ())),
+        "intersects": len(payload.get("intersects", ())),
+    }
+
+
+def write_snapshot(payload: dict, directory: str) -> Optional[str]:
+    """Serialize one payload to a content-addressed snapshot file.
+    Oversized payloads trim planes in ``_TRIM_ORDER`` (recorded in the
+    header and the outcome — never silent). Returns the path, or None
+    when nothing useful fits."""
+    trimmed: List[str] = []
+    cap = max_bytes()
+    body = pickle.dumps(payload, protocol=4)
+    for plane in _TRIM_ORDER:
+        if len(body) <= cap:
+            break
+        if plane == "seeds":
+            payload["seeds"] = {"witness": None, "generation": None, "entries": []}
+        elif payload.get(plane):
+            payload[plane] = []
+        else:
+            continue
+        trimmed.append(plane)
+        body = pickle.dumps(payload, protocol=4)
+    if len(body) > cap:
+        log.warning("warmstore snapshot exceeds cap even after trimming; not written")
+        return None
+    header = {
+        "schema": SCHEMA,
+        "contract": CONTRACT,
+        "payload_sha256": hashlib.sha256(body).hexdigest(),
+        "planes": _plane_counts(payload),
+        "tenant": list(payload.get("tenant", ())),
+        "trimmed": trimmed,
+    }
+    os.makedirs(directory, exist_ok=True)
+    digest = hashlib.blake2b(body, digest_size=8).hexdigest()
+    path = os.path.join(directory, f"warmstore-{digest}.snap")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write((json.dumps(header) + "\n").encode())
+        f.write(body)
+    os.replace(tmp, path)  # a killed writer never leaves a half-snapshot
+    return path
+
+
+def snapshot(solver, directory: Optional[str] = None) -> Optional[str]:
+    """Snapshot this solver's warm planes to ``directory`` (default
+    ``KARPENTER_TPU_WARMSTORE_DIR``; unset = disabled → None). Never
+    raises: persistence is an optimization, failures degrade to the
+    cold restart the process would have paid anyway."""
+    directory = directory or warmstore_dir()
+    if directory is None:
+        return None
+    try:
+        # own trace root: build_payload runs _build_pools (encode.*
+        # spans) and may execute on a quiescing pipeline's caller thread
+        # with no enclosing trace — a span without a root is an orphan,
+        # and the serving identity tests gate orphans at zero
+        with tracer.trace_root("warmstore.snapshot", buffer_if="never"):
+            payload = build_payload(solver)
+            path = write_snapshot(payload, directory)
+    except Exception:  # noqa: BLE001 — see docstring: never fail the caller's shutdown path
+        log.exception("warmstore snapshot failed")
+        return None
+    if path is not None:
+        _set_last("snapshot", {"path": path, "planes": _plane_counts(payload)})
+    return path
+
+
+# ---------------------------------------------------------------------------
+# restore (reader)
+
+
+def read_snapshot(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """→ (payload, drop_reason). A snapshot is dropped WHOLE on any
+    version/contract/digest mismatch or corruption — restored state is
+    either provably the writer's, or absent."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        return None, f"unreadable: {e}"
+    if not raw.startswith(_MAGIC):
+        return None, "bad magic"
+    try:
+        nl = raw.index(b"\n", len(_MAGIC))
+        header = json.loads(raw[len(_MAGIC):nl])
+        body = raw[nl + 1:]
+    except (ValueError, json.JSONDecodeError) as e:
+        return None, f"bad header: {e}"
+    if header.get("schema") != SCHEMA:
+        return None, f"schema mismatch: {header.get('schema')} != {SCHEMA}"
+    if header.get("contract") != CONTRACT:
+        return None, "key-layout contract mismatch"
+    if hashlib.sha256(body).hexdigest() != header.get("payload_sha256"):
+        return None, "payload digest mismatch (truncated or corrupt)"
+    try:
+        payload = pickle.loads(body)
+    except Exception as e:  # noqa: BLE001 — any unpickling failure means "no snapshot"
+        return None, f"unpicklable payload: {e}"
+    if payload.get("schema") != SCHEMA or payload.get("contract") != CONTRACT:
+        return None, "payload/header version skew"
+    return payload, None
+
+
+class _Outcome:
+    """Per-plane restored/dropped accounting (never silent)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.restored: Dict[str, int] = {}
+        self.dropped: Dict[str, int] = {}
+        self.reason: Optional[str] = None
+
+    def ok(self, plane: str, n: int = 1) -> None:
+        if n:
+            self.restored[plane] = self.restored.get(plane, 0) + n
+
+    def drop(self, plane: str, n: int = 1) -> None:
+        if n:
+            self.dropped[plane] = self.dropped.get(plane, 0) + n
+
+    def drop_all(self, payload: Optional[dict], reason: str) -> dict:
+        self.reason = reason
+        if payload is not None:
+            for plane, n in _plane_counts(payload).items():
+                self.drop(plane, n)
+        else:
+            self.drop("snapshot", 1)
+        return self.to_dict()
+
+    def to_dict(self) -> dict:
+        out = {
+            "path": self.path,
+            "restored": dict(self.restored),
+            "dropped": dict(self.dropped),
+        }
+        if self.reason:
+            out["reason"] = self.reason
+        return out
+
+
+def _restore_catalogs(solver, payload: dict, out: _Outcome) -> Dict[bytes, tuple]:
+    """Install snapshotted catalog entries whose content fingerprint
+    matches a LIVE catalog, rebound to the live objects and the live
+    catalog generation. Returns fp → live identity head for job-key
+    rebinding."""
+    from .solver import _CATALOG_CACHE, _CATALOG_LOCK, _CatalogEntry, _catalog_cache_max, _catalog_fingerprint
+
+    cg = getattr(solver.cloud_provider, "catalog_generation", None)
+    pools, pool_catalogs = solver._build_pools()
+    live: Dict[bytes, tuple] = {}  # fp -> (catalog list, generation)
+    for pool, cat in zip(pools, pool_catalogs):
+        fp = _catalog_fingerprint(cat)
+        gen = cg(pool.nodepool) if callable(cg) else None
+        live.setdefault(fp, (cat, gen))
+
+    enc_heads: Dict[bytes, tuple] = {}
+    with _CATALOG_LOCK:
+        for snap in payload.get("catalogs", ()):
+            fp = snap["fingerprint"]
+            hit = live.get(fp)
+            if hit is None:
+                # fingerprint witness failed: the live world's catalog
+                # content differs — the entry (and every row on it) is
+                # dropped, never trusted
+                out.drop("catalog")
+                out.drop("compat", len(snap["sig_rows"]))
+                continue
+            cat, gen = hit
+            key = tuple(map(id, cat))  # analysis: allow-cache-determinism(id)
+            entry = _CATALOG_CACHE.get(key)
+            if entry is None or entry.fingerprint != fp:
+                enc = snap["enc"]
+                # rebind the encoding to the LIVE catalog objects: the
+                # fingerprint streams in catalog order, so equal digests
+                # mean position-wise identical content
+                enc.instance_types = list(cat)
+                enc.runtime_caches = dict(snap.get("runtime_caches") or {})
+                entry = _CatalogEntry(
+                    list(cat), fp, snap["vocab"], snap["axis"], enc, generation=gen
+                )
+                _CATALOG_CACHE[key] = entry
+                _CATALOG_CACHE.move_to_end(key)
+                while len(_CATALOG_CACHE) > _catalog_cache_max():
+                    _CATALOG_CACHE.popitem(last=False)
+            else:
+                entry.generation = gen
+            out.ok("catalog")
+            enc_heads[fp] = (id(entry), fp)
+            restored_rows = 0
+            cap = incremental.cache_cap("compat")
+            for pool_fp, sig, row in snap["sig_rows"]:
+                sid = podcache.intern_sig(sig)
+                if (pool_fp, sid) not in entry.sig_rows:
+                    entry.sig_rows[(pool_fp, sid)] = row
+                    entry.sig_rows.move_to_end((pool_fp, sid))
+                    while len(entry.sig_rows) > cap:
+                        entry.sig_rows.popitem(last=False)
+                restored_rows += 1
+            out.ok("compat", restored_rows)
+    return enc_heads
+
+
+def _restore_seeds(ws, plane: dict, live_witness: Optional[bytes], live_generation: Optional[int], out: _Outcome) -> None:
+    """Re-anchor the topology seed plane. The persisted generation
+    (``plane["generation"]``) is another process's counter value: the
+    plane is valid iff the recorded cluster-world witness matches the
+    LIVE world, and then it binds to the LIVE generation so the very
+    next informer event invalidates it exactly like home-grown seeds."""
+    entries = plane.get("entries") or []
+    if not entries:
+        return
+    witness = plane.get("witness")
+    if (
+        live_generation is None
+        or witness is None
+        or live_witness is None
+        or witness != live_witness
+    ):
+        out.drop("seeds", len(entries))
+        return
+    with ws.lock:
+        ws.seed_lru.clear()
+        ws.seed_generation = live_generation
+        for key, val in entries:
+            ws.seed_lru.put(key, dict(val))
+    out.ok("seeds", len(entries))
+
+
+def restore(solver, path: str, metrics=None, fleet_plane=None) -> dict:
+    """Restore a snapshot into ``solver``'s warm world. Every plane
+    re-anchors against the live world (catalog fingerprints, cluster
+    witness, re-interned signatures); whatever fails its witness is
+    dropped and counted. Returns the outcome dict (also mirrored to
+    ``solver.last_warmstore_stats`` and the warmstore metrics)."""
+    out = _Outcome(path)
+    try:
+        # own trace root (the snapshot() rationale): restore runs the
+        # live-world catalog fetch/fingerprint before the first tick's
+        # decision root exists
+        with tracer.trace_root("warmstore.restore", buffer_if="never"):
+            return _restore_under_root(solver, path, metrics, fleet_plane, out)
+    except Exception:  # noqa: BLE001 — a corrupt plane degrades to cold, never crashes the caller
+        log.exception("warmstore restore failed; remaining planes dropped")
+        out.reason = "restore error (see logs)"
+    return _publish(solver, out.to_dict(), metrics)
+
+
+def _restore_under_root(solver, path: str, metrics, fleet_plane, out: "_Outcome") -> dict:
+    try:
+        payload, reason = read_snapshot(path)
+        if payload is None:
+            result = out.drop_all(None, reason or "unreadable")
+            return _publish(solver, result, metrics)
+        ws = incremental.warm_state_for(solver)
+        if ws is None:
+            result = out.drop_all(payload, "incremental path disabled")
+            return _publish(solver, result, metrics)
+
+        snap_scope = tuple(payload.get("tenant", ()) or ())
+        enc_heads = _restore_catalogs(solver, payload, out)
+
+        for sigs, engine_tok, val in payload.get("routes", ()):
+            key = tuple(podcache.intern_sig(s) for s in sigs) + (engine_tok,)
+            ws.routes.put(key, val)
+            out.ok("route")
+
+        for stored, skel in payload.get("jobs", ()):
+            key = _rebind_job_key(stored, enc_heads, snap_scope)
+            if key is None:
+                out.drop("job")
+                continue
+            ws.jobs.put(key, skel)
+            if fleet_plane is not None:
+                # fleet content plane: same tenant-free content prefix
+                # contract as the live put in solver._pack_and_finalize
+                fleet_plane.skeleton_put(key[:-1], skel)
+            out.ok("job")
+
+        for (engine, cap, srkeys), clusters, applied in payload.get("merges", ()):
+            rkeys = [_rebind_rkey(rk, enc_heads, snap_scope) for rk in srkeys]
+            if any(rk is None for rk in rkeys):
+                out.drop("merge")
+                continue
+            rebuilt = []
+            bad = False
+            for cluster in clusters:
+                trail = [_rebind_rkey(rk, enc_heads, snap_scope) for rk in cluster[0]]
+                if any(t is None for t in trail):
+                    bad = True
+                    break
+                rebuilt.append((tuple(trail),) + tuple(cluster[1:]))
+            if bad:
+                out.drop("merge")
+                continue
+            ws.merges.put(
+                (engine, cap, tuple(rkeys)),
+                incremental.MergeSkeleton(rebuilt, applied),
+            )
+            out.ok("merge")
+
+        for strail, emitted in payload.get("emits", ()):
+            trail = [_rebind_rkey(rk, enc_heads, snap_scope) for rk in strail]
+            if any(t is None for t in trail):
+                out.drop("emit")
+                continue
+            ws.emits.put(tuple(trail), emitted)
+            out.ok("emit")
+
+        for stored, row in payload.get("screen_rows", ()):
+            rkey = _rebind_rkey(stored, enc_heads, snap_scope)
+            if rkey is None:
+                out.drop("mergerow")
+                continue
+            ws.screen_rows.put(rkey, row)
+            out.ok("mergerow")
+
+        cluster = solver.cluster
+        live_gen = (
+            cluster.generation()
+            if cluster is not None and hasattr(cluster, "generation")
+            else None
+        )
+        _restore_seeds(
+            ws,
+            payload.get("seeds") or {},
+            cluster_witness(solver.kube_client),
+            live_gen,
+            out,
+        )
+
+        inter = ws.intersects_cache()
+        n_inter = 0
+        for key, verdict in payload.get("intersects", ()):
+            if key not in inter:
+                inter[key] = verdict
+                n_inter += 1
+        out.ok("intersects", n_inter)
+    except Exception:  # noqa: BLE001 — a corrupt plane degrades to cold, never crashes the caller
+        log.exception("warmstore restore failed; remaining planes dropped")
+        out.reason = "restore error (see logs)"
+    return _publish(solver, out.to_dict(), metrics)
+
+
+def _publish(solver, result: dict, metrics) -> dict:
+    _set_last("restore", result)
+    try:
+        solver.last_warmstore_stats = dict(result)
+    except Exception:  # noqa: BLE001 — read-only solver doubles must not fail the restore
+        log.debug("could not stamp last_warmstore_stats", exc_info=True)
+    if metrics is not None and hasattr(metrics, "warmstore_restored"):
+        for plane, n in result.get("restored", {}).items():
+            metrics.warmstore_restored.inc(n, plane=plane)
+        for plane, n in result.get("dropped", {}).items():
+            metrics.warmstore_dropped.inc(n, plane=plane)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# fleet content planes (fleet/megasolve.py): the canonical-catalog plane
+# persists by content fingerprint; the fleetenv envelope memo does NOT
+# (its keys are per-provider generation counters that die with the
+# process — admission prewarm recomputes them against live counters)
+
+
+def snapshot_fleet_plane(plane, directory: Optional[str] = None) -> Optional[str]:
+    """Snapshot a CatalogPlane's canonical catalogs → path or None."""
+    directory = directory or warmstore_dir()
+    if directory is None:
+        return None
+    try:
+        payload = {
+            "schema": SCHEMA,
+            "contract": CONTRACT,
+            "tenant": (),
+            "fleet_canon": plane.export_canon(),
+        }
+        return write_snapshot(payload, directory)
+    except Exception:  # noqa: BLE001 — persistence never fails the fleet control plane
+        log.exception("fleet-plane snapshot failed")
+        return None
+
+
+def restore_fleet_plane(plane, path: str) -> dict:
+    """Restore canonical catalogs into a CatalogPlane (content-addressed
+    — fingerprints are their own witness; plane generations re-mint)."""
+    payload, reason = read_snapshot(path)
+    if payload is None:
+        return {"path": path, "restored": {}, "dropped": {"fleetcanon": 1}, "reason": reason}
+    n = plane.import_canon(payload.get("fleet_canon", ()))
+    return {"path": path, "restored": {"fleetcanon": n}, "dropped": {}}
+
+
+# ---------------------------------------------------------------------------
+# restart simulation (tests, profiling): drop every in-memory plane
+# exactly as a process exit would — the on-disk snapshot is all that
+# survives
+
+
+def simulate_process_death() -> None:
+    """Wipe every cross-solve in-memory plane: the catalog cache, every
+    WarmState, and the podcache intern maps INCLUDING their counters (a
+    fresh interpreter restarts ids at zero). Callers must also discard
+    pod objects carrying ``_karp_memo`` from the old world — a real
+    restart re-reads pods from the apiserver, memo-free."""
+    from .solver import _CATALOG_CACHE, _CATALOG_LOCK
+
+    with _CATALOG_LOCK:
+        _CATALOG_CACHE.clear()
+    incremental.reset()
+    podcache.reset_process()
+    with _LAST_LOCK:
+        _LAST["snapshot"] = None
+        _LAST["restore"] = None

@@ -115,6 +115,19 @@ def _parse_args():
                          "BENCH_BACKEND=cpu off-TPU)")
     ap.add_argument("--constraint-pods", type=int, default=10_000,
                     help="pod count (with --constraints)")
+    ap.add_argument("--restart", action="store_true",
+                    help="warm-state persistence mode (ISSUE 13): build a "
+                         "config-7-shaped warm world, snapshot it, simulate "
+                         "a process death, then profile the snapshot -> "
+                         "restore -> first-solve path (BENCH_BACKEND=cpu "
+                         "off-TPU)")
+    ap.add_argument("--snapshot", metavar="PATH", default=None,
+                    help="with --restart: restore this existing snapshot "
+                         "instead of taking a fresh one")
+    ap.add_argument("--restart-pods", type=int, default=5_000,
+                    help="pod count (with --restart)")
+    ap.add_argument("--restart-types", type=int, default=500,
+                    help="catalog size (with --restart)")
     return ap.parse_args()
 
 
@@ -153,6 +166,9 @@ def main():
         return
     if args.constraints:
         _constraints_mode(args)
+        return
+    if args.restart:
+        _restart_mode(args)
         return
 
     from karpenter_core_tpu.apis import labels as wk
@@ -241,6 +257,98 @@ def main():
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)
+    print(s.getvalue())
+
+
+def _restart_mode(args):
+    """--restart [--snapshot PATH]: profile the snapshot → restore →
+    first-solve path (ISSUE 13). Builds a config-7-shaped workload,
+    warms a solver, snapshots, wipes every in-memory plane exactly as a
+    process exit would (warmstore.simulate_process_death), then profiles
+    restore + the first post-restart solve against fresh pod/catalog
+    objects — what a restarted provisioner actually executes."""
+    import tempfile
+    import time as _time
+
+    from karpenter_core_tpu.apis.nodepool import NodePool
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+    from karpenter_core_tpu.kube.objects import NodeSelectorRequirement
+    from karpenter_core_tpu.solver import TPUScheduler, warmstore
+
+    teams = 40
+    rng = np.random.RandomState(23)
+    specs = [
+        (
+            ["100m", "250m", "500m", "1", "2", "4"][rng.randint(6)],
+            ["128Mi", "512Mi", "1Gi", "2Gi", "4Gi"][rng.randint(5)],
+            "1" if rng.rand() < 0.1 else None,
+            int(i % teams),
+        )
+        for i in range(args.restart_pods)
+    ]
+    cat_specs = [
+        (f"cap-{i}", {"cpu": str((i % 64) + 1), "memory": f"{2 * ((i % 64) + 1)}Gi", "pods": "110"})
+        for i in range(args.restart_types)
+    ] + [
+        (f"cap-gpu-{g}", {"cpu": str(8 * (g + 1)), "memory": f"{16 * (g + 1)}Gi",
+                          "pods": "110", "nvidia.com/gpu": str(min(8, g + 1))})
+        for g in range(20)
+    ]
+
+    def build_world():
+        provider = FakeCloudProvider()
+        provider.instance_types = [new_instance_type(n, r) for n, r in cat_specs]
+        provider.bump_catalog_generation()
+        np_ = NodePool()
+        np_.metadata.name = "default"
+        np_.spec.template.requirements = [
+            NodeSelectorRequirement("bench-team", "In", [f"t{t}" for t in range(teams)])
+        ]
+        pods = [
+            bench._mk_pod(i, cpu, mem, gpu=gpu,
+                          selector={"bench-team": f"t{t}"}, labels={"bench-team": f"t{t}"})
+            for i, (cpu, mem, gpu, t) in enumerate(specs)
+        ]
+        return provider, np_, pods
+
+    path = args.snapshot
+    if path is None:
+        provider, np_, pods = build_world()
+        warm = TPUScheduler([np_], provider)
+        for _ in range(2):
+            warm.solve(pods)
+        t0 = _time.perf_counter()
+        path = warm.snapshot(directory=tempfile.mkdtemp(prefix="profile-warmstore-"))
+        print(f"snapshot: {path} ({(_time.perf_counter()-t0)*1000:.1f} ms)", file=sys.stderr)
+    warmstore.simulate_process_death()
+    # fresh objects: a restarted process re-reads pods/catalog from the
+    # apiserver/provider — nothing may carry the dead process's memos
+    provider, np_, pods = build_world()
+    solver = TPUScheduler([np_], provider)
+    pr = cProfile.Profile()
+    pr.enable()
+    t0 = _time.perf_counter()
+    outcome = solver.restore(path)
+    restore_ms = (_time.perf_counter() - t0) * 1000.0
+    t0 = _time.perf_counter()
+    res = solver.solve(pods)
+    first_ms = (_time.perf_counter() - t0) * 1000.0
+    pr.disable()
+    print(
+        f"restore: {restore_ms:.1f} ms  restored={outcome.get('restored')} "
+        f"dropped={outcome.get('dropped')}",
+        file=sys.stderr,
+    )
+    print(
+        f"first solve after restore: {first_ms:.1f} ms "
+        f"(host {solver.last_timings['host_ms']:.1f} ms, "
+        f"{res.pods_scheduled} pods, {res.node_count} nodes) "
+        f"cache={solver.last_cache_stats}",
+        file=sys.stderr,
+    )
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(40)
     print(s.getvalue())
 
 

@@ -35,7 +35,7 @@ from karpenter_core_tpu.analysis.findings import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-CACHESOUND = ["cache-key", "cache-invalidation", "cache-determinism"]
+CACHESOUND = ["cache-key", "cache-invalidation", "cache-determinism", "cache-persist"]
 
 
 def run_snippet(tmp_path, code, rules=CACHESOUND, name="snippet.py"):
@@ -348,6 +348,56 @@ def test_determinism_scoped_id_marker(tmp_path):
 # scoped marker mechanics (findings.py)
 
 
+# ---------------------------------------------------------------------------
+# cache-persist fixtures (ISSUE 13: persisted-key re-anchoring)
+
+
+def test_cache_persist_trusts_persisted_generation(tmp_path):
+    bad = """
+        def _restore_seeds(ws, plane, live_generation):
+            ws.seed_generation = int(plane["generation"])
+    """
+    report = run_snippet(tmp_path, bad, rules=["cache-persist"])
+    assert [f for f in report.findings if "PERSISTED generation" in f.message]
+    good = bad.replace('int(plane["generation"])', "live_generation")
+    assert run_snippet(tmp_path, good, rules=["cache-persist"]).findings == []
+
+
+def test_cache_persist_dropped_tenant_scope(tmp_path):
+    bad = (
+        "def _rebind_job_key(stored, heads, tenant_scope):\n"
+        "    head = heads.get(stored[0])\n"
+        "    if head is None:\n"
+        "        return None\n"
+        "    return (head,) + stored[1:]\n"
+    )
+    report = run_snippet(tmp_path, bad, rules=["cache-persist"])
+    assert [f for f in report.findings if "tenant scope" in f.message]
+    good = bad.replace(
+        "return (head,) + stored[1:]", "return (head,) + stored[1:] + (tenant_scope,)"
+    )
+    assert run_snippet(tmp_path, good, rules=["cache-persist"]).findings == []
+
+
+def test_cache_persist_unverified_contract(tmp_path):
+    bad = (
+        "SCHEMA = 1\n"
+        'CONTRACT = "abc"\n'
+        "\n"
+        "def read_snapshot(header):\n"
+        "    if header.get(\"schema\") != SCHEMA:\n"
+        "        return None\n"
+        "    return header\n"
+    )
+    report = run_snippet(tmp_path, bad, rules=["cache-persist"])
+    assert [f for f in report.findings if "CONTRACT" in f.message]
+    good = bad.replace(
+        'if header.get("schema") != SCHEMA:',
+        'if header.get("schema") != SCHEMA or header.get("contract") != CONTRACT:',
+    )
+    assert run_snippet(tmp_path, good, rules=["cache-persist"]).findings == []
+
+
 def test_scoped_marker_not_blanket_suppression():
     lines = ["x = f()  # analysis: allow-cache-key(b, meta.alloc) — why"]
     assert "cache-key" not in allowed_rules_for_line(lines, 1)
@@ -434,6 +484,7 @@ _MUT_FILES = [
     "karpenter_core_tpu/fleet/megasolve.py",
     "karpenter_core_tpu/solver/sharding.py",
     "karpenter_core_tpu/solver/constraint_tensors.py",
+    "karpenter_core_tpu/solver/warmstore.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -562,6 +613,19 @@ _MUTANTS = [
     ("seed-key-drop-tenantscope", "karpenter_core_tpu/solver/solver.py",
      "skey = key + (\n                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope\n                )",
      "skey = key + (self._seed_exclusion_key(), self._sim_drained)", "cache-key"),
+    # ISSUE 13: persisted keys (solver/warmstore.py). A restored entry
+    # must witness the same read-set as a freshly computed one — the
+    # seed plane must re-anchor to the LIVE cluster generation (the
+    # persisted counter is another process's ordinal), and the job-key
+    # rebind must preserve the snapshot's tenant scope (dropping it
+    # would let a scope-free lookup alias another tenant's restored
+    # entries).
+    ("restore-drop-generation-reanchor", "karpenter_core_tpu/solver/warmstore.py",
+     "ws.seed_generation = live_generation",
+     'ws.seed_generation = int(plane["generation"] or 0)', "cache-persist"),
+    ("restore-drop-tenant-scope", "karpenter_core_tpu/solver/warmstore.py",
+     "return (head,) + stored[1:] + (tenant_scope,)",
+     "return (head,) + stored[1:]", "cache-persist"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -580,6 +644,9 @@ _MANDATORY = {
     # ISSUE 12 acceptance: the job memo must witness its mask inputs
     # (zone_ok carries the anti-affinity exclusion narrowing)
     "job-key-drop-zonemask",
+    # ISSUE 13 acceptance: persisted keys re-anchor, never trust the
+    # dead process's generation counters or drop the tenant scope
+    "restore-drop-generation-reanchor", "restore-drop-tenant-scope",
 }
 
 

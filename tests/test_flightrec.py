@@ -246,7 +246,7 @@ class TestDebugRoutes:
             # the stable top-level schema, always present
             assert set(doc) == {
                 "schema", "trace_id", "timings", "cache", "merge",
-                "pack_backend", "shard", "route", "disruption",
+                "pack_backend", "shard", "route", "disruption", "warmstore",
             }
             # ISSUE 12: the route block carries the per-solve pod split
             assert doc["route"]["tensor"] == 8

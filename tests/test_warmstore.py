@@ -1,0 +1,702 @@
+"""Warm-state persistence (solver/warmstore.py, ISSUE 13).
+
+The load-bearing invariant extends PR 4's: a RESTORED solve is
+plan-identical to an unkilled warm solve (and therefore to a cold
+solve) of the same inputs — a snapshot restores memoization, never
+approximation. The round-trip tests kill the process (every in-memory
+plane wiped, intern counters reset), restore from disk into fresh
+worlds, and compare plans byte-for-byte; the invalidation matrix
+mutates catalog/pool/pod/cluster state between snapshot and restore and
+asserts the affected planes are DROPPED (witness mismatch — never
+trusted) while the rest restore; corrupt/truncated/version-skewed
+snapshots degrade to a cold solve with the drop counted, never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from helpers import make_node, make_nodepool, make_pod, spread
+from karpenter_core_tpu.apis import labels as wk
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+from karpenter_core_tpu.kube.objects import NodeSelectorRequirement
+from karpenter_core_tpu.metrics import Metrics
+from karpenter_core_tpu.solver import TPUScheduler, incremental, warmstore
+
+TEAMS = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    warmstore.simulate_process_death()
+    yield
+    warmstore.simulate_process_death()
+
+
+def _catalog(n=48, bump=0):
+    return [
+        new_instance_type(
+            f"ct-{i}",
+            {"cpu": str((i % 16) + 1 + bump), "memory": f"{2 * ((i % 16) + 1)}Gi", "pods": "110"},
+        )
+        for i in range(n)
+    ]
+
+
+def _specs(seed, n=160):
+    rng = np.random.RandomState(seed)
+    cpus = ["100m", "250m", "500m", "1", "2"]
+    mems = ["128Mi", "512Mi", "1Gi", "2Gi"]
+    return [
+        (cpus[rng.randint(len(cpus))], mems[rng.randint(len(mems))], int(i % TEAMS))
+        for i in range(n)
+    ]
+
+
+def _world(specs, catalog_bump=0, pool_weight=None):
+    """Fresh provider/nodepool/pods of the given content — every call
+    builds new objects (a restarted process shares no object identity
+    with the killed one)."""
+    provider = FakeCloudProvider()
+    provider.instance_types = _catalog(bump=catalog_bump)
+    provider.bump_catalog_generation()
+    nodepool = make_nodepool(
+        requirements=[
+            NodeSelectorRequirement("team", "In", [f"t{t}" for t in range(TEAMS)])
+        ]
+    )
+    if pool_weight is not None:
+        nodepool.spec.weight = pool_weight
+    pods = [
+        make_pod(
+            name=f"p-{i}",
+            requests={"cpu": cpu, "memory": mem},
+            node_selector={"team": f"t{t}"},
+            labels={"team": f"t{t}"},
+        )
+        for i, (cpu, mem, t) in enumerate(specs)
+    ]
+    return provider, nodepool, pods
+
+
+def _canon(res):
+    return (
+        sorted(
+            (
+                p.nodepool_name,
+                p.instance_type.name,
+                p.zone,
+                p.capacity_type,
+                round(p.price, 9),
+                tuple(sorted(p.pod_indices)),
+            )
+            for p in res.node_plans
+        ),
+        sorted(res.pod_errors.values()),
+    )
+
+
+def _snapshot_world(specs, tmp_path, solves=2, **kw):
+    """Warm a solver, snapshot it, return (path, unkilled canon)."""
+    provider, nodepool, pods = _world(specs, **kw)
+    solver = TPUScheduler([nodepool], provider)
+    for _ in range(solves):
+        res = solver.solve(pods)
+    path = solver.snapshot(directory=str(tmp_path))
+    assert path is not None and os.path.exists(path)
+    return path, _canon(res)
+
+
+class TestSnapshotRoundTrip:
+    @pytest.mark.parametrize("seed", [3, 5, 9])
+    def test_restored_plans_byte_identical_to_unkilled(self, seed, tmp_path):
+        specs = _specs(seed)
+        path, ref = _snapshot_world(specs, tmp_path)
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        solver = TPUScheduler([nodepool], provider)
+        outcome = solver.restore(path)
+        assert outcome["restored"].get("catalog") == 1
+        assert not outcome["dropped"]
+        res = solver.solve(pods)
+        assert _canon(res) == ref
+        # the restored solve is a WARM solve: catalog, compat rows, and
+        # job skeletons all served from the restored planes
+        hits = (solver.last_cache_stats or {}).get("hits", {})
+        assert hits.get("catalog", 0) >= 1
+        assert hits.get("compat", 0) >= 1
+        assert hits.get("job", 0) >= 1
+
+    def test_restore_is_faster_than_cold(self, tmp_path):
+        """Not a perf gate (bench config 14 owns that) — asserts the
+        mechanism: the restored first solve skips the encode work a cold
+        restart pays (zero compat/catalog misses)."""
+        specs = _specs(11, n=200)
+        path, _ = _snapshot_world(specs, tmp_path)
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        solver = TPUScheduler([nodepool], provider)
+        solver.restore(path)
+        solver.solve(pods)
+        misses = (solver.last_cache_stats or {}).get("misses", {})
+        assert misses.get("catalog", 0) == 0
+        assert misses.get("compat", 0) == 0
+        assert misses.get("job", 0) == 0
+
+    def test_outcome_surfaced_in_stats_schema(self, tmp_path):
+        from karpenter_core_tpu.solver import stats as solver_stats
+
+        specs = _specs(2, n=60)
+        path, _ = _snapshot_world(specs, tmp_path)
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        metrics = Metrics()
+        solver = TPUScheduler([nodepool], provider, metrics=metrics)
+        solver.restore(path)
+        solver.solve(pods)
+        doc = solver_stats.solve_stats(solver)
+        assert doc["schema"] == solver_stats.SCHEMA
+        assert doc["warmstore"]["restored"]["catalog"] == 1
+        fields = solver_stats.bench_fields(doc)
+        assert fields["warmstore"]["restored"]["catalog"] == 1
+        # restores are never silent: the counter pair carries the planes
+        assert metrics.warmstore_restored.get(plane="catalog") == 1
+        assert metrics.warmstore_restored.get(plane="job") >= 1
+
+    def test_snapshot_file_is_versioned_and_self_describing(self, tmp_path):
+        specs = _specs(4, n=40)
+        path, _ = _snapshot_world(specs, tmp_path)
+        with open(path, "rb") as f:
+            magic = f.readline()
+            header = json.loads(f.readline())
+        assert magic == b"KTPU-WARMSTORE\n"
+        assert header["schema"] == warmstore.SCHEMA
+        assert header["contract"] == warmstore.CONTRACT
+        assert header["planes"]["catalog"] == 1
+        assert "payload_sha256" in header
+
+
+class TestInvalidationMatrix:
+    """Mutations between snapshot and restore: the witness-failed planes
+    drop (never trusted), the rest restore, and the restored solve stays
+    byte-identical to a cold solve of the MUTATED world."""
+
+    def _restore_and_check(self, path, specs, expect_catalog, **world_kw):
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs, **world_kw)
+        solver = TPUScheduler([nodepool], provider)
+        outcome = solver.restore(path)
+        res = solver.solve(pods)
+        os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+        try:
+            cold_provider, cold_pool, cold_pods = _world(specs, **world_kw)
+            ref = TPUScheduler([cold_pool], cold_provider).solve(cold_pods)
+        finally:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        assert _canon(res) == _canon(ref)
+        if expect_catalog:
+            assert outcome["restored"].get("catalog", 0) == 1
+        else:
+            # fingerprint witness failed: the whole entry and every
+            # plane keyed through it dropped
+            assert outcome["dropped"].get("catalog", 0) == 1
+            assert outcome["restored"].get("job", 0) == 0
+        return solver, outcome
+
+    # 1
+    def test_catalog_price_mutation_drops_catalog_planes(self, tmp_path):
+        specs = _specs(21, n=80)
+        path, _ = _snapshot_world(specs, tmp_path)
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        for it in provider.instance_types[::7]:
+            for o in it.offerings:
+                o.price *= 1.01
+        provider.bump_catalog_generation()
+        solver = TPUScheduler([nodepool], provider)
+        outcome = solver.restore(path)
+        assert outcome["dropped"].get("catalog", 0) == 1
+        assert outcome["restored"].get("job", 0) == 0
+        assert outcome["restored"].get("route", 0) >= 1  # sig-keyed planes survive
+        res = solver.solve(pods)
+        assert res.node_plans  # degraded to a (correct) cold solve
+
+    # 2
+    def test_catalog_capacity_mutation_drops_catalog_planes(self, tmp_path):
+        specs = _specs(22, n=80)
+        path, _ = _snapshot_world(specs, tmp_path)
+        self._restore_and_check(path, specs, expect_catalog=False, catalog_bump=1)
+
+    # 3
+    def test_catalog_unchanged_restores_everything(self, tmp_path):
+        specs = _specs(23, n=80)
+        path, _ = _snapshot_world(specs, tmp_path)
+        solver, outcome = self._restore_and_check(path, specs, expect_catalog=True)
+        assert not outcome["dropped"]
+        hits = (solver.last_cache_stats or {}).get("hits", {})
+        assert hits.get("job", 0) >= 1
+
+    # 4
+    def test_pool_requirement_mutation_is_never_served_stale(self, tmp_path):
+        """A changed pool template changes the pool fingerprint: the
+        restored rows/jobs keyed under the OLD fingerprint are inert
+        (content-addressed keys can't be looked up by the new pool), so
+        the solve recomputes — and matches cold."""
+        specs = _specs(24, n=80)
+        path, _ = _snapshot_world(specs, tmp_path)
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        nodepool.spec.template.requirements.append(
+            NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", ["on-demand", "spot"])
+        )
+        solver = TPUScheduler([nodepool], provider)
+        solver.restore(path)
+        solver.solve(pods)
+        hits = (solver.last_cache_stats or {}).get("hits", {})
+        assert hits.get("job", 0) == 0  # old-pool jobs never alias the new pool
+        os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+        try:
+            p2, np2, pods2 = _world(specs)
+            np2.spec.template.requirements.append(
+                NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", ["on-demand", "spot"])
+            )
+            ref = TPUScheduler([np2], p2).solve(pods2)
+        finally:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        assert _canon(solver.solve(pods)) == _canon(ref)
+
+    # 5
+    def test_pod_requests_changed_jobs_miss_plans_match_cold(self, tmp_path):
+        specs = _specs(25, n=80)
+        path, _ = _snapshot_world(specs, tmp_path)
+        changed = [("2", "4Gi", t) for (_c, _m, t) in _specs(25, n=80)]
+        solver, _ = self._restore_and_check2(path, changed)
+        hits = (solver.last_cache_stats or {}).get("hits", {})
+        assert hits.get("catalog", 0) >= 1  # content planes still serve
+        assert hits.get("job", 0) == 0  # different request matrices
+
+    def _restore_and_check2(self, path, specs):
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        solver = TPUScheduler([nodepool], provider)
+        outcome = solver.restore(path)
+        res = solver.solve(pods)
+        os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+        try:
+            p2, np2, pods2 = _world(specs)
+            ref = TPUScheduler([np2], p2).solve(pods2)
+        finally:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        assert _canon(res) == _canon(ref)
+        return solver, outcome
+
+    # 6
+    def test_pod_subset_changed_plans_match_cold(self, tmp_path):
+        specs = _specs(26, n=80)
+        path, _ = _snapshot_world(specs, tmp_path)
+        self._restore_and_check2(path, specs[:50] + _specs(99, n=20))
+
+    # -- the cluster/seeds leg --------------------------------------------
+
+    def _seeded_world(self, specs):
+        """Kube-backed world: one labeled node + bound pods so zone
+        spread constraints have non-trivial seed counts."""
+        from karpenter_core_tpu.kube.client import KubeClient
+        from karpenter_core_tpu.state.cluster import Cluster
+        from karpenter_core_tpu.state.informers import Informers
+
+        provider, nodepool, pods = _world(specs)
+        for p in pods:
+            if p.metadata.labels.get("team") == "t1":
+                p.spec.topology_spread_constraints = [
+                    spread(wk.LABEL_TOPOLOGY_ZONE, labels={"team": "t1"})
+                ].copy()
+                p.__dict__.pop("_karp_memo", None)
+        kube = KubeClient()
+        cluster = Cluster(kube, provider)
+        Informers(kube, cluster).start()
+        node = make_node(
+            name="seed-node-0",
+            labels={
+                wk.NODEPOOL_LABEL_KEY: nodepool.name,
+                "team": "t1",
+                wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        kube.create(node)
+        bound = make_pod(
+            name="bound-t1",
+            requests={"cpu": "100m", "memory": "128Mi"},
+            labels={"team": "t1"},
+        )
+        bound.spec.node_name = node.metadata.name
+        kube.create(bound)
+        return provider, nodepool, pods, kube, cluster
+
+    # 7
+    def test_cluster_unchanged_seeds_reanchor_to_live_generation(self, tmp_path):
+        specs = _specs(27, n=60)
+        provider, nodepool, pods, kube, cluster = self._seeded_world(specs)
+        solver = TPUScheduler([nodepool], provider, kube_client=kube, cluster=cluster)
+        solver.solve(pods)
+        solver.solve(pods)
+        ws = incremental.warm_state_for(solver)
+        assert len(ws.seed_lru) >= 1
+        path = solver.snapshot(directory=str(tmp_path))
+        warmstore.simulate_process_death()
+        # identical kube CONTENT in a fresh world (rvs/generations differ)
+        p2, np2, pods2, kube2, cluster2 = self._seeded_world(specs)
+        solver2 = TPUScheduler([np2], p2, kube_client=kube2, cluster=cluster2)
+        outcome = solver2.restore(path)
+        assert outcome["restored"].get("seeds", 0) >= 1
+        ws2 = incremental.warm_state_for(solver2)
+        # re-anchored to the LIVE counter, not the dead process's
+        assert ws2.seed_generation == cluster2.generation()
+        res = solver2.solve(pods2)
+        hits = (solver2.last_cache_stats or {}).get("hits", {})
+        assert hits.get("seeds", 0) >= 1
+        os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+        try:
+            p3, np3, pods3, kube3, cluster3 = self._seeded_world(specs)
+            ref = TPUScheduler([np3], p3, kube_client=kube3, cluster=cluster3).solve(pods3)
+        finally:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        assert _canon(res) == _canon(ref)
+
+    # 8
+    def test_cluster_mutated_seeds_dropped(self, tmp_path):
+        specs = _specs(28, n=60)
+        provider, nodepool, pods, kube, cluster = self._seeded_world(specs)
+        solver = TPUScheduler([nodepool], provider, kube_client=kube, cluster=cluster)
+        solver.solve(pods)
+        solver.solve(pods)
+        path = solver.snapshot(directory=str(tmp_path))
+        warmstore.simulate_process_death()
+        p2, np2, pods2, kube2, cluster2 = self._seeded_world(specs)
+        extra = make_pod(
+            name="bound-t1-extra",
+            requests={"cpu": "100m", "memory": "128Mi"},
+            labels={"team": "t1"},
+        )
+        extra.spec.node_name = "seed-node-0"
+        kube2.create(extra)  # the seed counts' world changed
+        solver2 = TPUScheduler([np2], p2, kube_client=kube2, cluster=cluster2)
+        outcome = solver2.restore(path)
+        assert outcome["restored"].get("seeds", 0) == 0
+        assert outcome["dropped"].get("seeds", 0) >= 1
+        # and the recomputed solve matches cold on the mutated world
+        res = solver2.solve(pods2)
+        os.environ["KARPENTER_TPU_INCREMENTAL"] = "0"
+        try:
+            p3, np3, pods3, kube3, cluster3 = self._seeded_world(specs)
+            extra3 = make_pod(
+                name="bound-t1-extra",
+                requests={"cpu": "100m", "memory": "128Mi"},
+                labels={"team": "t1"},
+            )
+            extra3.spec.node_name = "seed-node-0"
+            kube3.create(extra3)
+            ref = TPUScheduler([np3], p3, kube_client=kube3, cluster=cluster3).solve(pods3)
+        finally:
+            os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
+        assert _canon(res) == _canon(ref)
+
+    # 9
+    def test_no_cluster_at_restore_drops_seeds(self, tmp_path):
+        specs = _specs(29, n=60)
+        provider, nodepool, pods, kube, cluster = self._seeded_world(specs)
+        solver = TPUScheduler([nodepool], provider, kube_client=kube, cluster=cluster)
+        solver.solve(pods)
+        solver.solve(pods)
+        path = solver.snapshot(directory=str(tmp_path))
+        warmstore.simulate_process_death()
+        p2, np2, pods2 = _world(specs)
+        solver2 = TPUScheduler([np2], p2)  # no kube, no cluster
+        outcome = solver2.restore(path)
+        assert outcome["restored"].get("seeds", 0) == 0
+        assert outcome["dropped"].get("seeds", 0) >= 1
+
+
+class TestCorruptSnapshots:
+    """Degrade to cold, never crash — and never silently."""
+
+    def _snapshot(self, tmp_path, seed=31):
+        specs = _specs(seed, n=60)
+        path, _ = _snapshot_world(specs, tmp_path)
+        return specs, path
+
+    def _restore_fresh(self, specs, path):
+        warmstore.simulate_process_death()
+        provider, nodepool, pods = _world(specs)
+        metrics = Metrics()
+        solver = TPUScheduler([nodepool], provider, metrics=metrics)
+        outcome = solver.restore(path)
+        res = solver.solve(pods)  # cold solve still works
+        assert res.node_plans
+        return outcome, metrics
+
+    def test_truncated_snapshot_dropped_whole(self, tmp_path):
+        specs, path = self._snapshot(tmp_path)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        outcome, metrics = self._restore_fresh(specs, path)
+        assert not outcome["restored"]
+        assert "digest mismatch" in outcome["reason"]
+        assert metrics.warmstore_dropped.get(plane="snapshot") == 1
+
+    def test_garbage_file_dropped_whole(self, tmp_path):
+        specs, path = self._snapshot(tmp_path)
+        with open(path, "wb") as f:
+            f.write(b"not a snapshot at all\x00\x01")
+        outcome, _ = self._restore_fresh(specs, path)
+        assert not outcome["restored"]
+        assert outcome["reason"] == "bad magic"
+
+    def test_missing_file_dropped_whole(self, tmp_path):
+        specs, path = self._snapshot(tmp_path)
+        outcome, _ = self._restore_fresh(specs, str(tmp_path / "nope.snap"))
+        assert not outcome["restored"]
+        assert "unreadable" in outcome["reason"]
+
+    def test_schema_mismatch_dropped_whole(self, tmp_path, monkeypatch):
+        specs, path = self._snapshot(tmp_path)
+        monkeypatch.setattr(warmstore, "SCHEMA", warmstore.SCHEMA + 1)
+        outcome, _ = self._restore_fresh(specs, path)
+        assert not outcome["restored"]
+        assert "schema mismatch" in outcome["reason"]
+
+    def test_contract_mismatch_dropped_whole(self, tmp_path, monkeypatch):
+        """A changed key-layout contract (the writer's stablehash) drops
+        the WHOLE snapshot — the reader must never re-anchor keys it
+        would misparse."""
+        specs, path = self._snapshot(tmp_path)
+        monkeypatch.setattr(warmstore, "CONTRACT", "0" * 32)
+        outcome, _ = self._restore_fresh(specs, path)
+        assert not outcome["restored"]
+        assert "contract" in outcome["reason"]
+
+    def test_size_cap_trims_planes_never_silently(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_WARMSTORE_MAX_MB", "0.02")
+        specs = _specs(33, n=80)
+        provider, nodepool, pods = _world(specs)
+        solver = TPUScheduler([nodepool], provider)
+        solver.solve(pods)
+        solver.solve(pods)
+        path = solver.snapshot(directory=str(tmp_path))
+        if path is None:
+            return  # nothing useful fit under the cap — also a non-silent outcome
+        with open(path, "rb") as f:
+            f.readline()
+            header = json.loads(f.readline())
+        assert header["trimmed"], "an under-cap snapshot must record its trims"
+
+
+class TestServingPipelineHooks:
+    def test_quiesce_returns_snapshot_path_and_restore_before_first_tick(self, tmp_path):
+        """The serving seam end to end: quiesce() returns the snapshot
+        path (no side channel), a fresh pipeline restores it BEFORE its
+        first tick, and the restored pipeline's first solve is warm."""
+        from karpenter_core_tpu.serving import trafficgen as tg
+        from karpenter_core_tpu.serving.pipeline import PipelineConfig, ServingPipeline
+
+        def drive(config, restore_path=None):
+            harness = tg.TrafficHarness(teams=4, n_types=48)
+            pipe = ServingPipeline(
+                harness.provisioner, metrics=harness.metrics, config=config,
+                on_decision=harness.bind,
+            )
+            if restore_path is not None:
+                outcome = pipe.restore_warm_state(restore_path)
+                assert outcome is not None
+            pipe.attach_watch()
+            pipe.hold()
+            pipe.start()
+            try:
+                step = tg.Step(
+                    creates=[
+                        tg.PodSpecLite(f"ws-{i}", "250m", "256Mi", None, i % 4)
+                        for i in range(8)
+                    ]
+                )
+                harness.inject_step(step, 0)
+                pipe.release()
+                out = pipe.quiesce(timeout=30.0)
+                assert out
+                pipe.hold()
+            finally:
+                pipe.stop()
+            harness.close()
+            return out, pipe
+
+        cfg = PipelineConfig(
+            idle_seconds=0.01, max_seconds=0.2, prewarm=False,
+            warmstore_dir=str(tmp_path), warmstore_restore=None,
+        )
+        path, _ = drive(cfg)
+        assert isinstance(path, str) and os.path.exists(path)
+
+        warmstore.simulate_process_death()
+        cfg2 = PipelineConfig(
+            idle_seconds=0.01, max_seconds=0.2, prewarm=False,
+            warmstore_dir=None, warmstore_restore=None,
+        )
+        _, pipe2 = drive(cfg2, restore_path=path)
+        state = pipe2.debug_state()
+        assert state["warmstore"]["restored"].get("catalog") == 1
+
+    def test_quiesce_without_warmstore_dir_returns_true(self):
+        from karpenter_core_tpu.serving import trafficgen as tg
+        from karpenter_core_tpu.serving.pipeline import PipelineConfig, ServingPipeline
+
+        harness = tg.TrafficHarness(teams=2, n_types=16)
+        pipe = ServingPipeline(
+            harness.provisioner, metrics=harness.metrics,
+            config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2, prewarm=False,
+                                  warmstore_dir=None, warmstore_restore=None),
+            on_decision=harness.bind,
+        )
+        pipe.attach_watch()
+        pipe.start()
+        try:
+            assert pipe.quiesce(timeout=10.0) is True
+        finally:
+            pipe.stop()
+        harness.close()
+
+
+class TestTenantMigration:
+    """ISSUE 13 acceptance: a tenant snapshot restored into a second
+    FleetScheduler produces byte-identical plans with job-memo hit
+    counters > 0 on the first round (no re-encode of unchanged
+    content)."""
+
+    def _tenant_pods(self, n=60, seed=13):
+        rng = np.random.RandomState(seed)
+        return [
+            make_pod(
+                name=f"mig-p{i}",
+                requests={
+                    "cpu": ["100m", "250m", "500m", "1", "2"][rng.randint(5)],
+                    "memory": ["128Mi", "512Mi", "1Gi", "2Gi"][rng.randint(4)],
+                },
+            )
+            for i in range(n)
+        ]
+
+    def _fleet_world(self, tmp_path):
+        from karpenter_core_tpu.apis.nodepool import NodePool
+        from karpenter_core_tpu.fleet import FleetEngine, FleetRegistry
+
+        registry = FleetRegistry(warmstore_dir=str(tmp_path))
+        engine = FleetEngine(registry)
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog()
+        provider.bump_catalog_generation()
+        np_ = NodePool()
+        np_.metadata.name = "default"
+        return registry, engine, provider, np_
+
+    def _plan_keys(self, outcome):
+        return sorted(
+            (
+                p.nodepool_name, p.instance_type.name, p.zone, p.capacity_type,
+                round(p.price, 9), tuple(p.pod_indices),
+            )
+            for p in outcome.result.node_plans
+        )
+
+    def test_migration_between_schedulers_first_round_warm(self, tmp_path):
+        registry1, engine1, provider1, np1 = self._fleet_world(tmp_path)
+        registry1.add_tenant("tenant-a", [np1], provider1)
+        pods = self._tenant_pods()
+        ref = engine1.solve_round({"tenant-a": pods})["tenant-a"]
+        assert ref.error is None
+        engine1.solve_round({"tenant-a": self._tenant_pods()})
+        path = registry1.snapshot_tenant("tenant-a")
+        assert path is not None
+
+        # the second scheduler: a different process's worth of state
+        warmstore.simulate_process_death()
+        registry2, engine2, provider2, np2 = self._fleet_world(tmp_path)
+        registry2.add_tenant("tenant-a", [np2], provider2, restore_from=path)
+        handle = registry2.get("tenant-a")
+        out = engine2.solve_round({"tenant-a": self._tenant_pods()})["tenant-a"]
+        assert out.error is None
+        assert self._plan_keys(out) == self._plan_keys(ref)
+        hits = (handle.solver.last_cache_stats or {}).get("hits", {})
+        assert hits.get("job", 0) > 0, hits
+        assert hits.get("catalog", 0) >= 1
+
+    def test_eviction_snapshots_and_readmission_restores(self, tmp_path):
+        registry, engine, provider, np_ = self._fleet_world(tmp_path)
+        registry.add_tenant("tenant-b", [np_], provider)
+        pods = self._tenant_pods(seed=17)
+        ref = engine.solve_round({"tenant-b": pods})["tenant-b"]
+        assert registry.remove_tenant("tenant-b")
+        assert "tenant-b" in registry.evicted_snapshots
+
+        # re-admission (migration back): fresh provider objects, same content
+        provider2 = FakeCloudProvider()
+        provider2.instance_types = _catalog()
+        provider2.bump_catalog_generation()
+        from karpenter_core_tpu.apis.nodepool import NodePool
+
+        np2 = NodePool()
+        np2.metadata.name = "default"
+        registry.add_tenant("tenant-b", [np2], provider2)
+        assert "tenant-b" not in registry.evicted_snapshots  # consumed
+        handle = registry.get("tenant-b")
+        out = engine.solve_round({"tenant-b": self._tenant_pods(seed=17)})["tenant-b"]
+        assert out.error is None
+        assert self._plan_keys(out) == self._plan_keys(ref)
+        hits = (handle.solver.last_cache_stats or {}).get("hits", {})
+        assert hits.get("job", 0) > 0
+
+    def test_fleet_canonical_plane_round_trips(self, tmp_path):
+        from karpenter_core_tpu.fleet.megasolve import CatalogPlane
+
+        plane = CatalogPlane()
+        plane.activate(True)
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog()
+        provider.bump_catalog_generation()
+        plane.resolve("t-0", provider, None)
+        path = warmstore.snapshot_fleet_plane(plane, str(tmp_path))
+        assert path is not None
+        plane2 = CatalogPlane()
+        outcome = warmstore.restore_fleet_plane(plane2, path)
+        assert outcome["restored"]["fleetcanon"] == 1
+        # content-addressed: the same tenant catalog resolves to the
+        # restored canonical snapshot without a fresh clone
+        plane2.activate(True)
+        cat, gen = plane2.resolve("t-1", provider, None)
+        assert gen[0] == "fleet"
+        assert [it.name for it in cat] == [it.name for it in provider.instance_types]
+
+
+class TestWarmstoreDisabled:
+    def test_snapshot_disabled_without_directory(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_WARMSTORE_DIR", raising=False)
+        specs = _specs(41, n=20)
+        provider, nodepool, pods = _world(specs)
+        solver = TPUScheduler([nodepool], provider)
+        solver.solve(pods)
+        assert solver.snapshot() is None
+
+    def test_incremental_kill_switch_drops_restore(self, tmp_path, monkeypatch):
+        specs = _specs(42, n=40)
+        path, _ = _snapshot_world(specs, tmp_path)
+        warmstore.simulate_process_death()
+        monkeypatch.setenv("KARPENTER_TPU_INCREMENTAL", "0")
+        provider, nodepool, pods = _world(specs)
+        solver = TPUScheduler([nodepool], provider)
+        outcome = solver.restore(path)
+        assert outcome["reason"] == "incremental path disabled"
+        res = solver.solve(pods)
+        assert res.node_plans
