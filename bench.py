@@ -2302,6 +2302,91 @@ def config14() -> dict:
     return out
 
 
+def config15() -> dict:
+    """Chaos plane (ISSUE 15): the five fault scenarios × {faulted,
+    clean} on a lockstep rollout stream, every run its own subprocess
+    (clean twin included — the faulted run must not inherit anything).
+    Each fault gets a seeded FaultSchedule window mid-run (watch_flap,
+    watch_hang, latency_spike, failover, clock_skew) and the run
+    reports the degradation evidence, gated by the ledger:
+
+      plan_identity       — the faulted plan stream's sha256 equals the
+                            clean twin's (divergence budget 0: every
+                            fault here is maskable by hold-and-recover);
+      stale_plans_emitted — plans observed WHILE a guard held; must be
+                            0 (degrade to hold + counter, never a stale
+                            plan);
+      single_writer_ok    — no NodeClaim write landed while deposed
+                            (the failover window's invariant);
+      held_ticks          — the bounded degradation actually engaged
+                            (holding faults must hold ≥1 tick);
+      p99 / slo_burn      — decision-latency and flight-recorder burn
+                            columns (relative lanes catch regressions).
+    """
+    scale = _scale(int(os.environ.get("BENCH_CHAOS_SCALE", "240")))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
+    faults = ("watch_flap", "watch_hang", "latency_spike", "failover", "clock_skew")
+    base = ["--scenario", "rollout", "--scale", str(scale), "--seed", str(seed)]
+    out: dict = {
+        "config": f"15: chaos plane, rollout @ scale {scale}, {len(faults)} faults x {{faulted,clean}}, seed {seed}",
+        "faults": {},
+    }
+    clean = _restart_measure(base + ["--chaos", "none"])
+    out["clean"] = {
+        k: clean.get(k)
+        for k in ("plan_sha256", "plans_emitted", "pods_decided", "pod_errors", "ticks")
+    }
+    out["clean"]["steady_p99_ms"] = clean.get("steady_decision_latency_ms", {}).get("p99")
+    identical = holds_engaged = 0
+    stale_total = 0
+    writer_ok_all = True
+    worst_p99 = out["clean"]["steady_p99_ms"] or 0.0
+    worst_burn = max(
+        (clean.get("slo_burn") or {}).values(), default=0.0
+    )
+    holding = {"watch_flap": "stale", "watch_hang": "stale", "failover": "leader"}
+    for fault in faults:
+        got = _restart_measure(base + ["--chaos", fault])
+        ident = bool(
+            clean.get("plan_sha256")
+            and got.get("plan_sha256") == clean.get("plan_sha256")
+        )
+        identical += ident
+        held = got.get("held_ticks") or {}
+        plane = holding.get(fault)
+        engaged = plane is None or held.get(plane, 0) >= 1
+        holds_engaged += engaged
+        stale_total += int(got.get("stale_plans_emitted") or 0)
+        writer_ok_all = writer_ok_all and bool(got.get("single_writer_ok", False))
+        p99 = (got.get("steady_decision_latency_ms") or {}).get("p99") or 0.0
+        burn = max((got.get("slo_burn") or {}).values(), default=0.0)
+        worst_p99 = max(worst_p99, p99)
+        worst_burn = max(worst_burn, burn)
+        entry = {
+            "plan_identical": ident,
+            "fault_steps": got.get("fault_steps"),
+            "held_ticks": held,
+            "hold_engaged": engaged,
+            "stale_plans_emitted": got.get("stale_plans_emitted"),
+            "single_writer_ok": got.get("single_writer_ok"),
+            "monotonic_decision_order": got.get("monotonic_decision_order"),
+            "pods_decided": got.get("pods_decided"),
+            "pod_errors": got.get("pod_errors"),
+            "steady_p99_ms": p99,
+            "slo_burn": got.get("slo_burn"),
+        }
+        if "error" in got:
+            entry["error"] = got["error"]
+        out["faults"][fault] = entry
+    out["plan_identity"] = round(identical / len(faults), 4)
+    out["holds_engaged"] = round(holds_engaged / len(faults), 4)
+    out["stale_plans_emitted"] = stale_total
+    out["single_writer_ok_all"] = 1.0 if writer_ok_all else 0.0
+    out["worst_steady_p99_ms"] = round(float(worst_p99), 2)
+    out["worst_slo_burn"] = round(float(worst_burn), 4)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # engine shootout: device vs native pack, pallas vs XLA compat
 # ---------------------------------------------------------------------------
@@ -2431,9 +2516,9 @@ def main() -> None:
 
     configs = []
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12, config13, config14):
+        for fn in (config1, config2, config3, config4, config5, config6, config7, config8, config9, config10, config11, config12, config13, config14, config15):
             try:
-                if fn in (config7, config8, config9, config11, config12, config14):  # measure the incremental/serving/disruption/fleet/shard/restart paths
+                if fn in (config7, config8, config9, config11, config12, config14, config15):  # measure the incremental/serving/disruption/fleet/shard/restart/chaos paths
                     configs.append(fn())
                 else:
                     with incremental_off():

@@ -101,6 +101,13 @@ RELATIVE_GATES: List[Tuple[str, str, str]] = [
     ("config14", "restore_ms", "down"),
     ("config14", "first_tick_warm_ms", "down"),
     ("config14", "ticks_to_warm", "down"),
+    # ISSUE 15: the chaos plane's latency lanes on their own
+    # trajectories — the clean twin's steady p99 (lockstep rollout, a
+    # reproducible solver-path shape) and the worst faulted p99 / SLO
+    # burn across the five fault scenarios
+    ("config15", "clean.steady_p99_ms", "down"),
+    ("config15", "worst_steady_p99_ms", "down"),
+    ("config15", "worst_slo_burn", "down"),
 ]
 ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     # (config, metric, "floor"|"ceiling", bound)
@@ -133,6 +140,15 @@ ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     ("config14", "plan_identity", "floor", 1.0),
     ("config14", "first_solve_speedup", "floor", 3.0),
     ("config14", "ticks_to_warm", "ceiling", 3.0),
+    # ISSUE 15: chaos-plane invariants — every faulted run's plan
+    # stream byte-identical to its clean twin (divergence budget 0),
+    # zero plans emitted while a degradation guard held, no NodeClaim
+    # write while deposed, and every holding fault actually engaged
+    # its guard (a gate that never holds is proving nothing)
+    ("config15", "plan_identity", "floor", 1.0),
+    ("config15", "stale_plans_emitted", "ceiling", 0.0),
+    ("config15", "single_writer_ok_all", "floor", 1.0),
+    ("config15", "holds_engaged", "floor", 1.0),
 ]
 
 

@@ -126,6 +126,20 @@ class AnalysisConfig:
         "karpenter_core_tpu/scheduler/scheduler.py",
         "karpenter_core_tpu/disruption/helpers.py",
     )
+    # control-loop packages held to clock discipline (ISSUE 15): any
+    # duration/timeout/expiry math must read time.monotonic(); wall
+    # clock is reserved for stamps that cross a process boundary
+    # (leases, deletionTimestamp, condition transitions) under a scoped
+    # `# analysis: allow-clock(reason)` marker
+    control_loop_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/disruption/",
+        "karpenter_core_tpu/operator/",
+        "karpenter_core_tpu/serving/",
+        "karpenter_core_tpu/lifecycle/",
+        "karpenter_core_tpu/provisioning/",
+        "karpenter_core_tpu/kube/",
+        "karpenter_core_tpu/state/",
+    )
 
 
 DEFAULT_CONFIG = AnalysisConfig()
@@ -276,6 +290,7 @@ def _load_rules() -> None:
     if not _LOADED:
         from . import (  # noqa: F401
             cachesound,
+            clock,
             hygiene,
             hostsync,
             locks,

@@ -38,6 +38,7 @@ class DisruptionContext:
     cloud_provider: object
     recorder: object
     queue: OrchestrationQueue
+    # analysis: allow-clock(condition-stamps — compared against persisted last_transition_time wall-clock stamps)
     clock: Callable[[], float] = time.time
     # test hook: replaces the 15 s validation wait (consolidation.go:42);
     # None skips waiting entirely
@@ -60,6 +61,7 @@ class DisruptionController:
         provisioner,
         cloud_provider,
         recorder=None,
+        # analysis: allow-clock(condition-stamps — fans to DisruptionContext, compared against persisted wall-clock stamps)
         clock: Callable[[], float] = time.time,
         queue: Optional[OrchestrationQueue] = None,
         validation_sleep: Optional[Callable[[float], None]] = None,

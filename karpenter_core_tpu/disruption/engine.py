@@ -498,6 +498,90 @@ class BatchedDisruptionEngine:
                 hi = mid - 1
         return last
 
+    # -- the condition cohorts (ISSUE 15: expiration / drift) -------------
+
+    def condition_command(self, method, candidates: List[Candidate]) -> Command:
+        """Batched dispatch for the condition cohorts: the sequential
+        ``ConditionMethod._simulate_in_order`` loop — same order, same
+        success criterion, same one-winner contract — with (a) the whole
+        cohort screened in one singleton-subset dispatch (in-place
+        feasibility is observability: a screen-feasible candidate's
+        pods fit surviving capacity, so its drain needs no replacement),
+        and (b) known-blocked drains memoized negatively, so a cohort
+        that failed to simulate at this generation re-decides without
+        re-simulating on the next pass. Blocked candidates re-announce
+        via the recorder only on the pass that actually simulates —
+        events are telemetry, not plan state, so plan identity to the
+        sequential oracle holds probe-for-probe."""
+        from . import tpu_repack
+        from .helpers import CandidateDeletingError, _blocked, simulate_scheduling
+
+        t0 = time.perf_counter()
+        stats: dict = {
+            "engine": "batched",
+            "cohort": method.type_name,
+            "candidates": len(candidates),
+        }
+        self.last_engine_stats = stats
+        screened = inplace = 0
+        if len(candidates) > 1:
+            with tracer.span(
+                "disrupt.screen", candidates=len(candidates), cohort=method.type_name
+            ):
+                feasible = tpu_repack.screen_singles(self.ctx, candidates)
+            screened = len(candidates)
+            inplace = int(np.count_nonzero(np.asarray(feasible, dtype=bool)))
+        stats["subsets_screened"] = screened
+        stats["screen_feasible_subsets"] = inplace
+        verified = 0
+        gen = self._generation()
+        world = self._world_key()
+        try:
+            for candidate in candidates:
+                vkey = None
+                if gen is not None and world is not None:
+                    # the drain simulation reads only the drained node +
+                    # the informer/catalog world — NOT the condition that
+                    # nominated it — so a blocked verdict is shared
+                    # across the expiration/drift cohorts
+                    vkey = ("cond", gen, world, (candidate.provider_id(),))
+                    known = self.verdicts.get(vkey, self.cstats)
+                    if known is not None:
+                        continue  # memoized: this drain cannot schedule its pods
+                verified += 1
+                with tracer.span("disrupt.verify", subset=1, cohort=method.type_name):
+                    try:
+                        results = simulate_scheduling(
+                            self.ctx.kube_client,
+                            self.ctx.cluster,
+                            self.ctx.provisioner,
+                            [candidate],
+                        )
+                    except CandidateDeletingError:
+                        # transient (mid-deletion) — not memoized: the
+                        # sequential loop re-probes it next pass too
+                        continue
+                if not results.all_non_pending_pods_scheduled():
+                    _blocked(
+                        self.ctx.recorder,
+                        candidate,
+                        "Scheduling simulation failed to schedule all pods",
+                    )
+                    if vkey is not None:
+                        # see _attempt_multi: ctx reads are witnessed by
+                        # (generation, world key), the drained node by
+                        # its provider id
+                        self.verdicts.put(vkey, True, self.cstats)  # analysis: allow-cache-key(method)
+                    continue
+                return Command(
+                    candidates=[candidate], replacements=results.new_node_claims
+                )
+            return Command()
+        finally:
+            stats["subsets_verified"] = verified
+            stats["decision_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+            stats["cache"] = self.cstats.to_dict()
+
     # -- the single-node decision ----------------------------------------
 
     def single_command(self, method, candidates: List[Candidate]) -> Command:

@@ -31,6 +31,7 @@ class NodeClaimDisruptionController:
         kube_client,
         cloud_provider,
         cluster,
+        # analysis: allow-clock(expiry vs creation_timestamp — persisted wall-clock stamps by protocol)
         clock: Callable[[], float] = time.time,
         drift_enabled: bool = True,  # the Drift feature gate (options.go:123)
     ):

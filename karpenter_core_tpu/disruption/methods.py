@@ -76,16 +76,42 @@ class Method:
     def compute_command(self, candidates: List[Candidate]) -> Command:
         raise NotImplementedError
 
+    def _engine(self):
+        """The controller-shared batched engine (disruption/engine.py),
+        constructed lazily for tests that build methods from a bare
+        ctx. Shared by the consolidation family and (ISSUE 15) the
+        condition cohorts, so the whole ordered chain rides one memo
+        plane."""
+        eng = getattr(self.ctx, "engine", None)
+        if eng is None:
+            from .engine import BatchedDisruptionEngine
+
+            eng = BatchedDisruptionEngine(self.ctx)
+            try:
+                self.ctx.engine = eng
+            except Exception:  # noqa: BLE001 — frozen/legacy ctx: engine stays local
+                pass
+        return eng
+
 
 class ConditionMethod(Method):
     """Expiration / Drift / Emptiness: act on status conditions set by the
-    marker controller; replacements are counted by simulation."""
+    marker controller; replacements are counted by simulation. The
+    simulate loop dispatches through the batched engine (ISSUE 15) —
+    ``engine.condition_command`` is probe-for-probe the sequential loop
+    (``_simulate_in_order``, retained as the plan-identity oracle under
+    ``KARPENTER_TPU_DISRUPT_ENGINE=sequential``) with the cohort
+    screened in one dispatch and known-blocked drains memoized."""
 
     condition = ""
     needs_replacement = True
 
     def __init__(self, ctx):
         self.ctx = ctx
+        # per-decision observability (mirrors ConsolidationBase): the
+        # batched cohort pass's screen/memo stats, read by the
+        # controller's _observe_decision and /debug/traces root args
+        self.last_decision_stats: Optional[dict] = None
 
     def should_disrupt(self, candidate: Candidate) -> bool:
         nc = candidate.state_node.node_claim
@@ -123,6 +149,16 @@ class ConditionMethod(Method):
         ]
         if empty:
             return Command(candidates=empty)
+        from .engine import engine_mode
+
+        if engine_mode() == "batched":
+            engine = self._engine()
+            cmd = engine.condition_command(self, candidates)
+            self.last_decision_stats = engine.last_engine_stats
+            return cmd
+        return self._simulate_in_order(candidates)
+
+    def _simulate_in_order(self, candidates: List[Candidate]) -> Command:
         # non-empty: one at a time, launching replacement capacity for
         # displaced pods (expiration.go:80-123, drift.go:75-121)
         for candidate in candidates:
@@ -185,21 +221,6 @@ class ConsolidationBase(Method):
         # (and, on the batched engine, the whole family's stats) — read
         # by the controller, bench config 9, and /debug/traces root args
         self.last_decision_stats: Optional[dict] = None
-
-    def _engine(self):
-        """The controller-shared batched engine (disruption/engine.py),
-        constructed lazily for tests that build methods from a bare
-        ctx."""
-        eng = getattr(self.ctx, "engine", None)
-        if eng is None:
-            from .engine import BatchedDisruptionEngine
-
-            eng = BatchedDisruptionEngine(self.ctx)
-            try:
-                self.ctx.engine = eng
-            except Exception:  # noqa: BLE001 — frozen/legacy ctx: engine stays local
-                pass
-        return eng
 
     def is_consolidated(self) -> bool:
         return self.last_consolidation_state == self.ctx.cluster.consolidation_state()

@@ -29,7 +29,9 @@ class QueuedCommand:
 
 
 class OrchestrationQueue:
-    def __init__(self, kube_client, cluster, recorder=None, clock: Callable[[], float] = time.time, metrics=None):
+    # queue timestamps are in-memory timeout anchors, never persisted —
+    # monotonic, immune to skew
+    def __init__(self, kube_client, cluster, recorder=None, clock: Callable[[], float] = time.monotonic, metrics=None):
         self.kube_client = kube_client
         self.cluster = cluster
         self.recorder = recorder

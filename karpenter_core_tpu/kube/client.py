@@ -37,6 +37,7 @@ class KubeClient:
     controllers use so timestamp comparisons agree under simulated time.
     """
 
+    # analysis: allow-clock(object-stamps — creation/deletionTimestamp are persisted wall clock by k8s protocol)
     def __init__(self, clock: Callable[[], float] = time.time) -> None:
         self._objects: Dict[str, Dict[tuple, KubeObject]] = defaultdict(dict)
         self._watchers: Dict[str, List[Callable]] = defaultdict(list)

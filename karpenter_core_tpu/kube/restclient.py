@@ -27,6 +27,8 @@ tests/test_restclient.py next to stub-server unit tests.
 from __future__ import annotations
 
 import json
+import os
+import random
 import ssl
 import threading
 import urllib.error
@@ -42,6 +44,48 @@ class ApiError(RuntimeError):
     def __init__(self, code: int, message: str):
         super().__init__(f"{code}: {message}")
         self.code = code
+
+
+def _env_ms(name: str, default_ms: float) -> float:
+    try:
+        return float(os.environ.get(name, default_ms))
+    except ValueError:
+        return default_ms
+
+
+class WatchBackoff:
+    """Capped exponential backoff with full jitter for the relist and
+    watch-error retry paths (ISSUE 15): retries are never a hot loop
+    (delay is bounded below by base/2) and never unbounded (capped at
+    ``KARPENTER_TPU_WATCH_BACKOFF_MAX_MS``). A healthy stream resets
+    the ladder, so a one-off flap pays one base delay, not the cap."""
+
+    def __init__(
+        self,
+        base_ms: Optional[float] = None,
+        max_ms: Optional[float] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        if base_ms is None:
+            base_ms = _env_ms("KARPENTER_TPU_WATCH_BACKOFF_BASE_MS", 200.0)
+        if max_ms is None:
+            max_ms = _env_ms("KARPENTER_TPU_WATCH_BACKOFF_MAX_MS", 5000.0)
+        self.base_s = max(0.001, base_ms) / 1000.0
+        self.max_s = max(self.base_s, max_ms / 1000.0)
+        self._rng = rng or random.Random()
+        self._attempt = 0
+
+    @property
+    def attempt(self) -> int:
+        return self._attempt
+
+    def next_delay(self) -> float:
+        cap = min(self.max_s, self.base_s * (2.0 ** self._attempt))
+        self._attempt += 1
+        return cap * (0.5 + 0.5 * self._rng.random())
+
+    def reset(self) -> None:
+        self._attempt = 0
 
 
 class RestKubeClient:
@@ -70,6 +114,33 @@ class RestKubeClient:
         # admission parity with the in-memory client: a real apiserver
         # runs its own webhooks, so this chain is typically empty
         self.admission: List[Callable[[KubeObject], None]] = []
+        # chaos seam (ISSUE 15): an optional callable consulted before
+        # every HTTP request — fault_injector(method, path, stream) may
+        # sleep (latency spike) or raise (410 storm, connection reset).
+        # kube/faults.py:RestFaultInjector is the seeded implementation.
+        self.fault_injector: Optional[Callable[[str, str, bool], None]] = None
+        # watch-loop observability, attached via attach_watch_metrics
+        # (kube/ stays metrics-agnostic; the operator wiring owns the
+        # registry): relists / errors / backoff-seconds counters
+        self._watch_metrics: dict = {}
+
+    def attach_watch_metrics(
+        self, relists=None, errors=None, backoff_seconds=None
+    ) -> None:
+        """Attach the karpenter_tpu_watch_{relists,errors,
+        backoff_seconds}_total counters (metrics/registry.py Metrics.
+        watch_*). Safe to call any time; watch threads pick the sinks
+        up on their next use."""
+        self._watch_metrics = {
+            "relists": relists,
+            "errors": errors,
+            "backoff_seconds": backoff_seconds,
+        }
+
+    def _watch_count(self, name: str, value: float = 1.0, **labels) -> None:
+        sink = self._watch_metrics.get(name)
+        if sink is not None:
+            sink.inc(value, **labels)
 
     # -- plumbing ----------------------------------------------------------
 
@@ -97,6 +168,9 @@ class RestKubeClient:
             )
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        inject = self.fault_injector
+        if inject is not None:
+            inject(method, path, stream)
         try:
             resp = urllib.request.urlopen(
                 req, timeout=None if stream else self.timeout, context=self._ctx
@@ -245,6 +319,7 @@ class RestKubeClient:
 
         def relist(first: bool) -> str:
             data = self._request("GET", self._path(kind))
+            self._watch_count("relists", kind=kind)
             rv = (data.get("metadata") or {}).get("resourceVersion", "0")
             seen = set()
             for item in data.get("items", []):
@@ -260,6 +335,14 @@ class RestKubeClient:
         rv = relist(first=True)
         unsubscribed = threading.Event()
         live = {"resp": None}  # the stream unsubscribe must unblock
+        backoff = WatchBackoff()
+
+        def back_off() -> bool:
+            """Sleep one capped+jittered backoff step; True → exit the
+            watch thread (unsubscribed/stopping fired mid-sleep)."""
+            delay = backoff.next_delay()
+            self._watch_count("backoff_seconds", delay, kind=kind)
+            return unsubscribed.wait(delay) or self._stopping.is_set()
 
         def stream():
             last_rv = rv
@@ -288,6 +371,7 @@ class RestKubeClient:
                             if etype == "BOOKMARK":
                                 continue
                             if etype == "ERROR":
+                                self._watch_count("errors", kind=kind, reason="error_event")
                                 last_rv = relist(first=False)  # expired rv
                                 break
                             mapped = {
@@ -297,6 +381,7 @@ class RestKubeClient:
                             }.get(etype)
                             if mapped:
                                 deliver(mapped, from_k8s(kind, item))
+                                backoff.reset()  # healthy stream: next error starts at base
                     finally:
                         try:
                             self._streams.remove(resp)
@@ -304,17 +389,22 @@ class RestKubeClient:
                         except (ValueError, OSError):
                             pass
                 except ApiError as err:
+                    self._watch_count(
+                        "errors", kind=kind, reason="410" if err.code == 410 else "http"
+                    )
                     if err.code == 410:  # Gone: event cache window passed
                         try:
                             last_rv = relist(first=False)
                         except Exception:
                             pass
-                    if unsubscribed.wait(2.0) or self._stopping.is_set():
+                    if back_off():
                         return
                 except Exception:
                     # stream dropped (network, apiserver restart): back
-                    # off briefly and resume from the last seen rv
-                    if unsubscribed.wait(2.0) or self._stopping.is_set():
+                    # off (capped exponential + jitter) and resume from
+                    # the last seen rv
+                    self._watch_count("errors", kind=kind, reason="stream")
+                    if back_off():
                         return
 
         thread = threading.Thread(target=stream, name=f"watch-{kind}", daemon=True)

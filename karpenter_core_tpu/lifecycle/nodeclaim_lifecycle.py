@@ -34,6 +34,7 @@ class NodeClaimLifecycleController:
         kube_client,
         cloud_provider: CloudProvider,
         recorder=None,
+        # analysis: allow-clock(registration TTL vs persisted claim creation wall-clock stamps)
         clock: Callable[[], float] = time.time,
         metrics=None,
     ):

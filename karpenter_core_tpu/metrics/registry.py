@@ -374,6 +374,25 @@ class Metrics:
             "Fraction of decisions over the latency SLO target in the trailing window (1m | 10m)",
             ["window"],
         )
+        # real-apiserver watch loop (kube/restclient.py): relist and
+        # retry traffic under 410 storms / stream drops — attached via
+        # RestKubeClient.attach_watch_metrics (kube/ stays registry-
+        # agnostic); retries are never silent (ISSUE 15)
+        self.watch_relists = r.counter(
+            f"{ns}_tpu_watch_relists_total",
+            "Watch relists (initial list + 410/ERROR recovery), by kind",
+            ["kind"],
+        )
+        self.watch_errors = r.counter(
+            f"{ns}_tpu_watch_errors_total",
+            "Watch stream errors, by kind and reason (410 | http | stream | error_event)",
+            ["kind", "reason"],
+        )
+        self.watch_backoff_seconds = r.counter(
+            f"{ns}_tpu_watch_backoff_seconds_total",
+            "Seconds of capped+jittered watch-retry backoff slept, by kind (KARPENTER_TPU_WATCH_BACKOFF_{BASE,MAX}_MS)",
+            ["kind"],
+        )
         r.register(_TracerOrphanCollector())
         self.serving_stage_duration = r.histogram(
             f"{ns}_serving_stage_duration_seconds",

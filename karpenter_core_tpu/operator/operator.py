@@ -43,6 +43,7 @@ class Operator:
         cloud_provider,
         kube_client: Optional[KubeClient] = None,
         options: Optional[Options] = None,
+        # analysis: allow-clock(fans to lease/stamping controllers that compare persisted wall-clock stamps)
         clock: Callable[[], float] = time.time,
     ):
         self.options = options or Options.from_env()

@@ -20,7 +20,8 @@ class Batcher:
         self,
         idle_seconds: float = 1.0,
         max_seconds: float = 10.0,
-        clock: Callable[[], float] = time.time,
+        # pure in-process window durations — monotonic, immune to skew
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.idle_seconds = idle_seconds
         self.max_seconds = max_seconds

@@ -14,12 +14,13 @@ config 8 verify against the sequential loop on every scenario.
 """
 
 from .latency import DecisionLatencyTracker, percentiles_ms
-from .pipeline import PipelineConfig, SequentialLoop, ServingPipeline
+from .pipeline import LostLeadership, PipelineConfig, SequentialLoop, ServingPipeline
 from .queues import Closed, StageQueue
 
 __all__ = [
     "Closed",
     "DecisionLatencyTracker",
+    "LostLeadership",
     "PipelineConfig",
     "SequentialLoop",
     "ServingPipeline",

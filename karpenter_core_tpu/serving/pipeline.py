@@ -102,6 +102,15 @@ class PipelineConfig:
     warmstore_restore: Optional[str] = field(
         default_factory=lambda: os.environ.get("KARPENTER_TPU_WARMSTORE_RESTORE", "").strip() or None
     )
+    # stale-world guard (ISSUE 15): with a positive bound, the plan
+    # thread refuses to run the authoritative step against an observed
+    # world older than this many seconds (no watch event / explicit
+    # staleness mark) — the tick HOLDS (counted, visible in /debug)
+    # until freshness recovers. 0 disables the age check; the explicit
+    # `set_world_stale` seam works regardless.
+    max_staleness_s: float = field(
+        default_factory=lambda: _env_float("KARPENTER_TPU_SERVING_MAX_STALENESS_S", 0.0)
+    )
 
     def to_dict(self) -> dict:
         return {
@@ -113,7 +122,14 @@ class PipelineConfig:
             "disrupt_every": self.disrupt_every,
             "warmstore_dir": self.warmstore_dir,
             "warmstore_restore": self.warmstore_restore,
+            "max_staleness_s": self.max_staleness_s,
         }
+
+
+class LostLeadership(RuntimeError):
+    """Raised by the leader admission guard when a NodeClaim write is
+    attempted by a process that no longer holds the leader lease — the
+    deposed leader's in-flight tick must not emit (ISSUE 15)."""
 
 
 class _DecisionStep:
@@ -348,6 +364,17 @@ class ServingPipeline:
         # warm-state restore outcome (ISSUE 13): per-plane restored/
         # dropped counts of the pre-first-tick restore, for /debug
         self._warmstore_outcome: Optional[dict] = None
+        # chaos-plane degradation state (ISSUE 15): the stale-world
+        # guard's freshness stamp (monotonic; any watch delivery is
+        # evidence of liveness) + explicit staleness seam, the leader
+        # emit gate, and the held-tick counters the bench gates on
+        # (held ticks are degradation, never silent)
+        self._world_stamp = time.monotonic()
+        self._world_stale_flag = False
+        self._stale_holds = 0
+        self._leader_holds = 0
+        self._is_leader: Optional[Callable[[], bool]] = None
+        self._leader_guard = None
         self._threads: List[threading.Thread] = []
         self._watch_unsub = None
 
@@ -361,6 +388,7 @@ class ServingPipeline:
         """Ingest: stamp first-pending arrival (the SLO clock starts
         here) and nudge the batch window. Runs on whatever thread wrote
         the pod — the cheap, nonblocking edge of the pipeline."""
+        self.note_world_event()
         if event == "DELETED":
             self.latency.forget(pod.uid)
             return
@@ -381,7 +409,98 @@ class ServingPipeline:
         post-event solve."""
         with self._mu:
             self._catalog_dirty = True
+        self.note_world_event()
         self._new_pods_evt.set()
+
+    # -- chaos-plane degradation (ISSUE 15) ----------------------------------
+
+    def note_world_event(self) -> None:
+        """Any watch/catalog delivery is evidence the observed world is
+        live — refresh the stale-world guard's freshness stamp. Called
+        from the ingest edge; watch-liveness probes may call it too."""
+        with self._mu:
+            self._world_stamp = time.monotonic()
+
+    def set_world_stale(self, stale: bool) -> None:
+        """Explicit staleness seam: a watch-health monitor (or the chaos
+        harness) marks the observed world unsafe to plan against —
+        e.g. the watch channel is flapping/hung, or node heartbeats
+        stopped. Independent of the age-bound check."""
+        with self._mu:
+            self._world_stale_flag = bool(stale)
+
+    def world_is_stale(self) -> bool:
+        bound = self.config.max_staleness_s
+        with self._mu:
+            if self._world_stale_flag:
+                return True
+            if bound > 0.0:
+                return (time.monotonic() - self._world_stamp) > bound
+        return False
+
+    def attach_leader_gate(self, is_leader: Callable[[], bool]) -> None:
+        """Single-writer enforcement under leader election: (a) the plan
+        thread holds each tick while not leading, and (b) an admission
+        guard on the kube client rejects NodeClaim writes the moment
+        leadership is lost — so a failover MID-tick (leadership lost
+        after the step started) still cannot emit: the deposed leader's
+        in-flight emit raises LostLeadership at the write, the tick
+        lands as an error, and the new leader is the sole writer.
+
+        Attach/detach happen while the pipeline is held (or before
+        start/after stop) — the admission-guard list itself is only
+        ever mutated with no tick in flight."""
+
+        def _guard(obj) -> None:
+            if obj.kind == "NodeClaim" and not is_leader():
+                raise LostLeadership("NodeClaim write without leadership")
+
+        kc = self.kube_client
+        with self._mu:
+            self._is_leader = is_leader
+            self._leader_guard = _guard
+        kc.admission.append(_guard)
+
+    def detach_leader_gate(self) -> None:
+        with self._mu:
+            guard, self._leader_guard = self._leader_guard, None
+            self._is_leader = None
+        if guard is not None:
+            kc = self.kube_client
+            try:
+                kc.admission.remove(guard)
+            except ValueError:
+                pass
+
+    def held_ticks(self) -> dict:
+        with self._mu:
+            return {"stale": self._stale_holds, "leader": self._leader_holds}
+
+    def _await_emit_preconditions(self) -> bool:
+        """The degradation point: before the authoritative step runs,
+        prove (a) the observed world is within the freshness bound and
+        (b) this process holds leadership. Failing either HOLDS the
+        tick — counted once per hold, never emitted — and waits for
+        recovery. A held tick keeps its batch token, so the pending work
+        is decided the moment the world recovers (degrade to hold +
+        counter, never a stale plan). Returns False when stopping."""
+        counted_stale = counted_leader = False
+        while not self._stop_evt.is_set():
+            stale = self.world_is_stale()
+            with self._mu:
+                is_leader = self._is_leader
+            deposed = is_leader is not None and not is_leader()
+            if not stale and not deposed:
+                return True
+            with self._mu:
+                if stale and not counted_stale:
+                    self._stale_holds += 1
+                    counted_stale = True
+                if deposed and not counted_leader:
+                    self._leader_holds += 1
+                    counted_leader = True
+            time.sleep(0.005)
+        return False
 
     # -- batch former stage --------------------------------------------------
 
@@ -419,6 +538,13 @@ class ServingPipeline:
                 while self._gate_held and not self._stop_evt.is_set():
                     self._gate_cv.wait(timeout=0.2)
             if self._stop_evt.is_set():
+                return
+            # stale-world guard + leader gate (ISSUE 15): the tick holds
+            # here — token kept, nothing emitted — until the world is
+            # fresh and this process leads. Sits AFTER the hold gate so
+            # lockstep drivers stay atomic, BEFORE tick accounting so a
+            # held tick never appears as an undrained tick to quiesce().
+            if not self._await_emit_preconditions():
                 return
             queue_wait_ms = round(
                 (time.perf_counter() - token["formed_at"]) * 1000.0, 3
@@ -739,6 +865,7 @@ class ServingPipeline:
         if self._watch_unsub is not None:
             self._watch_unsub()
             self._watch_unsub = None
+        self.detach_leader_gate()
 
     # -- gating / quiescence (lockstep harness + operational pause) ----------
 
@@ -803,6 +930,9 @@ class ServingPipeline:
             }
             disrupt_log = list(self._disrupt_log)[-4:]
             warmstore_outcome = self._warmstore_outcome
+            stale_holds = self._stale_holds
+            leader_holds = self._leader_holds
+            leader_gate = self._is_leader is not None
         return {
             "config": self.config.to_dict(),
             "ticks": ticks,
@@ -827,6 +957,13 @@ class ServingPipeline:
                 "retained": len(self._step.recorder),
             },
             "warmstore": warmstore_outcome,
+            "chaos": {
+                "max_staleness_s": self.config.max_staleness_s,
+                "world_stale": self.world_is_stale(),
+                "held_ticks": {"stale": stale_holds, "leader": leader_holds},
+                "leader_gate": leader_gate,
+                "fault_window": flightrec.active_fault_window(),
+            },
         }
 
 

@@ -77,6 +77,36 @@ def _breach_threshold_ms() -> Optional[float]:
         return None
 
 
+# -- chaos fault-window annotation (ISSUE 15) -------------------------------
+# The chaos harness registers the active fault window here so a breach
+# dump (or a /debug/decisions record) produced while a fault is injected
+# is distinguishable from an organic regression. Process-global like
+# RECORDER; the window rides every record assembled while it is set.
+
+_FAULT_WINDOW_MU = threading.Lock()
+_FAULT_WINDOW: Optional[dict] = None
+
+
+def set_fault_window(scenario: str, fault: str, phase: str = "active") -> None:
+    """Mark records assembled from now on as taken under injected
+    chaos: ``scenario`` (e.g. chaos_relist_storm), ``fault`` (the
+    kube/faults.py kind), ``phase`` (inject | active | recovery)."""
+    global _FAULT_WINDOW
+    with _FAULT_WINDOW_MU:
+        _FAULT_WINDOW = {"scenario": scenario, "fault": fault, "phase": phase}
+
+
+def clear_fault_window() -> None:
+    global _FAULT_WINDOW
+    with _FAULT_WINDOW_MU:
+        _FAULT_WINDOW = None
+
+
+def active_fault_window() -> Optional[dict]:
+    with _FAULT_WINDOW_MU:
+        return dict(_FAULT_WINDOW) if _FAULT_WINDOW is not None else None
+
+
 class DecisionRecord(dict):
     """One decision's flight record. A plain dict (JSON-ready for the
     debug routes and breach dumps) with typed access helpers."""
@@ -160,6 +190,9 @@ class FlightRecorder:
         )
         if extra:
             rec.update(extra)
+        window = active_fault_window()
+        if window is not None:
+            rec["fault_window"] = window
         # the SLO clock is decision latency when pods were settled,
         # the step's own wall otherwise (an empty tick still burns time)
         slo_ms = rec["latency_ms"]["max"]
@@ -310,6 +343,7 @@ class FlightRecorder:
             "slo_target_ms": slo_target_ms(),
             "burn_rate": burn,
             "coverage": coverage,
+            "fault_window": active_fault_window(),
             "decisions": records[-max(1, tail):],
         }
 

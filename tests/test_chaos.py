@@ -1,0 +1,382 @@
+"""Chaos plane (ISSUE 15): seeded fault schedules, the REST fault seam,
+capped watch backoff, the stale-world / leader degradation guards, and
+fault-window annotation in the flight recorder."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from karpenter_core_tpu.apis.nodeclaim import NodeClaim
+from karpenter_core_tpu.kube.faults import (
+    FAULT_KINDS,
+    FaultEvent,
+    FaultSchedule,
+    RestFaultInjector,
+    SkewClock,
+)
+from karpenter_core_tpu.kube.restclient import ApiError, RestKubeClient, WatchBackoff
+from karpenter_core_tpu.serving import LostLeadership, PipelineConfig, ServingPipeline
+from karpenter_core_tpu.serving import trafficgen as tg
+from karpenter_core_tpu.tracing import flightrec
+
+from test_restclient import _StubApiServer
+
+
+# ---------------------------------------------------------------------------
+# fault schedules
+
+
+class TestFaultSchedule:
+    def test_build_is_deterministic_per_name_and_seed(self):
+        a = FaultSchedule.build("chaos-x", 7, FAULT_KINDS, 200)
+        b = FaultSchedule.build("chaos-x", 7, FAULT_KINDS, 200)
+        assert a.to_dict() == b.to_dict()
+        # a different seed (or name) moves at least one window
+        c = FaultSchedule.build("chaos-x", 8, FAULT_KINDS, 200)
+        assert a.to_dict() != c.to_dict()
+        d = FaultSchedule.build("chaos-y", 7, FAULT_KINDS, 200)
+        assert a.to_dict() != d.to_dict()
+
+    def test_windows_land_in_middle_half(self):
+        n = 160
+        sched = FaultSchedule.build("mid", 3, FAULT_KINDS, n)
+        assert len(sched.events) == len(FAULT_KINDS)
+        for ev in sched.events:
+            assert n // 4 <= ev.step < (3 * n) // 4
+            assert ev.duration >= 1
+
+    def test_magnitudes_applied_per_kind(self):
+        sched = FaultSchedule.build(
+            "mag", 1, ("latency_spike", "clock_skew"), 40,
+            magnitudes={"latency_spike": 25.0, "clock_skew": 3600.0},
+        )
+        assert sched.first("latency_spike").magnitude == 25.0
+        assert sched.first("clock_skew").magnitude == 3600.0
+
+    def test_active_and_kinds_at(self):
+        sched = FaultSchedule(
+            "manual", 0,
+            [FaultEvent("watch_flap", 5, duration=3), FaultEvent("failover", 6)],
+        )
+        assert sched.kinds_at(4) == ()
+        assert sched.kinds_at(5) == ("watch_flap",)
+        assert set(sched.kinds_at(6)) == {"watch_flap", "failover"}
+        assert sched.kinds_at(8) == ()
+        assert sched.first("failover").step == 6
+        assert sched.first("relist_storm") is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultSchedule("bad", 0, [FaultEvent("meteor_strike", 1)])
+        with pytest.raises(ValueError):
+            FaultSchedule.build("bad", 0, ("meteor_strike",), 10)
+
+
+class TestSkewClock:
+    def test_offset_and_skew(self):
+        t = {"now": 100.0}
+        clock = SkewClock(base=lambda: t["now"])
+        assert clock() == 100.0
+        clock.skew(3600.0)
+        assert clock() == 3700.0
+        t["now"] = 101.0
+        assert clock() == 3701.0  # base keeps advancing monotonically
+        clock.skew(-3600.0)
+        assert clock() == pytest.approx(101.0)
+
+
+# ---------------------------------------------------------------------------
+# the REST fault seam
+
+
+class TestRestFaultInjector:
+    def _sched(self, *events):
+        return FaultSchedule("inj", 0, events)
+
+    def test_latency_spike_sleeps_on_any_request(self):
+        slept = []
+        inj = RestFaultInjector(
+            self._sched(FaultEvent("latency_spike", 1, duration=2, magnitude=40.0)),
+            sleep=slept.append,
+        )
+        inj("GET", "/api/v1/pods", False)  # ordinal 1
+        inj("GET", "/api/v1/pods", True)  # ordinal 2
+        inj("GET", "/api/v1/pods", False)  # ordinal 3: window over
+        assert slept == [0.04, 0.04]
+        assert inj.injected == [(1, "latency_spike"), (2, "latency_spike")]
+
+    def test_relist_storm_is_stream_only_410(self):
+        inj = RestFaultInjector(
+            self._sched(
+                FaultEvent("relist_storm", 1, duration=1),
+                FaultEvent("relist_storm", 2, duration=1),
+            )
+        )
+        inj("GET", "/api/v1/pods", False)  # ordinal 1: plain GET untouched
+        with pytest.raises(ApiError) as err:
+            inj("GET", "/api/v1/pods?watch=1", True)  # ordinal 2
+        assert err.value.code == 410
+        assert inj.injected == [(2, "relist_storm")]
+
+    def test_watch_flap_resets_stream_connections(self):
+        inj = RestFaultInjector(self._sched(FaultEvent("watch_flap", 1, duration=2)))
+        with pytest.raises(ConnectionResetError):
+            inj("GET", "/api/v1/pods?watch=1", True)  # ordinal 1
+        inj("POST", "/api/v1/pods", False)  # ordinal 2: writes unaffected
+        assert inj.injected == [(1, "watch_flap")]
+
+
+class _Counter:
+    def __init__(self):
+        self.total = 0.0
+        self.labels = []
+
+    def inc(self, value=1.0, **labels):
+        self.total += value
+        self.labels.append(labels)
+
+
+class TestWatchLoopUnderFaults:
+    def test_flapped_watch_backs_off_and_recovers(self, monkeypatch):
+        """A connection-reset flap on the first stream attempt: the watch
+        loop counts the error, sleeps one capped backoff step, resumes
+        from the last rv, and still delivers the live event."""
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_BASE_MS", "5")
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_MAX_MS", "20")
+        stub = _StubApiServer()
+        watcher = RestKubeClient(stub.url)
+        writer = RestKubeClient(stub.url)
+        relists, errors, backoff = _Counter(), _Counter(), _Counter()
+        watcher.attach_watch_metrics(
+            relists=relists, errors=errors, backoff_seconds=backoff
+        )
+        # ordinal 1 is the initial relist GET; ordinal 2 the first stream
+        # request — flap exactly that one, the retry (ordinal 3) is clean
+        watcher.fault_injector = RestFaultInjector(
+            FaultSchedule("flap", 0, [FaultEvent("watch_flap", 2, duration=1)])
+        )
+        seen = threading.Event()
+
+        def cb(etype, obj):
+            if obj.name == "live-claim":
+                seen.set()
+
+        try:
+            watcher.watch("NodeClaim", cb)
+            time.sleep(0.4)  # flap + backoff + re-established stream
+            nc = NodeClaim()
+            nc.metadata.name = "live-claim"
+            writer.create(nc)
+            assert seen.wait(5.0), "watch must recover after the flap"
+            assert errors.total >= 1
+            assert any(lb.get("reason") == "stream" for lb in errors.labels)
+            assert backoff.total > 0.0
+            assert relists.total >= 1
+            assert watcher.fault_injector.injected == [(2, "watch_flap")]
+        finally:
+            watcher.close()
+            writer.close()
+            stub.stop()
+
+
+class TestWatchBackoff:
+    def test_caps_and_jitter_band(self):
+        b = WatchBackoff(base_ms=100.0, max_ms=800.0, rng=random.Random(0))
+        for attempt in range(8):
+            cap = min(0.8, 0.1 * (2.0 ** attempt))
+            d = b.next_delay()
+            assert cap * 0.5 <= d <= cap, (attempt, d)
+        # ladder is capped: late attempts never exceed max
+        assert b.next_delay() <= 0.8
+
+    def test_reset_restarts_the_ladder(self):
+        b = WatchBackoff(base_ms=100.0, max_ms=800.0, rng=random.Random(1))
+        b.next_delay()
+        b.next_delay()
+        assert b.attempt == 2
+        b.reset()
+        assert b.attempt == 0
+        assert b.next_delay() <= 0.1
+
+    def test_env_knobs_and_garbage_fallback(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_BASE_MS", "50")
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_MAX_MS", "900")
+        b = WatchBackoff()
+        assert b.base_s == pytest.approx(0.05)
+        assert b.max_s == pytest.approx(0.9)
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_BASE_MS", "junk")
+        assert WatchBackoff().base_s == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# pipeline degradation guards
+
+
+def _pipe(harness, **cfg):
+    pipe = ServingPipeline(
+        harness.provisioner,
+        metrics=harness.metrics,
+        config=PipelineConfig(idle_seconds=0.01, max_seconds=0.2, **cfg),
+        on_decision=harness.bind,
+    )
+    pipe.attach_watch()
+    return pipe
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestStaleWorldGuard:
+    def test_age_bound_without_events(self):
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness, max_staleness_s=0.05)
+        try:
+            pipe.note_world_event()
+            assert not pipe.world_is_stale()
+            time.sleep(0.12)
+            assert pipe.world_is_stale()  # no deliveries past the bound
+            pipe.note_world_event()
+            assert not pipe.world_is_stale()
+        finally:
+            harness.close()
+
+    def test_age_bound_zero_disables(self):
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness)  # max_staleness_s defaults to 0 = off
+        try:
+            time.sleep(0.05)
+            assert not pipe.world_is_stale()
+            pipe.set_world_stale(True)  # the explicit flag still works
+            assert pipe.world_is_stale()
+        finally:
+            harness.close()
+
+    def test_stale_world_holds_tick_then_recovers(self):
+        """The degradation contract: a stale world never yields a plan —
+        the tick holds (counted once), pending pods keep their batch
+        token, and the moment the world recovers they are decided."""
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness)
+        pipe.set_world_stale(True)
+        pipe.start()
+        try:
+            step = tg.Step(
+                creates=[tg.PodSpecLite(f"st-{i}", "100m", "128Mi", None, 0) for i in range(3)]
+            )
+            harness.inject_step(step, 0)
+            assert _wait(lambda: pipe.held_ticks()["stale"] >= 1)
+            assert pipe.latency.decided_count() == 0, "stale world must not plan"
+            pipe.set_world_stale(False)
+            pipe.note_world_event()
+            assert pipe.quiesce(timeout=30.0)
+            assert pipe.latency.decided_count() == 3
+            assert pipe.debug_state()["chaos"]["held_ticks"]["stale"] >= 1
+        finally:
+            pipe.stop()
+            harness.close()
+
+
+class TestLeaderGate:
+    def test_deposed_leader_holds_tick(self):
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness)
+        led = {"leading": False}
+        pipe.attach_leader_gate(lambda: led["leading"])
+        pipe.start()
+        try:
+            step = tg.Step(
+                creates=[tg.PodSpecLite(f"ld-{i}", "100m", "128Mi", None, 0) for i in range(2)]
+            )
+            harness.inject_step(step, 0)
+            assert _wait(lambda: pipe.held_ticks()["leader"] >= 1)
+            assert pipe.latency.decided_count() == 0
+            led["leading"] = True
+            assert pipe.quiesce(timeout=30.0)
+            assert pipe.latency.decided_count() == 2
+        finally:
+            pipe.stop()
+            harness.close()
+
+    def test_mid_tick_failover_rejects_nodeclaim_write(self):
+        """The single-writer invariant's last line of defense: once
+        leadership is gone, the admission guard rejects NodeClaim writes
+        even from a tick already in flight."""
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness)
+        led = {"leading": True}
+        pipe.attach_leader_gate(lambda: led["leading"])
+        try:
+            nc = NodeClaim()
+            nc.metadata.name = "deposed-write"
+            led["leading"] = False
+            with pytest.raises(LostLeadership):
+                harness.kube.create(nc)
+            assert harness.kube.get("NodeClaim", "deposed-write") is None
+            led["leading"] = True
+            harness.kube.create(nc)  # re-elected: writes flow again
+            assert harness.kube.get("NodeClaim", "deposed-write") is not None
+            pipe.detach_leader_gate()
+            assert pipe.held_ticks() == {"stale": 0, "leader": 0}
+        finally:
+            harness.close()
+
+    def test_detach_is_idempotent_and_restores_writes(self):
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness)
+        pipe.attach_leader_gate(lambda: False)
+        try:
+            pipe.detach_leader_gate()
+            pipe.detach_leader_gate()
+            nc = NodeClaim()
+            nc.metadata.name = "after-detach"
+            harness.kube.create(nc)  # no guard left behind
+        finally:
+            harness.close()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder fault windows
+
+
+class TestFaultWindowAnnotation:
+    def test_records_inside_window_are_annotated(self):
+        flightrec.clear_fault_window()
+        try:
+            flightrec.set_fault_window("rollout", "watch_flap")
+            window = flightrec.active_fault_window()
+            assert window["scenario"] == "rollout"
+            assert window["fault"] == "watch_flap"
+            assert window["phase"] == "active"
+            flightrec.set_fault_window("rollout", "watch_flap", phase="recovery")
+            assert flightrec.active_fault_window()["phase"] == "recovery"
+        finally:
+            flightrec.clear_fault_window()
+        assert flightrec.active_fault_window() is None
+
+    def test_record_carries_window_only_while_active(self):
+        rec = flightrec.FlightRecorder(capacity=8)
+        flightrec.clear_fault_window()
+        try:
+            clean = rec.record("tick", tick=1)
+            assert "fault_window" not in clean
+            flightrec.set_fault_window("rollout", "latency_spike")
+            faulted = rec.record("tick", tick=2)
+            assert faulted["fault_window"] == {
+                "scenario": "rollout",
+                "fault": "latency_spike",
+                "phase": "active",
+            }
+        finally:
+            flightrec.clear_fault_window()
+        after = rec.record("tick", tick=3)
+        assert "fault_window" not in after

@@ -11,7 +11,11 @@ from helpers import Env, running_pod
 from karpenter_core_tpu.disruption import engine as engine_mod
 from karpenter_core_tpu.disruption.engine import BatchedDisruptionEngine, engine_mode
 from karpenter_core_tpu.disruption.helpers import get_candidates
+from karpenter_core_tpu.apis.nodeclaim import COND_DRIFTED, COND_EMPTY, COND_EXPIRED
 from karpenter_core_tpu.disruption.methods import (
+    Drift,
+    Emptiness,
+    Expiration,
     MultiNodeConsolidation,
     SingleNodeConsolidation,
     max_parallel,
@@ -531,5 +535,170 @@ class TestSubsetScreenKernel:
             # prefix masks are cumulative within an order
             for (label, k), m in zip(descr, masks):
                 assert int(m.sum()) == k
+        finally:
+            env.stop()
+
+
+class TestConditionChainIdentity:
+    """ISSUE 15: the ordered Expiration → Drift → Emptiness chain decides
+    plan-identically batched vs the sequential oracle across seeds, the
+    no-simulation fast paths stay simulation-free under both engines, and
+    a blocked drain verdict is shared across cohorts."""
+
+    @staticmethod
+    def _mark(env, nc, condition, when):
+        nc.set_condition(condition, "True")
+        nc.get_condition(condition).last_transition_time = when
+        env.kube.apply(nc)
+
+    def _mark_cohort(self, env, condition, seed, want_empty):
+        """Mark every claim whose node emptiness matches ``want_empty``
+        with ``condition`` at spread transition times; returns the marked
+        node names."""
+        from karpenter_core_tpu.utils import pod as podutils
+
+        rng = np.random.RandomState(seed + 999)
+        busy = {
+            p.spec.node_name
+            for p in env.kube.list("Pod")
+            if podutils.is_reschedulable(p)
+        }
+        node_names = {n.spec.provider_id: n.metadata.name for n in env.kube.list("Node")}
+        marked = []
+        for nc in sorted(env.kube.list("NodeClaim"), key=lambda c: c.metadata.name):
+            name = node_names.get(nc.status.provider_id)
+            if (name not in busy) != want_empty:
+                continue
+            self._mark(env, nc, condition, env.now - float(rng.randint(60, 3000)))
+            marked.append(name)
+        return marked
+
+    @staticmethod
+    def _decide(env, mode, method_cls, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DISRUPT_ENGINE", mode)
+        method = method_cls(env.controller.ctx)
+        candidates = get_candidates(
+            env.cluster, env.kube, env.recorder, env.clock, env.provider,
+            method.should_disrupt, env.controller.queue,
+        )
+        return method.compute_command(candidates), method
+
+    @staticmethod
+    def _spy_simulations(monkeypatch):
+        """Fail-fast spy over BOTH simulate_scheduling bindings: the
+        module-level one methods.py imported, and the helpers original the
+        engine re-imports lazily per call."""
+        from karpenter_core_tpu.disruption import helpers as helpers_mod
+        from karpenter_core_tpu.disruption import methods as methods_mod
+
+        calls = []
+
+        def spy(*args, **kwargs):
+            calls.append(args)
+            raise AssertionError("simulate_scheduling on a no-simulation path")
+
+        monkeypatch.setattr(helpers_mod, "simulate_scheduling", spy)
+        monkeypatch.setattr(methods_mod, "simulate_scheduling", spy)
+        return calls
+
+    @pytest.mark.parametrize("method_cls", [Expiration, Drift])
+    @pytest.mark.parametrize("seed", [11, 22, 33])
+    def test_simulating_cohort_identity(self, seed, method_cls, monkeypatch):
+        env = seeded_env(seed)
+        try:
+            marked = self._mark_cohort(env, method_cls.condition, seed, want_empty=False)
+            if not marked:
+                pytest.skip("seed produced no busy nodes")
+            cmd_b, m_b = self._decide(env, "batched", method_cls, monkeypatch)
+            cmd_s, m_s = self._decide(env, "sequential", method_cls, monkeypatch)
+            assert cmd_key(cmd_b) == cmd_key(cmd_s)
+            if cmd_b.action() != ACTION_NOOP:
+                # a real batched decision surfaces cohort-tagged stats
+                assert m_b.last_decision_stats["engine"] == "batched"
+                assert m_b.last_decision_stats["cohort"] == method_cls.type_name
+                assert m_b.last_decision_stats["candidates"] == len(marked)
+            # the sequential oracle path never touches the engine
+            assert m_s.last_decision_stats is None
+        finally:
+            env.stop()
+
+    # seeds chosen so every one actually yields empty nodes
+    @pytest.mark.parametrize("seed", [11, 33, 44])
+    def test_emptiness_cohort_is_simulation_free(self, seed, monkeypatch):
+        """Empty-condition nodes all disrupt in one command with zero
+        scheduling simulations, under both engines."""
+        env = seeded_env(seed)
+        try:
+            marked = self._mark_cohort(env, COND_EMPTY, seed, want_empty=True)
+            if not marked:
+                pytest.skip("seed produced no empty nodes")
+            calls = self._spy_simulations(monkeypatch)
+            cmd_b, _ = self._decide(env, "batched", Emptiness, monkeypatch)
+            cmd_s, _ = self._decide(env, "sequential", Emptiness, monkeypatch)
+            assert cmd_key(cmd_b) == cmd_key(cmd_s)
+            assert sorted(c.name() for c in cmd_b.candidates) == sorted(marked)
+            assert not cmd_b.replacements
+            assert calls == []
+        finally:
+            env.stop()
+
+    def test_unmarked_cluster_is_noop_and_simulation_free(self, monkeypatch):
+        """No condition set anywhere: every cohort no-ops without a single
+        simulation under either engine (the zero-work proof extended to
+        the condition predicates)."""
+        env = seeded_env(11)
+        try:
+            calls = self._spy_simulations(monkeypatch)
+            for method_cls in (Expiration, Drift, Emptiness):
+                for mode in ("batched", "sequential"):
+                    cmd, _ = self._decide(env, mode, method_cls, monkeypatch)
+                    assert cmd.action() == ACTION_NOOP
+            assert calls == []
+        finally:
+            env.stop()
+
+    @pytest.mark.parametrize("method_cls", [Expiration, Drift])
+    def test_blocked_candidate_skipped_identically(self, method_cls, monkeypatch):
+        """A drain whose pods cannot reschedule (oversized pod) sorts
+        first (earliest transition) but is skipped by both engines; the
+        surviving pick is identical."""
+        env = seeded_env(22)
+        try:
+            stuck_node, stuck_nc = env.make_initialized_node(
+                instance_type_name="fake-it-9", pods=[running_pod(cpu="11")]
+            )
+            assert env.cluster.synced()
+            self._mark(env, stuck_nc, method_cls.condition, env.now - 10_000.0)
+            marked = self._mark_cohort(env, method_cls.condition, 22, want_empty=False)
+            if not marked:
+                pytest.skip("seed produced no busy nodes")
+            cmd_b, _ = self._decide(env, "batched", method_cls, monkeypatch)
+            cmd_s, _ = self._decide(env, "sequential", method_cls, monkeypatch)
+            assert cmd_key(cmd_b) == cmd_key(cmd_s)
+            assert stuck_node.metadata.name not in {
+                c.name() for c in cmd_b.candidates
+            }
+        finally:
+            env.stop()
+
+    def test_blocked_verdict_shared_across_cohorts(self, monkeypatch):
+        """The negative drain verdict keys on (generation, world, node) —
+        deliberately NOT the nominating condition — so a candidate that
+        failed to simulate under Expiration is not re-simulated when
+        Drift nominates it at the same generation."""
+        env = seeded_env(33)
+        try:
+            _, stuck_nc = env.make_initialized_node(
+                instance_type_name="fake-it-9", pods=[running_pod(cpu="11")]
+            )
+            assert env.cluster.synced()
+            self._mark(env, stuck_nc, COND_EXPIRED, env.now - 5000.0)
+            self._mark(env, stuck_nc, COND_DRIFTED, env.now - 5000.0)
+            cmd1, m1 = self._decide(env, "batched", Expiration, monkeypatch)
+            assert cmd1.action() == ACTION_NOOP
+            assert m1.last_decision_stats["subsets_verified"] == 1
+            cmd2, m2 = self._decide(env, "batched", Drift, monkeypatch)
+            assert cmd2.action() == ACTION_NOOP
+            assert m2.last_decision_stats["subsets_verified"] == 0
         finally:
             env.stop()

@@ -359,6 +359,100 @@ def test_jnp_host_only(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# clock-discipline fixtures (ISSUE 15)
+
+CLOCK_CONFIG = AnalysisConfig(control_loop_modules=("snippet.py",))
+
+
+def test_clock_positive_wall_arithmetic(tmp_path):
+    code = """
+        import time
+
+        TIMEOUT = 30.0
+
+        def expired(start):
+            return time.time() - start > TIMEOUT
+    """
+    report = run_snippet(tmp_path, code, rules=["clock-discipline"], config=CLOCK_CONFIG)
+    assert len(report.findings) == 1
+    assert "'time.time()'" in report.findings[0].message
+    assert report.findings[0].symbol == "expired"
+
+
+def test_clock_positive_datetime_compare(tmp_path):
+    code = """
+        from datetime import datetime
+
+        def stale(deadline):
+            return datetime.now() > deadline
+    """
+    report = run_snippet(tmp_path, code, rules=["clock-discipline"], config=CLOCK_CONFIG)
+    assert len(report.findings) == 1
+    assert "datetime.now" in report.findings[0].message
+
+
+def test_clock_positive_injectable_default(tmp_path):
+    code = """
+        import time
+
+        class Loop:
+            clock = time.time
+
+            def __init__(self, clock=time.time):
+                self.clock = clock
+    """
+    report = run_snippet(tmp_path, code, rules=["clock-discipline"], config=CLOCK_CONFIG)
+    # the class-level alias AND the parameter default
+    assert len(report.findings) == 2
+    assert all("injectable clock" in f.message for f in report.findings)
+
+
+def test_clock_negative_monotonic_and_stamp(tmp_path):
+    code = """
+        import time
+
+        def elapsed(start):
+            return time.monotonic() - start
+
+        def stamp(rec):
+            rec["wall_clock"] = time.time()  # a record field, no math
+            return rec
+    """
+    report = run_snippet(tmp_path, code, rules=["clock-discipline"], config=CLOCK_CONFIG)
+    assert report.findings == []
+
+
+def test_clock_negative_out_of_scope_module(tmp_path):
+    code = """
+        import time
+
+        def expired(start):
+            return time.time() - start > 5
+    """
+    report = run_snippet(
+        tmp_path, code, rules=["clock-discipline"], config=CLOCK_CONFIG, name="other.py"
+    )
+    assert report.findings == []
+
+
+def test_clock_scoped_marker_suppresses(tmp_path):
+    code = """
+        import time
+
+        LEASE = 15.0
+
+        def lease_expired(renew_time):
+            # analysis: allow-clock(renew_time crosses processes)
+            return time.time() - renew_time > LEASE
+
+        def clock_default(clock=time.time):  # analysis: allow-clock(persisted stamps)
+            return clock
+    """
+    report = run_snippet(tmp_path, code, rules=["clock-discipline"], config=CLOCK_CONFIG)
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip
 
 
