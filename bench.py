@@ -907,6 +907,12 @@ def config7() -> dict:
     identical = 0
     hit_rates = []
     last_warm_stats: dict = {}
+    # ISSUE 16 absolute gate: after the first warm tick has compiled the
+    # tick shape, NO further tick (warm, cold-clone, or no-op) may raise
+    # an XLA compile — steady state means steady executables
+    from karpenter_core_tpu.tracing import deviceplane
+
+    compile_base = None
     for tick in range(ticks):
         mutated = tick > 0 and mutate_every > 0 and tick % mutate_every == 0
         if tick > 0:
@@ -938,6 +944,8 @@ def config7() -> dict:
             os.environ.pop("KARPENTER_TPU_INCREMENTAL", None)
         with nogc():
             res = warm_solver.solve(pods)
+        if compile_base is None:
+            compile_base = deviceplane.compile_count()
         warm_host.append(warm_solver.last_timings["host_ms"])
         warm_wall.append(warm_solver.last_timings["total_ms"])
         ref_uid = {p.uid: i for i, p in enumerate(clone_pods)}
@@ -953,6 +961,9 @@ def config7() -> dict:
         res = warm_solver.solve(pods)
     noop_host = warm_solver.last_timings["host_ms"]
     noop_stats = warm_solver.last_cache_stats or {}
+    warm_tick_recompiles = (
+        deviceplane.compile_count() - compile_base if compile_base is not None else 0
+    )
     gc.unfreeze()
 
     def pct(a, q):
@@ -977,6 +988,10 @@ def config7() -> dict:
         "warm_cache_hit_rate_mean": round(float(np.mean(hit_rates)), 4) if hit_rates else 0.0,
         "warm_cache_hits": last_warm_stats.get("hits", {}),
         "warm_cache_misses": last_warm_stats.get("misses", {}),
+        # ISSUE 16 ledger ceiling 0: XLA compiles raised by any tick
+        # after the first warm tick (recompile events carry the
+        # triggering solve's trace_id — see /debug/device)
+        "warm_tick_recompiles": int(warm_tick_recompiles),
         "nodes": res.node_count,
         # ISSUE 6 satellite: the SLO shape everywhere ticks are driven —
         # here a tick IS one synchronous warm solve, so its decision
@@ -1708,6 +1723,8 @@ def fleet_run(
     arrivals; tenant 0 mutates its catalog before round 1). Timed wall
     covers the solve rounds only — both engines consume identical,
     pre-materialized pod streams."""
+    from karpenter_core_tpu.tracing import deviceplane
+
     os.environ["KARPENTER_TPU_FLEET_ENGINE"] = engine_name
     registry, engine, tenants = fleet_env(n_tenants)
     works = [fleet_work(tenants, pods_each, r) for r in range(rounds)]
@@ -1716,6 +1733,7 @@ def fleet_run(
     dispatch = {"flushes": 0, "pack_calls": 0, "jobs": 0, "max_occupancy": 0}
     wall = 0.0
     per_round_ms = []
+    steady_compile_base = None
     for r, work in enumerate(works):
         if r == 1:
             # mid-stream catalog mutation: tenant 0 ships a new menu
@@ -1725,6 +1743,11 @@ def fleet_run(
             t0 = time.perf_counter()
             outcomes = engine.solve_round(work)
             dt = time.perf_counter() - t0
+        if steady_compile_base is None:
+            # round 0 is the provisioning burst (the warmup shape);
+            # rounds ≥ 1 are the steady churn rounds the ISSUE-16 gate
+            # holds at zero recompiles
+            steady_compile_base = deviceplane.compile_count()
         wall += dt
         per_round_ms.append(round(dt * 1000.0, 1))
         d = engine.last_round.get("dispatch") or {}
@@ -1751,6 +1774,12 @@ def fleet_run(
         "pods_per_sec": round(decided / wall, 1) if wall else 0.0,
         "dispatch": dispatch,
         "plans": plans,
+        # XLA compiles raised during the steady churn rounds (r ≥ 1)
+        "steady_round_recompiles": int(
+            deviceplane.compile_count() - steady_compile_base
+            if steady_compile_base is not None
+            else 0
+        ),
     }
 
 
@@ -1829,6 +1858,12 @@ def config11() -> dict:
         "throughput_over_target": bool(gate_ratio and gate_ratio >= 2.5),
         "plan_identity": f"{identical}/{len(cells)}",
         "plan_identical_all": identical == len(cells),
+        # ISSUE 16 ledger ceiling 0: the identity runs repeat a curve
+        # cell (8 tenants × 200 pods) the process has already compiled —
+        # their steady churn rounds must raise zero XLA compiles
+        "steady_round_recompiles": int(
+            solo_id["steady_round_recompiles"] + bat_id["steady_round_recompiles"]
+        ),
     }
 
 

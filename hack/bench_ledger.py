@@ -149,6 +149,11 @@ ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     ("config15", "stale_plans_emitted", "ceiling", 0.0),
     ("config15", "single_writer_ok_all", "floor", 1.0),
     ("config15", "holds_engaged", "floor", 1.0),
+    # ISSUE 16: the zero-recompile gates — after warmup, steady ticks
+    # (config 7) and steady fleet rounds (config 11) must raise NO XLA
+    # compile events (every event carries its trace_id via /debug/device)
+    ("config7", "warm_tick_recompiles", "ceiling", 0.0),
+    ("config11", "steady_round_recompiles", "ceiling", 0.0),
 ]
 
 
@@ -408,6 +413,27 @@ def check_regressions(
     return failures
 
 
+def stale_lanes(traj: Dict[Tuple[str, str, str], Dict[int, float]]) -> List[dict]:
+    """Backend lanes whose last measured round trails the newest round
+    (ISSUE 16 satellite: promoted from a markdown note to a counted
+    ``--check`` condition — a string of cpu rounds must not silently
+    retire the device lane). Age is in rounds behind the latest."""
+    lane_rounds: Dict[str, set] = {}
+    for (backend, _config, _metric), series in traj.items():
+        lane_rounds.setdefault(backend, set()).update(series.keys())
+    if not lane_rounds:
+        return []
+    latest = max(max(rs) for rs in lane_rounds.values())
+    out: List[dict] = []
+    for backend, rs in sorted(lane_rounds.items()):
+        last = max(rs)
+        if last < latest:
+            out.append(
+                {"backend": backend, "last_round": last, "age_rounds": latest - last}
+            )
+    return out
+
+
 def describe_failure(f: dict) -> str:
     base = f"`{f['config']}/{f['metric']}` ({f['backend']}): r{f['latest_round']:02d} = {f['latest']:g}"
     if f.get("kind") == "relative":
@@ -530,6 +556,7 @@ def build_ledger(bench_dir: str, threshold: float) -> dict:
         ],
         "table": rows,
         "failures": failures,
+        "stale_lanes": stale_lanes(traj),
         "_rounds_full": rounds,  # stripped before writing
         "_traj": traj,
     }
@@ -545,6 +572,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="gate regression threshold as a fraction (default 0.15)")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 when a gate metric regressed vs the best prior round")
+    ap.add_argument("--allow-stale-lanes", action="store_true",
+                    help="demote stale backend lanes from a --check failure to a "
+                         "counted warning (ISSUE 16 satellite)")
     args = ap.parse_args(argv)
 
     ledger = build_ledger(args.dir, args.threshold)
@@ -566,14 +596,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"bench_ledger: {len(rounds)} rounds, {parsed_rows} trajectory rows "
         f"→ {out_path}, {md_path}"
     )
+    stale = ledger.get("stale_lanes") or []
+    for s in stale:
+        print(
+            f"STALE LANE {s['backend']}: last measured r{s['last_round']:02d}, "
+            f"{s['age_rounds']} round(s) behind the latest",
+            file=sys.stderr,
+        )
+    rc = 0
     if ledger["failures"]:
         for f in ledger["failures"]:
             print("REGRESSION " + describe_failure(f), file=sys.stderr)
         if args.check:
-            return 1
-    elif args.check:
-        print("bench_ledger: check passed — no gate regressions")
-    return 0
+            rc = 1
+    if args.check and stale and not args.allow_stale_lanes:
+        print(
+            f"bench_ledger: {len(stale)} stale backend lane(s) — re-run the lane "
+            "or pass --allow-stale-lanes to accept the gap",
+            file=sys.stderr,
+        )
+        rc = 1
+    if args.check and rc == 0:
+        suffix = f" ({len(stale)} stale lane warning(s) allowed)" if stale else ""
+        print(f"bench_ledger: check passed — no gate regressions{suffix}")
+    return rc
 
 
 if __name__ == "__main__":

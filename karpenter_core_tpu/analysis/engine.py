@@ -33,6 +33,19 @@ class AnalysisConfig:
         "karpenter_core_tpu/solver/pallas_kernels.py",
         "karpenter_core_tpu/solver/backends/lp.py",
     )
+    # device-hot solver modules held to the deviceplane registration seam
+    # (ISSUE 16): every jax.jit / shard_map entry point must register
+    # through tracing.deviceplane (observe_jit decorator or wrap() around
+    # the jit call) so recompiles are attributed to the triggering solve
+    jit_registry_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/pack.py",
+        "karpenter_core_tpu/solver/sharding.py",
+        "karpenter_core_tpu/solver/backend.py",
+        "karpenter_core_tpu/solver/kernels.py",
+        "karpenter_core_tpu/solver/pallas_kernels.py",
+        "karpenter_core_tpu/solver/backends/lp.py",
+        "karpenter_core_tpu/disruption/tpu_repack.py",
+    )
     # control-plane packages that must never import jax: a stray jnp op
     # in a controller thread would initialize the backend (and possibly
     # block on a dead TPU plugin) outside the solver's probe/fallback
@@ -293,6 +306,7 @@ def _load_rules() -> None:
             clock,
             hygiene,
             hostsync,
+            jitregistry,
             locks,
             pipelinesafety,
             tracersafety,

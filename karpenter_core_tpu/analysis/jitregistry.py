@@ -1,0 +1,154 @@
+"""jit-registry: every jit entry point in device-hot solver modules must
+register through the device-plane observatory (ISSUE 16).
+
+``tracing/deviceplane.py`` attributes XLA recompiles to the solve that
+triggered them, but only for functions routed through its seam — a
+naked ``jax.jit`` / ``shard_map`` in a hot module compiles invisibly:
+the zero-recompile ledger gates and the warmstore ``jitsig`` inventory
+plane (the ``warmup_compile_only`` prewarmer's shopping list) both go
+blind to it. Two registered forms are accepted:
+
+- decorator form: ``@deviceplane.observe_jit("name", ...)`` stacked
+  anywhere on a function that is (or wraps) jit-decorated;
+- call form: the jit call is the direct argument of
+  ``deviceplane.wrap("name", jax.jit(...))`` (per-call builders in
+  sharding.py, where in/out shardings depend on the live mesh).
+
+Deliberate bypasses (e.g. a throwaway jit inside a test harness helper)
+carry a scoped ``# analysis: allow-jit-registry(<why>)`` marker on the
+flagged line or the line above.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .engine import FileContext, dotted_name, jit_decoration, rule
+from .findings import SEV_ERROR, Finding, scoped_marker_args
+
+#: callables whose invocation creates an XLA-compiled entry point
+_JIT_CALLEES = ("jax.jit", "jit", "shard_map")
+
+
+def _is_jit_registry_scoped(ctx: FileContext) -> bool:
+    return any(ctx.relpath.endswith(m) for m in ctx.config.jit_registry_modules)
+
+
+def _has_observe_decorator(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if dotted_name(target).endswith("observe_jit"):
+            return True
+    return False
+
+
+def _marker_present(ctx: FileContext, lines: Iterable[int]) -> bool:
+    return any(
+        scoped_marker_args(ctx.lines, ln, "jit-registry") is not None for ln in lines
+    )
+
+
+def _jit_call_name(call: ast.Call) -> str:
+    name = dotted_name(call.func)
+    if name in ("jax.jit", "jit") or name.split(".")[-1] == "shard_map":
+        return name
+    return ""
+
+
+@rule(
+    "jit-registry",
+    "jax.jit / shard_map entry points in device-hot solver modules must register "
+    "through tracing.deviceplane (observe_jit / wrap)",
+)
+def check_jit_registry(ctx: FileContext):
+    if not _is_jit_registry_scoped(ctx):
+        return
+
+    # nodes excused from the call-form check: jit calls living inside a
+    # decorator list (the decorator-form check owns those) and jit calls
+    # passed directly to deviceplane.wrap(...)
+    excused: Set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                excused.update(ast.walk(dec))
+        elif isinstance(node, ast.Call) and dotted_name(node.func).endswith(
+            "deviceplane.wrap"
+        ):
+            excused.update(node.args)
+
+    symbols: List = []
+
+    def visit(node: ast.AST, sym: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                child_sym = f"{sym}.{child.name}" if sym else child.name
+                symbols.append((child, child_sym))
+                visit(child, child_sym)
+            else:
+                visit(child, sym)
+
+    visit(ctx.tree, "")
+
+    for node, sym in symbols:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # decorator form: a jit-decorated function needs observe_jit in
+        # the same stack (vmap alone doesn't build an executable)
+        info = jit_decoration(node)
+        if info is not None and info["kind"] == "jit" and not _has_observe_decorator(node):
+            lines = [node.lineno] + [d.lineno for d in node.decorator_list]
+            if not _marker_present(ctx, lines):
+                yield Finding(
+                    rule="jit-registry",
+                    path=ctx.relpath,
+                    line=node.decorator_list[0].lineno if node.decorator_list else node.lineno,
+                    symbol=sym,
+                    message=(
+                        f"jit-decorated '{node.name}' bypasses the deviceplane "
+                        f"registry — stack @deviceplane.observe_jit above the jit "
+                        f"decorator, or mark '# analysis: allow-jit-registry(<why>)'"
+                    ),
+                    severity=SEV_ERROR,
+                )
+
+    # call form: bare jit/shard_map calls outside decorators must be the
+    # direct argument of deviceplane.wrap
+    sym_of = {id(n): s for n, s in symbols}
+
+    def enclosing(node: ast.AST) -> str:
+        return _enclosing.get(id(node), "")
+
+    _enclosing = {}
+
+    def mark(node: ast.AST, sym: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_sym = sym
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                child_sym = sym_of.get(id(child), sym)
+            _enclosing[id(child)] = child_sym
+            mark(child, child_sym)
+
+    mark(ctx.tree, "")
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or node in excused:
+            continue
+        name = _jit_call_name(node)
+        if not name:
+            continue
+        if _marker_present(ctx, [node.lineno]):
+            continue
+        yield Finding(
+            rule="jit-registry",
+            path=ctx.relpath,
+            line=node.lineno,
+            symbol=enclosing(node),
+            message=(
+                f"bare '{name}(...)' call bypasses the deviceplane registry — "
+                f"pass it through deviceplane.wrap(name, {name}(...)), or mark "
+                f"'# analysis: allow-jit-registry(<why>)'"
+            ),
+            severity=SEV_ERROR,
+        )

@@ -29,10 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..scheduling import resources
+from ..solver import devicetime
 from ..solver.encode import build_resource_axis, quantize_capacity, quantize_requests
+from ..tracing import deviceplane
 from .types import Candidate
 
 
+@deviceplane.observe_jit("disrupt.prefix_screen")
 @jax.jit
 def prefix_screen_kernel(
     candidate_loads: jnp.ndarray,  # (N, R) int32 — per-candidate pod request sums
@@ -61,6 +64,7 @@ def prefix_screen_kernel(
     return jnp.all(cum_load <= headroom, axis=-1)
 
 
+@deviceplane.observe_jit("disrupt.subset_screen")
 @jax.jit
 def subset_screen_kernel(
     subset_masks: jnp.ndarray,  # (S, N) bool/float — candidate membership per subset
@@ -93,6 +97,7 @@ def subset_screen_kernel(
     return jnp.all(subset_load <= headroom, axis=-1)
 
 
+@deviceplane.observe_jit("disrupt.single_screen")
 @jax.jit
 def single_screen_kernel(
     candidate_loads: jnp.ndarray,  # (N, R) int32 — per-candidate pod request sums
@@ -196,14 +201,18 @@ def screen_singles(ctx, candidates: List[Candidate]) -> np.ndarray:
     if s_new is not None:
         fleet_free = np.concatenate([fleet_free, fleet_ext()])
         new_node_cap = np.concatenate([new_node_cap, s_new])
-    return np.asarray(
-        single_screen_kernel(
-            jnp.asarray(loads),
-            jnp.asarray(free),
-            jnp.asarray(fleet_free),
-            jnp.asarray(new_node_cap),
+    with devicetime.track(phase="screen"):
+        devicetime.transfer("h2d", loads, free, fleet_free, new_node_cap, phase="screen")
+        out = np.asarray(
+            single_screen_kernel(
+                jnp.asarray(loads),
+                jnp.asarray(free),
+                jnp.asarray(fleet_free),
+                jnp.asarray(new_node_cap),
+            )
         )
-    )
+    devicetime.transfer("d2h", out, phase="screen")
+    return out
 
 
 def screen_subsets(ctx, candidates: List[Candidate], masks: np.ndarray) -> np.ndarray:
@@ -225,15 +234,21 @@ def screen_subsets(ctx, candidates: List[Candidate], masks: np.ndarray) -> np.nd
     if s_new is not None:
         fleet_free = np.concatenate([fleet_free, fleet_ext()])
         new_node_cap = np.concatenate([new_node_cap, s_new])
-    return np.asarray(
-        subset_screen_kernel(
-            jnp.asarray(masks.astype(np.float32)),
-            jnp.asarray(loads),
-            jnp.asarray(free),
-            jnp.asarray(fleet_free),
-            jnp.asarray(new_node_cap),
+    with devicetime.track(phase="screen"):
+        devicetime.transfer(
+            "h2d", masks, loads, free, fleet_free, new_node_cap, phase="screen"
         )
-    )
+        out = np.asarray(
+            subset_screen_kernel(
+                jnp.asarray(masks.astype(np.float32)),
+                jnp.asarray(loads),
+                jnp.asarray(free),
+                jnp.asarray(fleet_free),
+                jnp.asarray(new_node_cap),
+            )
+        )
+    devicetime.transfer("d2h", out, phase="screen")
+    return out
 
 
 def _fleet_free(ctx, axis, candidate_names) -> np.ndarray:
@@ -421,14 +436,17 @@ def screen_prefixes(ctx, candidates: List[Candidate]) -> int:
         fleet_free = np.concatenate([fleet_free, fleet_ext()])
         new_node_cap = np.concatenate([new_node_cap, s_new])
 
-    feasible = np.asarray(
-        prefix_screen_kernel(
-            jnp.asarray(loads),
-            jnp.asarray(free),
-            jnp.asarray(fleet_free),
-            jnp.asarray(new_node_cap),
+    with devicetime.track(phase="screen"):
+        devicetime.transfer("h2d", loads, free, fleet_free, new_node_cap, phase="screen")
+        feasible = np.asarray(
+            prefix_screen_kernel(
+                jnp.asarray(loads),
+                jnp.asarray(free),
+                jnp.asarray(fleet_free),
+                jnp.asarray(new_node_cap),
+            )
         )
-    )
+    devicetime.transfer("d2h", feasible, phase="screen")
     if not feasible.any():
         return 0
     # prefix sizes are 1-indexed; find the largest feasible prefix

@@ -358,6 +358,26 @@ class Metrics:
             "Warm-state snapshot entries dropped per cache plane (version/contract/fingerprint witness mismatch — never trusted)",
             ["plane"],
         )
+        # device-plane observatory (tracing/deviceplane.py, ISSUE 16):
+        # XLA compile events attributed per jit entry point and cause
+        # (first | new_shape | new_config — trace_id exemplars ride
+        # /debug/device and the stats device block, never the classic
+        # text exposition), H2D/D2H transfer bytes per solve phase, and
+        # the device-memory high-water mark of the last polled solve
+        self.xla_compiles = r.counter(
+            f"{ns}_tpu_xla_compiles_total",
+            "XLA compiles observed at registered jit entry points, by function and cause (first | new_shape | new_config); trace_id exemplars via /debug/device",
+            ["fn", "cause"],
+        )
+        self.transfer_bytes = r.counter(
+            f"{ns}_tpu_solver_transfer_bytes_total",
+            "Host<->device bytes moved by solver dispatches, by direction (h2d | d2h) and solve phase",
+            ["direction", "phase"],
+        )
+        self.hbm_high_water = r.gauge(
+            f"{ns}_tpu_hbm_high_water_bytes",
+            "Device-memory high-water mark polled at the end of the last solve (peak_bytes_in_use; absent off-accelerator)",
+        )
         # serving pipeline (serving/pipeline.py): the decision-latency
         # SLO (pod-pending → plan emitted), per-stage durations, and
         # stage-queue depths (backpressure visibility)

@@ -103,6 +103,22 @@ def _traces_last(_query) -> Tuple[int, str, str]:
     return 200, "application/json", to_chrome_json([tr])
 
 
+def _device(query) -> Tuple[int, str, str]:
+    """Device-plane observatory (tracing/deviceplane.py, ISSUE 16): the
+    jit-signature registry, process compile/transfer totals, and the
+    recent compile events carrying trace_id exemplars. ``?tail=N``
+    bounds the event list (default 32)."""
+    import json
+
+    from ..tracing import deviceplane
+
+    try:
+        tail = int(query.get("tail", ["32"])[0])
+    except ValueError:
+        return 400, "text/plain", "bad tail parameter\n"
+    return 200, "application/json", json.dumps(deviceplane.debug_state(tail=tail), default=str)
+
+
 def _decisions(query) -> Tuple[int, str, str]:
     """The flight recorder's ring (tracing/flightrec.py): per-decision
     records with SLO burn rates and timeline-reconstruction coverage.
@@ -283,6 +299,9 @@ class OperationalServer:
             # trace ring: the routes only read the bounded ring
             "/debug/decisions": _decisions,
             "/debug/decisions/last": _decisions_last,
+            # the device plane is always on for the same reason: the
+            # registry is bounded module state, the route only reads it
+            "/debug/device": _device,
         }
         if self.serving_state is not None:
             metrics_routes["/debug/serving"] = self._serving

@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import devicetime, incremental
-from ...tracing import tracer
+from ...tracing import deviceplane, tracer
 from . import PackBackend, job_prices
 
 _BIG = np.float32(1e12)  # padded/unavailable-type price: finite, never argmin
@@ -61,6 +61,7 @@ def _pow2(n: int, floor: int = 8) -> int:
     return max(floor, 1 << max(0, (n - 1)).bit_length())
 
 
+@deviceplane.observe_jit("lp.dual_ascent", static_names=("iters",))
 @partial(jax.jit, static_argnames=("iters",))
 def _dual_ascent_kernel(reqs, counts, alloc, prices, valid, iters: int):
     """Batched dual ascent, pure JAX (padded to size classes so compiles
@@ -162,7 +163,13 @@ def relax(
     prices_p[:T] = np.minimum(prices, _BIG)
     valid_p = np.zeros(T_pad, dtype=bool)
     valid_p[:T] = np.asarray(prices) < _BIG
-    with devicetime.track():
+    deviceplane.record_footprint(
+        deviceplane.nbytes_of(reqs_p, counts_p, alloc_p, prices_p, valid_p)
+    )
+    with devicetime.track(phase="lp"):
+        devicetime.transfer(
+            "h2d", reqs_p, counts_p, alloc_p, prices_p, valid_p, phase="lp"
+        )
         w, t_star, has_fit = _dual_ascent_kernel(
             jnp.asarray(reqs_p),
             jnp.asarray(counts_p),
@@ -175,6 +182,7 @@ def relax(
         w = np.asarray(w)  # analysis: allow-host-sync
         t_star = np.asarray(t_star)[:S]  # analysis: allow-host-sync
         has_fit = np.asarray(has_fit)[:S]  # analysis: allow-host-sync
+    devicetime.transfer("d2h", w, t_star, has_fit, phase="lp")
     real = valid_p[:T]
     bound = _host_bound(
         w[:T][real].astype(np.float64),

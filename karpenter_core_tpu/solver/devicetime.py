@@ -7,6 +7,17 @@ device boundary (dispatch, transfer, blocking conversion) runs under
 transfer + time blocked waiting on device results — not on-chip
 execution time (XLA overlaps that with host work by design; an exact
 split needs the xprof trace, KARPENTER_TPU_PROFILE_DIR).
+
+ISSUE 16 extends the seam in two directions:
+
+- ``track(phase=...)`` labels each ``device_wait`` span with the solve
+  phase it belongs to (pack, shard, lp, screen, existing) so the
+  host/device split in ``phase_breakdown_ms`` attributes correctly, and
+  ``transfer()`` rides the same boundary to account H2D/D2H bytes per
+  phase into the device plane (tracing/deviceplane.py).
+- ``device_memory_stats()`` polls the backend's HBM watermarks. It
+  lives HERE, not in deviceplane, because the tracing tier is host-only
+  by rule (jnp-host-only): jax stays behind the solver boundary.
 """
 
 from __future__ import annotations
@@ -14,7 +25,9 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+from typing import Optional
 
+from ..tracing import deviceplane
 from ..tracing.tracer import span as _span
 
 _tls = threading.local()
@@ -29,13 +42,49 @@ def seconds() -> float:
 
 
 @contextmanager
-def track():
+def track(phase: str = "solve"):
     """Accumulate device-attributable time; under an active solve trace
-    each tracked region is also a ``device_wait`` span, so the exported
-    trace shows *where* in the host pipeline the device waits sit."""
+    each tracked region is also a ``device_wait`` span (labeled with its
+    solve ``phase``), so the exported trace shows *where* in the host
+    pipeline the device waits sit."""
     t0 = time.perf_counter()
     try:
-        with _span("device_wait"):
+        with _span("device_wait", phase=phase):
             yield
     finally:
         _tls.seconds = getattr(_tls, "seconds", 0.0) + (time.perf_counter() - t0)
+
+
+def transfer(direction: str, *arrays, phase: str = "solve", nbytes: Optional[int] = None) -> None:
+    """Account one host/device transfer at a tracked boundary:
+    ``direction`` is ``h2d`` (arguments shipped to the device) or
+    ``d2h`` (results synced back). Pass the arrays themselves (sized
+    duck-typed) or an explicit ``nbytes``."""
+    n = nbytes if nbytes is not None else deviceplane.nbytes_of(*arrays)
+    deviceplane.record_transfer(direction, n, phase=phase)
+
+
+def device_memory_stats() -> Optional[dict]:
+    """HBM watermarks of device 0, where the backend exposes them
+    (TPU PJRT does; cpu returns None and the device block falls back to
+    the padded-footprint estimate). Never raises — telemetry must not
+    take down a solve."""
+    if not deviceplane.enabled():
+        return None
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        stats = getattr(devices[0], "memory_stats", None)
+        raw = stats() if callable(stats) else None
+        if not raw:
+            return None
+        out = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit", "largest_alloc_size"):
+            if key in raw:
+                out[key] = int(raw[key])
+        return out or None
+    except Exception:  # noqa: BLE001 — a missing/odd backend degrades to "no HBM numbers"
+        return None

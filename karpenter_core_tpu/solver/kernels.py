@@ -18,6 +18,7 @@ import numpy as np
 
 from .contracts import contract
 from .encode import EncodedInstanceTypes, SignaturePoolCompat
+from ..tracing import deviceplane
 
 
 def _compat_example(dims):
@@ -85,6 +86,7 @@ def build_compat_inputs(
     return arrays
 
 
+@deviceplane.observe_jit("kernels.compat_kernel", static_names=("keys",))
 @contract(None, None, None, None, out="S T", example=_compat_example)
 @partial(jax.jit, static_argnames=("keys",))
 def compat_kernel(
@@ -109,6 +111,7 @@ def compat_kernel(
     return ok
 
 
+@deviceplane.observe_jit("kernels.offering_kernel")
 @contract("S Z", "S C", "T Z C", dtypes=("b1", "b1", "b1"), out="S T")
 @jax.jit
 def offering_kernel(
@@ -123,6 +126,7 @@ def offering_kernel(
     return jnp.einsum("szc,tzc->st", pair_ok.astype(jnp.float32), avail.astype(jnp.float32)) > 0
 
 
+@deviceplane.observe_jit("kernels.allowed_kernel", static_names=("keys",))
 @contract(None, None, None, None, "S Z", "S C", "T Z C", out="S T", example=_allowed_example)
 @partial(jax.jit, static_argnames=("keys",))
 def allowed_kernel(

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from . import devicetime
 from .contracts import contract
-from ..tracing import tracer
+from ..tracing import deviceplane, tracer
 import numpy as np
 
 INT_INF = np.int32(2**31 - 1)
@@ -68,6 +68,7 @@ def pareto_frontier(allocatable: np.ndarray) -> np.ndarray:
     return buf[:n].astype(np.int32)
 
 
+@deviceplane.observe_jit("pack.ffd_pack", static_names=("k_open",))
 @contract("P R", "F R", "()", out=("P", "()"))
 @partial(jax.jit, static_argnames=("k_open",))
 def ffd_pack(
@@ -142,6 +143,7 @@ def ffd_pack(
     return node_ids, final["next_id"]
 
 
+@deviceplane.observe_jit("pack.pack_existing")
 @contract("P R", "P", "S M", "M R", dtypes=("i4", "i4", "b1", "i4"), out=("P", "M R"))
 @jax.jit
 def pack_existing(
@@ -203,14 +205,18 @@ def _run_pack_existing(
 
     default_backend()  # device boundary: pin/probe before the first jnp
     # op so a dead TPU plugin costs a bounded fallback, not a hang
-    assign, free_out = pack_existing(
-        jnp.asarray(requests),
-        jnp.asarray(sig_ids),
-        jnp.asarray(compat.astype(bool)),
-        jnp.asarray(free),
-    )
-    # analysis: allow-host-sync — the ONE intended sync of this dispatch
-    return np.asarray(assign), np.asarray(free_out)
+    with devicetime.track(phase="existing"):
+        devicetime.transfer("h2d", requests, sig_ids, compat, free, phase="existing")
+        assign, free_out = pack_existing(
+            jnp.asarray(requests),
+            jnp.asarray(sig_ids),
+            jnp.asarray(compat.astype(bool)),
+            jnp.asarray(free),
+        )
+        # analysis: allow-host-sync — the ONE intended sync of this dispatch
+        assign, free_out = np.asarray(assign), np.asarray(free_out)
+    devicetime.transfer("d2h", assign, free_out, phase="existing")
+    return assign, free_out
 
 
 @contract("N R", "T R", "T", out="N", eval_shape=False)
@@ -246,6 +252,7 @@ def assign_cheapest_types(
     return best
 
 
+@deviceplane.observe_jit("pack.ffd_pack_batched", static_names=("k_open",))
 @contract("G P R", "G F R", "G", out=("G P", "G"))
 @partial(jax.jit, static_argnames=("k_open",))
 def ffd_pack_batched(
@@ -359,13 +366,16 @@ def _batch_pack(jobs: list, engine: str, mesh) -> list:
             requests[slot, : reqs.shape[0]] = reqs
             frontiers[slot, : len(frontier)] = frontier
             caps[slot] = cap
-        with devicetime.track():
+        deviceplane.record_footprint(deviceplane.nbytes_of(requests, frontiers, caps))
+        with devicetime.track(phase="pack"):
+            devicetime.transfer("h2d", requests, frontiers, caps, phase="pack")
             node_ids, counts = ffd_pack_batched(
                 jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
             )
             # one sync per size class, after the batched dispatch
             node_ids = np.asarray(node_ids)  # analysis: allow-host-sync
             counts = np.asarray(counts)  # analysis: allow-host-sync
+        devicetime.transfer("d2h", node_ids, counts, phase="pack")
         for slot, g in enumerate(members):
             results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
     return results
@@ -398,13 +408,16 @@ def _batch_pack_sharded(mesh, jobs: list) -> list:
             requests[slot, : reqs.shape[0]] = reqs
             frontiers[slot, : len(frontier)] = frontier
             caps[slot] = cap
-        with devicetime.track():
+        deviceplane.record_footprint(deviceplane.nbytes_of(requests, frontiers, caps))
+        with devicetime.track(phase="shard"):
+            devicetime.transfer("h2d", requests, frontiers, caps, phase="shard")
             node_ids, counts, _fleet = sharded_batch_pack(
                 mesh, jnp.asarray(requests), jnp.asarray(frontiers), jnp.asarray(caps)
             )
             # one sync per size class, after the mesh-sharded dispatch
             node_ids = np.asarray(node_ids)  # analysis: allow-host-sync
             counts = np.asarray(counts)  # analysis: allow-host-sync
+        devicetime.transfer("d2h", node_ids, counts, phase="shard")
         for slot, g in enumerate(members):
             results[g] = (node_ids[slot, : jobs[g][0].shape[0]], int(counts[slot]))
     return results

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..tracing import deviceplane
+
 LANE = 128  # TPU lane width; per-key chunks are padded to this
 TILE_S = 128
 TILE_T = 128
@@ -109,6 +111,7 @@ def _compat_tile_kernel(
     out_ref[:] = ok.astype(jnp.float32)
 
 
+@deviceplane.observe_jit("pallas.compat_pallas", static_names=("offsets", "widths", "interpret"))
 @functools.partial(
     jax.jit, static_argnames=("offsets", "widths", "interpret")
 )
@@ -163,6 +166,7 @@ def compat_pallas(
     return out[:S, :T] > 0.0
 
 
+@deviceplane.observe_jit("pallas.allowed_pallas", static_names=("offsets", "widths", "interpret"))
 @functools.partial(jax.jit, static_argnames=("offsets", "widths", "interpret"))
 def allowed_pallas(
     sig_packed: jnp.ndarray,  # (S, W) f32
@@ -215,8 +219,14 @@ def compat_via_pallas(
     rows = []
     # row-blocked over signatures: each dispatch's padded (Sp, Tp) f32
     # output stays under the tile budget; the type side uploads once
+    Tp_est = -(-T // TILE_T) * TILE_T
     for s0 in range(0, max(S, 1), block):
         s1 = min(s0 + block, S)
+        # the budgeted transient: this dispatch's padded (Sp, Tp) f32
+        # output — reported so tile headroom vs KARPENTER_TPU_COMPAT_TILE_MB
+        # is a per-solve observable (ISSUE 16)
+        Sp_est = -(-max(s1 - s0, 1) // TILE_S) * TILE_S
+        deviceplane.record_footprint(Sp_est * Tp_est * 4)
         rows.append(
             compat_pallas(
                 jnp.asarray(sp[s0:s1]),

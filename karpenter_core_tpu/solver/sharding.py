@@ -54,7 +54,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import devicetime
 from .pack import ffd_pack, ffd_pack_batched
-from ..tracing import tracer
+from ..tracing import deviceplane, tracer
 
 # jax.shard_map landed at top level only in newer jax; older images ship
 # it under jax.experimental.shard_map. Feature-detect once so the
@@ -224,7 +224,7 @@ def _sharded_pack_fn(mesh: Mesh):
         in_specs=(P("groups"), P("groups"), P("groups")),
         out_specs=(P("groups"), P("groups"), P()),
     )
-    return jax.jit(shard(per_device))
+    return deviceplane.wrap("sharding.sharded_batch_pack", jax.jit(shard(per_device)))
 
 
 def sharded_batch_pack(
@@ -287,7 +287,9 @@ def sharded_pod_pack(
     with tracer.span(
         "pack.shard.dispatch", pods=P, chunks=D, chunk_len=Pc, engine=engine
     ):
-        with devicetime.track():
+        deviceplane.record_footprint(deviceplane.nbytes_of(reqs, fronts, caps))
+        with devicetime.track(phase="shard"):
+            devicetime.transfer("h2d", reqs, fronts, caps, phase="shard")
             if engine == "sharded":
                 ids, counts, _fleet = sharded_batch_pack(
                     mesh, jnp.asarray(reqs), jnp.asarray(fronts), jnp.asarray(caps)
@@ -299,6 +301,7 @@ def sharded_pod_pack(
             # the ONE host sync of the mega dispatch, after all chunks
             ids = np.asarray(ids)  # analysis: allow-host-sync
             counts = np.asarray(counts, dtype=np.int64)  # analysis: allow-host-sync
+        devicetime.transfer("d2h", ids, counts, phase="shard")
     offsets = np.zeros(D, dtype=np.int64)
     np.cumsum(counts[:-1], out=offsets[1:])
     gids = np.where(ids >= 0, ids + offsets[:, None].astype(np.int32), -1)
@@ -486,7 +489,7 @@ def sharded_prefix_screen(
         in_specs=(P(axis), P(axis), P(axis), P()),
         out_specs=P(axis),
     )
-    return jax.jit(shard(per_device))(
+    return deviceplane.wrap("sharding.sharded_prefix_screen", jax.jit(shard(per_device)))(
         candidate_loads, candidate_free, fleet_free_local, new_node_cap
     )
 
@@ -525,6 +528,9 @@ def prepare_sharded_catalog(
     th = {k: jax.device_put(pad_t(v), sh) for k, v in type_has.items()}
     tn = {k: jax.device_put(pad_t(v), sh) for k, v in type_neg.items()}
     av = jax.device_put(pad_t(avail), sh)
+    devicetime.transfer(
+        "h2d", *tm.values(), *th.values(), *tn.values(), av, phase="shard"
+    )
     return tm, th, tn, av, T
 
 
@@ -563,12 +569,15 @@ def sharded_compat(
     """Type-axis-sharded overlap matmul: each device holds a T-shard,
     XLA all-gathers the (S, T) result from the output sharding."""
     axis = mesh.axis_names[0]
-    jitted = jax.jit(
-        lambda q, m: q @ m.T,
-        in_shardings=(
-            NamedSharding(mesh, P()),  # signatures replicated
-            NamedSharding(mesh, P(axis)),  # types sharded
+    jitted = deviceplane.wrap(
+        "sharding.sharded_compat",
+        jax.jit(
+            lambda q, m: q @ m.T,
+            in_shardings=(
+                NamedSharding(mesh, P()),  # signatures replicated
+                NamedSharding(mesh, P(axis)),  # types sharded
+            ),
+            out_shardings=NamedSharding(mesh, P(None, axis)),
         ),
-        out_shardings=NamedSharding(mesh, P(None, axis)),
     )
     return jitted(sig_masks, type_masks)

@@ -76,7 +76,7 @@ from .encode import (
 from .kernels import allowed_host, allowed_kernel, build_compat_inputs, zone_ct_masks
 from . import devicetime, incremental
 from .stablehash import feed as stable_feed, stable_hash
-from ..tracing import tracer
+from ..tracing import deviceplane, tracer
 from .pack import (
     assign_cheapest_types,
     batch_pack,
@@ -690,6 +690,12 @@ class TPUScheduler:
         # most recent snapshot/restore outcome — /debug/solve/stats
         # "warmstore" block (stats.py SCHEMA=4) + bench `_split`
         self.last_warmstore_stats: Optional[dict] = None
+        # device-plane observatory (ISSUE 16, tracing/deviceplane.py):
+        # per-solve compile/transfer/HBM attribution — /debug/solve/stats
+        # "device" block (stats.py SCHEMA=5), flight-recorder records,
+        # bench `_split`; None when the plane is disabled or the solve
+        # never dispatched
+        self.last_device_stats: Optional[dict] = None
 
     # ------------------------------------------------------------------
 
@@ -722,6 +728,7 @@ class TPUScheduler:
         profile_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
         t0 = _time.perf_counter()
         devicetime.reset()
+        deviceplane.reset_solve()
         sink = self.metrics.solver_phase_duration if self.metrics is not None else None
         with tracer.trace_root(
             "solve", metrics_sink=sink, buffer_if="solve", is_solve=True, pods=len(pods)
@@ -788,6 +795,42 @@ class TPUScheduler:
                                 self.metrics.shard_padding_waste.set(
                                     float(waste), axis=axis
                                 )
+                # device-plane drain (ISSUE 16): compile attribution,
+                # transfer bytes, and HBM watermark for THIS solve —
+                # per-solve stats field, trace args, and the xla-compile/
+                # transfer/HBM metrics (recompiles are never silent)
+                device_stats = deviceplane.consume_solve(
+                    memory=devicetime.device_memory_stats()
+                )
+                self.last_device_stats = device_stats
+                if device_stats:
+                    if tr is not None:
+                        tr.args["device"] = {
+                            k: v
+                            for k, v in device_stats.items()
+                            if k != "compile_events"
+                        }
+                    if self.metrics is not None:
+                        for ev in device_stats.get("compile_events", ()):
+                            if hasattr(self.metrics, "xla_compiles"):
+                                self.metrics.xla_compiles.inc(
+                                    1, fn=ev["fn"], cause=ev["cause"]
+                                )
+                        for phase, dirs in device_stats.get(
+                            "transfer_by_phase", {}
+                        ).items():
+                            for direction, nbytes in dirs.items():
+                                if hasattr(self.metrics, "transfer_bytes"):
+                                    self.metrics.transfer_bytes.inc(
+                                        nbytes, direction=direction, phase=phase
+                                    )
+                        hbm = device_stats.get("hbm")
+                        if hbm and hasattr(self.metrics, "hbm_high_water"):
+                            peak = hbm.get("peak_bytes_in_use") or hbm.get(
+                                "bytes_in_use"
+                            )
+                            if peak is not None:
+                                self.metrics.hbm_high_water.set(float(peak))
                 if self.metrics is not None:
                     self.metrics.solver_duration.observe(total)
                     self.metrics.solver_device_duration.observe(device)

@@ -20,10 +20,11 @@ from __future__ import annotations
 
 from typing import Optional
 
-SCHEMA = 4  # 4: "warmstore" block (snapshot/restore outcome — per-plane
-# restored/dropped counts, ISSUE 13); 3: "route" block added (tensor/
-# parked/oracle pod split per solve + oracle share, ISSUE 12); 2:
-# "shard" block (mesh padding)
+SCHEMA = 5  # 5: "device" block (compile/transfer/HBM attribution per
+# solve, ISSUE 16); 4: "warmstore" block (snapshot/restore outcome —
+# per-plane restored/dropped counts, ISSUE 13); 3: "route" block added
+# (tensor/parked/oracle pod split per solve + oracle share, ISSUE 12);
+# 2: "shard" block (mesh padding)
 
 
 def _round3(v) -> float:
@@ -68,6 +69,7 @@ def solve_stats(solver, disruption=None) -> dict:
         "route": dict(rs) if (rs := getattr(solver, "last_route_stats", None)) else None,
         "disruption": dict(dstats) if dstats else None,
         "warmstore": _warmstore_block(solver),
+        "device": dict(ds) if (ds := getattr(solver, "last_device_stats", None)) else None,
     }
 
 
@@ -113,6 +115,15 @@ def bench_fields(stats: dict) -> dict:
     wss = stats.get("warmstore")
     if wss:
         out["warmstore"] = dict(wss)
+    dev = stats.get("device")
+    if dev:
+        # compact projection: the event list stays on the debug route
+        out["device"] = {
+            "compiles": dev.get("compiles", 0),
+            "transfer_bytes": dict(dev.get("transfer_bytes", {})),
+            "footprint_bytes": dev.get("footprint_bytes", 0),
+            "tile_headroom_frac": dev.get("tile_headroom_frac"),
+        }
     merge = stats.get("merge", {})
     out["merge_ms"] = round(merge.get("ms", 0.0), 2)
     out["merge_candidates_screened"] = merge.get("candidates_screened", 0)

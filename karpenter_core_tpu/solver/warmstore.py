@@ -30,6 +30,13 @@ What persists, per plane (the "snapshot contents" table in README):
   plane re-anchors to the LIVE generation — the persisted counter value
   is another process's counter and witnesses nothing here.
 - **intersects memo**: fingerprint-addressed, persisted as-is.
+- **jit-signature inventory** (``tracing/deviceplane.py``, ISSUE 16):
+  the abstract call-signature population of every registered jit entry
+  point — what ROADMAP item 2's ``warmup_compile_only`` prewarmer will
+  replay. Witnessed on restore by the live registry: a row only lands
+  on a function this process registered through ``deviceplane.wrap()``
+  with the same static-argname contract; everything else is dropped
+  and counted like any other plane.
 - **fleet content planes** (``fleetenv``/``fleetcanon``/``fleetjob``,
   fleet/megasolve.py): restored through the same job-key rebinding; the
   per-tenant variant (``FleetRegistry.snapshot_tenant``) gives tenant
@@ -62,7 +69,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..tracing import tracer
+from ..tracing import deviceplane, tracer
 from . import incremental, podcache
 from .stablehash import stable_hash
 
@@ -85,6 +92,7 @@ _KEY_CONTRACT = (
     ("seeds", "(constraint key..., exclusion uids, sim_drained, tenant scope) -> domain counts; plane guard = cluster witness"),
     ("intersects", "(reqs fp, reqs fp) -> bool"),
     ("fleetjob", "tenant-free job-key content prefix -> JobSkeleton"),
+    ("jitsig", "(fn name, static-argname tuple) -> abstract signature keys (deviceplane inventory)"),
 )
 CONTRACT = stable_hash(_KEY_CONTRACT).hex()
 
@@ -94,9 +102,9 @@ _MAGIC = b"KTPU-WARMSTORE\n"
 # KARPENTER_TPU_WARMSTORE_MAX_MB the cheapest-to-recompute planes drop
 # first (screen rows re-derive from the merge pass; catalogs last — they
 # are the single biggest cold-solve cost)
-_TRIM_ORDER = ("screen_rows", "emits", "merges", "intersects", "jobs", "routes", "seeds", "catalogs")
+_TRIM_ORDER = ("jitsigs", "screen_rows", "emits", "merges", "intersects", "jobs", "routes", "seeds", "catalogs")
 
-_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob")
+_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob", "jitsig")
 
 # most recent snapshot/restore outcome (observability; guarded — the
 # serving pipeline snapshots from its plan thread while debug routes
@@ -282,6 +290,9 @@ def build_payload(solver) -> dict:
         "screen_rows": [],
         "seeds": {"witness": None, "generation": None, "entries": []},
         "intersects": [],
+        # jit-signature inventory (ISSUE 16): keys only — counts and
+        # compile history stay process-local
+        "jitsigs": deviceplane.export_signatures(),
     }
     if ws is None:
         return payload
@@ -355,6 +366,7 @@ def _plane_counts(payload: dict) -> dict:
         "mergerow": len(payload.get("screen_rows", ())),
         "seeds": len((payload.get("seeds") or {}).get("entries", ())),
         "intersects": len(payload.get("intersects", ())),
+        "jitsig": sum(len(r[2]) for r in payload.get("jitsigs", ()) if len(r) == 3),
     }
 
 
@@ -694,6 +706,15 @@ def _restore_under_root(solver, path: str, metrics, fleet_plane, out: "_Outcome"
                 inter[key] = verdict
                 n_inter += 1
         out.ok("intersects", n_inter)
+
+        # jit-signature inventory (ISSUE 16): witnessed inside
+        # import_signatures — a row restores only onto a live wrap()
+        # registration with the same static-argname contract
+        jitsig_rows = payload.get("jitsigs", ())
+        if jitsig_rows:
+            n_ok, n_drop = deviceplane.import_signatures(jitsig_rows)
+            out.ok("jitsig", n_ok)
+            out.drop("jitsig", n_drop)
     except Exception:  # noqa: BLE001 — a corrupt plane degrades to cold, never crashes the caller
         log.exception("warmstore restore failed; remaining planes dropped")
         out.reason = "restore error (see logs)"
@@ -767,6 +788,7 @@ def simulate_process_death() -> None:
         _CATALOG_CACHE.clear()
     incremental.reset()
     podcache.reset_process()
+    deviceplane.reset()
     with _LAST_LOCK:
         _LAST["snapshot"] = None
         _LAST["restore"] = None

@@ -1,0 +1,516 @@
+"""Device-plane observatory (ISSUE 16 tentpole): compile/recompile
+attribution, transfer accounting, and HBM telemetry for every solve.
+
+All telemetry before this module was host-side (PR 1 tracing, PR 10
+flight recorder): XLA compilation, H2D/D2H transfer volume, and
+device-memory behavior were invisible — exactly why the Pallas tile
+budget was calibrated blind and the warmstore still pays full
+per-process compiles after restore (ROADMAP item 2). This module makes
+them first-class, per-decision observables:
+
+- **jit-signature registry.** Every jit/shard_map entry point in the
+  solver hot path registers through ``wrap()`` (or the ``observe_jit``
+  decorator form). The wrapper records, per function, the population of
+  abstract call signatures — array args as ``(shape, dtype)``, the rest
+  as static-config reprs — with call counts and the wall time of each
+  signature's first call (the compile-bearing call: jax caches
+  executables per abstract signature, so a signature's first arrival IS
+  the compile). The registry is what ROADMAP item 2's
+  ``warmup_compile_only`` prewarmer will replay; it persists through
+  the warmstore snapshot as the ``jitsig`` inventory plane.
+- **recompile attribution.** A new signature raises a compile event with
+  a cause (``first`` — the function's first signature ever,
+  ``new_shape`` — the abstract array shapes changed, ``new_config`` —
+  shapes match a known signature but the static config differs) and the
+  triggering solve's trace_id, which rides the event as the exemplar on
+  ``karpenter_tpu_xla_compiles_total{fn,cause}`` (exemplars are served
+  through ``/debug/device`` and the stats ``device`` block — the classic
+  text exposition stays exemplar-free, like the histogram exemplars).
+- **transfer accounting.** ``record_transfer(direction, nbytes, phase)``
+  rides the ``devicetime.track(phase=...)`` seam: every tracked device
+  boundary reports the bytes it moved, split H2D/D2H per solve phase
+  (``karpenter_tpu_solver_transfer_bytes_total{direction,phase}``).
+- **HBM telemetry.** The solver polls device memory watermarks at solve
+  end (``devicetime.device_memory_stats`` — this module must stay
+  jax-free, it lives in the host-only tracing tier) and pairs them with
+  the padded-buffer footprint estimate the kernels report
+  (``record_footprint``), compared against the
+  ``KARPENTER_TPU_COMPAT_TILE_MB`` budget so tile headroom is a number
+  instead of a guess.
+
+Per-solve attribution follows the sharding pad-stats pattern: the
+solver calls ``reset_solve()`` at solve entry and drains
+``consume_solve()`` in the solve's finally block into
+``solver.last_device_stats`` → stats.py SCHEMA=5 ``device`` block →
+flight recorder / bench ``_split`` / ledger. Process-global totals
+(``compile_count()``, ``totals()``, ``debug_state()``) back the bench
+zero-recompile gates and the ``/debug/device`` route.
+
+Knob: ``KARPENTER_TPU_DEVICEPLANE=0`` disables everything — wrapped
+functions dispatch straight through, reset/consume are no-ops.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+CAUSE_FIRST = "first"
+CAUSE_NEW_SHAPE = "new_shape"
+CAUSE_NEW_CONFIG = "new_config"
+
+# newest-wins ring of compile events for /debug/device exemplars
+_EVENTS_KEEP = 256
+# per-function signature population cap: the registry is an inventory,
+# not a cache — a function cycling through unbounded shapes is itself
+# the pathology the compile counter surfaces, so cap the roster and
+# count what fell off instead of growing without bound
+_SIGS_PER_FN = 512
+
+
+def enabled() -> bool:
+    return os.environ.get("KARPENTER_TPU_DEVICEPLANE", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# process-global registry
+
+
+class _FnRecord:
+    """One registered jit entry point: its signature population and
+    compile history."""
+
+    __slots__ = ("name", "call_site", "static_names", "signatures", "calls", "compiles", "evicted")
+
+    def __init__(self, name: str, call_site: str, static_names: Tuple[str, ...]):
+        self.name = name
+        self.call_site = call_site
+        self.static_names = tuple(static_names)
+        # sig key -> {"count", "first_ms", "restored"}
+        self.signatures: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.calls = 0
+        self.compiles = 0
+        self.evicted = 0
+
+
+_MU = threading.Lock()
+_REGISTRY: Dict[str, _FnRecord] = {}
+_EVENTS: deque = deque(maxlen=_EVENTS_KEEP)
+_TOTALS = {"compiles": 0, "calls": 0}
+# process-global transfer totals (per-solve splits live on the TLS acc)
+_TRANSFERS: Dict[Tuple[str, str], int] = {}
+
+_tls = threading.local()
+
+
+def _acc() -> Optional[dict]:
+    return getattr(_tls, "acc", None)
+
+
+def reset_solve() -> None:
+    """Arm per-solve accumulation on this thread (solve entry)."""
+    if not enabled():
+        _tls.acc = None
+        return
+    _tls.acc = {
+        "compiles": [],  # compile-event dicts, in order
+        "transfers": {},  # (direction, phase) -> bytes
+        "footprint": 0,  # max padded-buffer estimate seen this solve
+    }
+
+
+def consume_solve(memory: Optional[dict] = None) -> Optional[dict]:
+    """Drain this thread's per-solve accumulator into the stats-shaped
+    ``device`` block (None when the plane is disabled). ``memory`` is
+    the solver-tier HBM poll (``devicetime.device_memory_stats()``)."""
+    acc = _acc()
+    _tls.acc = None
+    if acc is None:
+        return None
+    by_phase: Dict[str, Dict[str, int]] = {}
+    direction_totals = {"h2d": 0, "d2h": 0}
+    for (direction, phase), nbytes in acc["transfers"].items():
+        by_phase.setdefault(phase, {})[direction] = (
+            by_phase.get(phase, {}).get(direction, 0) + nbytes
+        )
+        direction_totals[direction] = direction_totals.get(direction, 0) + nbytes
+    budget_mb = tile_budget_mb()
+    footprint = int(acc["footprint"])
+    headroom = None
+    if budget_mb > 0:
+        headroom = round(1.0 - footprint / (budget_mb * 1024 * 1024), 4)
+    events = acc["compiles"]
+    return {
+        "compiles": len(events),
+        "compile_events": [dict(e) for e in events[:8]],
+        "transfer_bytes": direction_totals,
+        "transfer_by_phase": by_phase,
+        "footprint_bytes": footprint,
+        "tile_budget_mb": budget_mb,
+        "tile_headroom_frac": headroom,
+        "hbm": dict(memory) if memory else None,
+    }
+
+
+def tile_budget_mb() -> float:
+    try:
+        return float(os.environ.get("KARPENTER_TPU_COMPAT_TILE_MB", "64"))
+    except ValueError:
+        return 64.0
+
+
+# ---------------------------------------------------------------------------
+# the registering-jit seam
+
+
+def _abstract(a: Any) -> tuple:
+    """One argument's abstract type: array-likes (anything with .shape
+    and .dtype — numpy or jax, traced or concrete) become
+    ``("a", shape, dtype)``; dict/tuple pytrees recurse; everything else
+    is static config by bounded repr. jax's executable cache keys on
+    exactly this abstraction, so key equality here ⇔ cache hit there."""
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(shape), str(dtype))
+    if isinstance(a, dict):
+        return ("d",) + tuple((k, _abstract(v)) for k, v in sorted(a.items()))
+    if isinstance(a, (tuple, list)):
+        return ("t",) + tuple(_abstract(v) for v in a)
+    r = repr(a)
+    return ("s", r if len(r) <= 120 else r[:117] + "...")
+
+
+def _has_array(node: tuple) -> bool:
+    if node[0] == "a":
+        return True
+    if node[0] in ("d", "t"):
+        rest = node[1:]
+        return any(_has_array(v if node[0] == "t" else v[1]) for v in rest)
+    return False
+
+
+def _sig_key(static_names: Tuple[str, ...], args: tuple, kwargs: dict) -> Tuple[tuple, tuple]:
+    """(array part, static part) of one call's abstract signature: args
+    whose pytree carries arrays land in the array part (shape/dtype
+    population), the rest — plus anything named in ``static_names`` —
+    is static config."""
+    arr: List[tuple] = []
+    static: List[tuple] = []
+    for i, a in enumerate(args):
+        node = _abstract(a)
+        (arr if _has_array(node) else static).append((i, node))
+    for k in sorted(kwargs):
+        node = _abstract(kwargs[k])
+        if k not in static_names and _has_array(node):
+            arr.append((k, node))
+        else:
+            static.append((k, node))
+    return tuple(arr), tuple(static)
+
+
+def _classify(rec: _FnRecord, arr_part: tuple, static_part: tuple) -> str:
+    if not rec.signatures:
+        return CAUSE_FIRST
+    for (known_arr, known_static), meta in rec.signatures.items():
+        if meta.get("restored"):
+            continue  # a restored inventory row is a prediction, not a witnessed compile
+        if known_arr == arr_part and known_static != static_part:
+            return CAUSE_NEW_CONFIG
+    return CAUSE_NEW_SHAPE
+
+
+def _record_compile(rec: _FnRecord, cause: str, ms: float, sig: tuple) -> dict:
+    from .tracer import current_trace_id
+
+    event = {
+        "fn": rec.name,
+        "cause": cause,
+        "ms": round(ms, 3),
+        "trace_id": current_trace_id(),
+        "wall": time.time(),
+    }
+    with _MU:
+        rec.compiles += 1
+        _TOTALS["compiles"] += 1
+        _EVENTS.append(dict(event))
+    acc = _acc()
+    if acc is not None:
+        acc["compiles"].append(event)
+    return event
+
+
+def wrap(name: str, fn: Callable, static_names: Tuple[str, ...] = (), call_site: str = "") -> Callable:
+    """Register ``fn`` (an already-jitted callable) under ``name`` and
+    return the observing wrapper. Signature bookkeeping is skipped
+    entirely while the plane is disabled — the wrapper is then one env
+    lookup + a passthrough call."""
+    static_names = tuple(static_names)
+    if not call_site:
+        code = getattr(fn, "__wrapped__", fn)
+        code = getattr(code, "__code__", None)
+        if code is not None:
+            call_site = f"{os.path.basename(code.co_filename)}:{code.co_firstlineno}"
+    with _MU:
+        rec = _REGISTRY.get(name)
+        if rec is None:
+            rec = _FnRecord(name, call_site, static_names)
+            _REGISTRY[name] = rec
+
+    @functools.wraps(fn)
+    def observed(*args, **kwargs):
+        if not enabled():
+            return fn(*args, **kwargs)
+        key = _sig_key(static_names, args, kwargs)
+        with _MU:
+            meta = rec.signatures.get(key)
+            rec.calls += 1
+            _TOTALS["calls"] += 1
+            fresh = meta is None
+            if fresh:
+                cause = _classify(rec, key[0], key[1])
+                meta = {"count": 0, "first_ms": None}
+                rec.signatures[key] = meta
+                while len(rec.signatures) > _SIGS_PER_FN:
+                    rec.signatures.popitem(last=False)
+                    rec.evicted += 1
+            restored = bool(meta.pop("restored", False)) if not fresh else False
+        if fresh or restored:
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            ms = (time.perf_counter() - t0) * 1e3
+            with _MU:
+                meta["count"] += 1
+                if meta["first_ms"] is None:
+                    meta["first_ms"] = round(ms, 3)
+            if fresh:
+                # a prewarmed (restored) signature's first live call is
+                # the replayed compile the inventory predicted — counted
+                # as a call, never as a recompile event
+                _record_compile(rec, cause, ms, key)
+            return out
+        with _MU:
+            meta["count"] += 1
+        return fn(*args, **kwargs)
+
+    observed.__deviceplane_fn__ = name
+    return observed
+
+
+def observe_jit(name: str, static_names: Tuple[str, ...] = ()):
+    """Decorator form of ``wrap`` for def-site jits: stacks above the
+    literal ``@jax.jit`` decoration (which stays visible to the
+    host-sync / tracer-safety AST passes)."""
+
+    def deco(fn: Callable) -> Callable:
+        return wrap(name, fn, static_names=static_names)
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# transfer + footprint accounting (rides devicetime.track)
+
+
+def record_transfer(direction: str, nbytes: int, phase: str = "solve") -> None:
+    """Account ``nbytes`` moved across the host/device boundary.
+    ``direction`` is ``h2d`` or ``d2h``; ``phase`` names the solve phase
+    the move belongs to (pack, shard, lp, screen, ...)."""
+    if nbytes <= 0 or not enabled():
+        return
+    key = (direction, phase)
+    with _MU:
+        _TRANSFERS[key] = _TRANSFERS.get(key, 0) + int(nbytes)
+    acc = _acc()
+    if acc is not None:
+        acc["transfers"][key] = acc["transfers"].get(key, 0) + int(nbytes)
+
+
+def nbytes_of(*arrays: Any) -> int:
+    """Total byte size of array-likes (numpy or jax; anything exposing
+    ``nbytes``, else size*itemsize, else 0). Duck-typed — no jax import."""
+    total = 0
+    for a in arrays:
+        if a is None:
+            continue
+        n = getattr(a, "nbytes", None)
+        if n is None:
+            size = getattr(a, "size", None)
+            itemsize = getattr(getattr(a, "dtype", None), "itemsize", None)
+            n = size * itemsize if size is not None and itemsize is not None else 0
+        total += int(n)
+    return total
+
+
+def record_footprint(nbytes: int) -> None:
+    """Report one padded device-buffer footprint estimate (the budgeted
+    transient — e.g. a Pallas compat tile or a shard pad block). The
+    per-solve block keeps the max."""
+    if nbytes <= 0 or not enabled():
+        return
+    acc = _acc()
+    if acc is not None and nbytes > acc["footprint"]:
+        acc["footprint"] = int(nbytes)
+
+
+# ---------------------------------------------------------------------------
+# global consumers: bench gates, /debug/device, warmstore plane
+
+
+def compile_count() -> int:
+    """Process-lifetime compile-event count — the bench zero-recompile
+    gates snapshot this around steady loops."""
+    with _MU:
+        return _TOTALS["compiles"]
+
+
+def totals() -> dict:
+    with _MU:
+        return {
+            "compiles": _TOTALS["compiles"],
+            "calls": _TOTALS["calls"],
+            "functions": len(_REGISTRY),
+            "transfer_bytes": {f"{d}.{p}": n for (d, p), n in sorted(_TRANSFERS.items())},
+        }
+
+
+def compile_totals_by_label() -> Dict[Tuple[str, str], int]:
+    """(fn, cause) -> count over the retained event ring + registry
+    compile counters; the metrics push uses per-solve events instead,
+    this backs /debug/device."""
+    out: Dict[Tuple[str, str], int] = {}
+    with _MU:
+        for ev in _EVENTS:
+            key = (ev["fn"], ev["cause"])
+            out[key] = out.get(key, 0) + 1
+    return out
+
+
+def recent_compiles(tail: int = 32) -> List[dict]:
+    with _MU:
+        return [dict(e) for e in list(_EVENTS)[-max(1, tail):]]
+
+
+def _jsonable(node: Any):
+    if isinstance(node, tuple):
+        return [_jsonable(v) for v in node]
+    return node
+
+
+def registry_state() -> List[dict]:
+    """Per-function inventory for /debug/device and profile_solve
+    --device: signatures with shapes, call counts, first-call (compile)
+    wall ms."""
+    out: List[dict] = []
+    with _MU:
+        for rec in _REGISTRY.values():
+            sigs = []
+            for (arr, static), meta in rec.signatures.items():
+                sigs.append(
+                    {
+                        "shapes": _jsonable(arr),
+                        "static": _jsonable(static),
+                        "count": meta.get("count", 0),
+                        "first_ms": meta.get("first_ms"),
+                        "restored": bool(meta.get("restored", False)),
+                    }
+                )
+            out.append(
+                {
+                    "fn": rec.name,
+                    "call_site": rec.call_site,
+                    "static_names": list(rec.static_names),
+                    "calls": rec.calls,
+                    "compiles": rec.compiles,
+                    "evicted": rec.evicted,
+                    "signatures": sigs,
+                }
+            )
+    return sorted(out, key=lambda r: r["fn"])
+
+
+def debug_state(tail: int = 32) -> dict:
+    """The /debug/device payload: totals, the per-function registry,
+    and the recent compile events carrying trace_id exemplars."""
+    return {
+        "enabled": enabled(),
+        "totals": totals(),
+        "tile_budget_mb": tile_budget_mb(),
+        "compiles_by_label": {
+            f"{fn}|{cause}": n for (fn, cause), n in sorted(compile_totals_by_label().items())
+        },
+        "registry": registry_state(),
+        "recent_compiles": recent_compiles(tail),
+    }
+
+
+# ---------------------------------------------------------------------------
+# warmstore inventory plane (jitsig): the signature population persists
+# so ROADMAP item 2's warmup_compile_only prewarmer can replay the exact
+# shapes a restored process will be asked to solve
+
+
+def export_signatures() -> List[tuple]:
+    """Serializable (fn, static_names, [(arr_part, static_part), ...])
+    rows — keys only, counts stay process-local."""
+    out: List[tuple] = []
+    with _MU:
+        for rec in _REGISTRY.values():
+            out.append((rec.name, rec.static_names, list(rec.signatures.keys())))
+    return out
+
+
+def import_signatures(rows: List[tuple]) -> Tuple[int, int]:
+    """Re-anchor a snapshot's signature inventory into the live
+    registry → (restored, dropped). The witness is the live seam: a row
+    restores only onto a function this process actually registered
+    through ``wrap()`` with the same static-argname contract — anything
+    else (renamed fn, changed static set, malformed row) is dropped,
+    never trusted. Restored signatures are inventory, not history:
+    count 0, flagged ``restored``, and their first live call does not
+    raise a recompile event (it is the predicted replay)."""
+    restored = dropped = 0
+    for row in rows:
+        try:
+            name, static_names, keys = row
+            static_names = tuple(static_names)
+        except (TypeError, ValueError):
+            dropped += 1
+            continue
+        with _MU:
+            rec = _REGISTRY.get(name)
+            if rec is None or rec.static_names != static_names:
+                dropped += len(keys) if isinstance(keys, list) else 1
+                continue
+            for key in keys:
+                try:
+                    arr, static = key
+                    k = (tuple(tuple(x) if isinstance(x, list) else x for x in arr),
+                         tuple(tuple(x) if isinstance(x, list) else x for x in static))
+                except (TypeError, ValueError):
+                    dropped += 1
+                    continue
+                if k not in rec.signatures:
+                    rec.signatures[k] = {"count": 0, "first_ms": None, "restored": True}
+                restored += 1
+    return restored, dropped
+
+
+def reset() -> None:
+    """Drop every registration's signature population and the event
+    ring (tests, simulate_process_death). Function records survive —
+    they are module-import facts, not runtime state."""
+    with _MU:
+        for rec in _REGISTRY.values():
+            rec.signatures.clear()
+            rec.calls = 0
+            rec.compiles = 0
+            rec.evicted = 0
+        _EVENTS.clear()
+        _TOTALS["compiles"] = 0
+        _TOTALS["calls"] = 0
+        _TRANSFERS.clear()

@@ -128,6 +128,13 @@ def _parse_args():
                     help="pod count (with --restart)")
     ap.add_argument("--restart-types", type=int, default=500,
                     help="catalog size (with --restart)")
+    ap.add_argument("--device", action="store_true",
+                    help="device-plane mode (ISSUE 16): one cold + one "
+                         "warm solve, then the compile table (fn x shape "
+                         "x count x compile_ms), per-phase transfer "
+                         "totals, and the HBM watermark; pass a modest "
+                         "pods count (e.g. 5000 500) and BENCH_BACKEND="
+                         "cpu off-TPU")
     return ap.parse_args()
 
 
@@ -209,6 +216,9 @@ def main():
 
     pods = [constrained(i) for i in range(n_pods)]
     solver = TPUScheduler([nodepool], provider)
+    if args.device:
+        _device_mode(solver, pods)
+        return
     if args.ticks:
         _tick_mode(args, solver, pods, constrained, rng)
         return
@@ -258,6 +268,91 @@ def main():
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)
     print(s.getvalue())
+
+
+def _device_mode(solver, pods):
+    """--device: one cold + one warm solve through the device-plane
+    observatory (ISSUE 16) — compile attribution per registered jit
+    entry point, H2D/D2H bytes per solve phase, and the HBM watermark
+    (off-TPU the cpu backend reports no watermarks; the padded-buffer
+    footprint estimate stands in)."""
+    from karpenter_core_tpu.solver import devicetime
+    from karpenter_core_tpu.tracing import deviceplane
+
+    for label in ("cold", "warm"):
+        t0 = time.perf_counter()
+        res = solver.solve(pods)
+        dev = solver.last_device_stats or {}
+        print(
+            f"{label}: {(time.perf_counter()-t0)*1000:.1f} ms  "
+            f"compiles={dev.get('compiles', 0)}  "
+            f"({res.pods_scheduled} pods, {res.node_count} nodes)",
+            file=sys.stderr,
+        )
+
+    def fmt_node(node):
+        # ("a", shape, dtype) → "4096x128:bool"; pytrees compact to their
+        # array-leaf census; static reprs pass through truncated
+        if node and node[0] == "a":
+            return "x".join(str(d) for d in node[1]) + ":" + str(node[2])
+        if node and node[0] in ("d", "t"):
+            leaves = []
+
+            def walk(n):
+                if not isinstance(n, (list, tuple)) or not n:
+                    return
+                if n[0] == "a":
+                    leaves.append("x".join(str(d) for d in n[1]))
+                    return
+                for child in n[1:]:
+                    walk(child[1] if n[0] == "d" else child)
+
+            walk(node)
+            head = ",".join(leaves[:4]) + ("…" if len(leaves) > 4 else "")
+            return f"pytree({len(leaves)}a:{head})"
+        text = str(node)
+        return text if len(text) <= 60 else text[:57] + "..."
+
+    print("\ncompile table (fn x shape x count x compile_ms):", file=sys.stderr)
+    for rec in deviceplane.registry_state():
+        if not rec["signatures"]:
+            continue
+        print(
+            f"  {rec['fn']}  [{rec['call_site']}]  calls={rec['calls']} "
+            f"compiles={rec['compiles']} evicted={rec['evicted']}",
+            file=sys.stderr,
+        )
+        for sig in rec["signatures"]:
+            shapes = ", ".join(fmt_node(tuple(n)) for _, n in (tuple(s) for s in sig["shapes"]))
+            static = ", ".join(str(n) for _, n in (tuple(s) for s in sig["static"]))
+            tag = " (restored)" if sig["restored"] else ""
+            print(
+                f"    [{shapes or '-'}] static[{static or '-'}] "
+                f"x{sig['count']}  first {sig['first_ms']} ms{tag}",
+                file=sys.stderr,
+            )
+
+    dev = solver.last_device_stats or {}
+    print("\ntransfer totals per phase (warm solve):", file=sys.stderr)
+    by_phase = dev.get("transfer_by_phase", {})
+    if not by_phase:
+        print("  none recorded", file=sys.stderr)
+    for phase, dirs in sorted(by_phase.items()):
+        split = "  ".join(f"{d}={n}B" for d, n in sorted(dirs.items()))
+        print(f"  {phase}: {split}", file=sys.stderr)
+    print("process totals:", deviceplane.totals()["transfer_bytes"], file=sys.stderr)
+
+    hbm = devicetime.device_memory_stats()
+    if hbm:
+        print(f"\nHBM watermark: {hbm}", file=sys.stderr)
+    else:
+        print(
+            f"\nHBM watermark: n/a on this backend — padded footprint "
+            f"estimate {dev.get('footprint_bytes', 0)} B of "
+            f"{dev.get('tile_budget_mb')} MiB tile budget "
+            f"(headroom {dev.get('tile_headroom_frac')})",
+            file=sys.stderr,
+        )
 
 
 def _restart_mode(args):

@@ -1,0 +1,326 @@
+"""Device-plane observatory (tracing/deviceplane.py, ISSUE 16 tentpole).
+
+Layers under test: the jit-signature registry and recompile causes; the
+per-solve drain into the stats ``device`` block; the disabled path
+(``KARPENTER_TPU_DEVICEPLANE=0`` — dispatch straight through, no
+bookkeeping); the zero-recompile invariant on steady incremental ticks;
+the warmstore ``jitsig`` inventory plane round trip (restored rows are
+inventory, not history — witness failures drop, never crash); the new
+metric families' exposition format; and the observation overhead guard.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from helpers import make_nodepool, make_pod
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, new_instance_type
+from karpenter_core_tpu.kube.objects import NodeSelectorRequirement
+from karpenter_core_tpu.metrics import Metrics, check_exposition
+from karpenter_core_tpu.solver import TPUScheduler, incremental, warmstore
+from karpenter_core_tpu.tracing import deviceplane
+
+TEAMS = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_device_plane():
+    deviceplane.reset()
+    incremental.reset()
+    yield
+    deviceplane.reset()
+    incremental.reset()
+
+
+def _catalog(n=16):
+    return [
+        new_instance_type(
+            f"dp-{i}",
+            {"cpu": str((i % 8) + 1), "memory": f"{2 * ((i % 8) + 1)}Gi", "pods": "110"},
+        )
+        for i in range(n)
+    ]
+
+
+def _nodepool():
+    return make_nodepool(
+        requirements=[
+            NodeSelectorRequirement("team", "In", [f"t{t}" for t in range(TEAMS)])
+        ]
+    )
+
+
+def _mk_pods(seed, n=96):
+    rng = np.random.RandomState(seed)
+    cpus = ["100m", "250m", "500m", "1"]
+    mems = ["128Mi", "512Mi", "1Gi"]
+    return [
+        make_pod(
+            name=f"dp-p{i}",
+            requests={
+                "cpu": cpus[rng.randint(len(cpus))],
+                "memory": mems[rng.randint(len(mems))],
+            },
+            node_selector={"team": f"t{i % TEAMS}"},
+            labels={"team": f"t{i % TEAMS}"},
+        )
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# signature registry + recompile causes (plain callables: the registry is
+# abstraction bookkeeping, it needs no jax to be exercised)
+
+
+class TestSignatureRegistry:
+    def test_compile_causes_first_new_shape_new_config(self):
+        calls = []
+        f = deviceplane.wrap(
+            "t.f", lambda x, n=1: calls.append(1) or x, static_names=("n",)
+        )
+        base = deviceplane.compile_count()
+        f(np.zeros(4), n=1)  # first signature ever
+        f(np.zeros(4), n=1)  # known → no event
+        f(np.zeros(8), n=1)  # shapes changed
+        f(np.zeros(8), n=2)  # shapes known, static config changed
+        assert deviceplane.compile_count() - base == 3
+        causes = [e["cause"] for e in deviceplane.recent_compiles()]
+        assert causes[-3:] == ["first", "new_shape", "new_config"]
+        assert len(calls) == 4  # observation never swallows a dispatch
+
+    def test_registry_state_inventory(self):
+        f = deviceplane.wrap("t.inv", lambda x: x)
+        f(np.zeros((2, 3), dtype=np.float32))
+        f(np.zeros((2, 3), dtype=np.float32))
+        rec = next(r for r in deviceplane.registry_state() if r["fn"] == "t.inv")
+        assert rec["calls"] == 2 and rec["compiles"] == 1
+        (sig,) = rec["signatures"]
+        assert sig["count"] == 2 and sig["first_ms"] is not None
+        assert ["a", [2, 3], "float32"] in [s for _, s in [tuple(x) for x in sig["shapes"]]]
+
+    def test_consume_solve_block_shape(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_COMPAT_TILE_MB", "1")
+        f = deviceplane.wrap("t.blk", lambda x: x)
+        deviceplane.reset_solve()
+        f(np.zeros(4))
+        deviceplane.record_transfer("h2d", 1000, phase="pack")
+        deviceplane.record_transfer("h2d", 500, phase="lp")
+        deviceplane.record_transfer("d2h", 200, phase="pack")
+        deviceplane.record_footprint(512 * 1024)
+        block = deviceplane.consume_solve(memory={"bytes_in_use": 7})
+        assert block["compiles"] == 1
+        assert block["compile_events"][0]["fn"] == "t.blk"
+        assert block["transfer_bytes"] == {"h2d": 1500, "d2h": 200}
+        assert block["transfer_by_phase"]["pack"] == {"h2d": 1000, "d2h": 200}
+        assert block["footprint_bytes"] == 512 * 1024
+        # 0.5 MiB of a 1 MiB budget → half the tile headroom left
+        assert block["tile_headroom_frac"] == pytest.approx(0.5)
+        assert block["hbm"] == {"bytes_in_use": 7}
+        # the drain is one-shot
+        assert deviceplane.consume_solve() is None
+        json.dumps(block)  # must be servable as-is
+
+    def test_disabled_plane_is_a_passthrough(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DEVICEPLANE", "0")
+        f = deviceplane.wrap("t.off", lambda x: x * 2)
+        base = deviceplane.compile_count()
+        assert f(3) == 6
+        assert deviceplane.compile_count() == base
+        rec = next(r for r in deviceplane.registry_state() if r["fn"] == "t.off")
+        assert rec["signatures"] == [] and rec["calls"] == 0
+        deviceplane.reset_solve()
+        deviceplane.record_transfer("h2d", 10**6, phase="pack")
+        deviceplane.record_footprint(10**6)
+        assert deviceplane.consume_solve() is None
+        assert deviceplane.totals()["transfer_bytes"] == {}
+
+    def test_signature_roster_bounded_with_eviction_counter(self):
+        f = deviceplane.wrap("t.bound", lambda x: x)
+        for n in range(deviceplane._SIGS_PER_FN + 10):
+            f(np.zeros(n + 1))
+        rec = next(r for r in deviceplane.registry_state() if r["fn"] == "t.bound")
+        assert len(rec["signatures"]) == deviceplane._SIGS_PER_FN
+        assert rec["evicted"] == 10
+
+
+# ---------------------------------------------------------------------------
+# zero recompiles on steady incremental ticks (the ledger gate's invariant,
+# asserted at test scale): after the warmup solve, repeat/no-op ticks must
+# raise no compile events — padded shape classes absorb the steady state
+
+
+class TestSteadyTickZeroRecompile:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_steady_ticks_raise_no_compiles(self, seed):
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog()
+        pods = _mk_pods(seed)
+        solver = TPUScheduler([_nodepool()], provider)
+        solver.solve(pods)  # warmup: compiles land here
+        base = deviceplane.compile_count()
+        for tick in range(4):
+            if tick % 2:
+                # same content shapes, busted pod identity: forces the
+                # solve through the kernels rather than a whole replay
+                p = pods[tick]
+                p.metadata.resource_version = str(int(p.metadata.resource_version or 0) + 1)
+            solver.solve(pods)
+            assert solver.last_device_stats is not None
+            assert solver.last_device_stats["compiles"] == 0, (
+                f"seed {seed} tick {tick}: "
+                f"{solver.last_device_stats['compile_events']}"
+            )
+        assert deviceplane.compile_count() == base
+
+
+# ---------------------------------------------------------------------------
+# warmstore jitsig inventory plane
+
+
+class TestJitsigSnapshotRoundTrip:
+    def test_export_import_round_trip_suppresses_replay_events(self):
+        f = deviceplane.wrap("t.rt", lambda x, n=1: x, static_names=("n",))
+        f(np.zeros(4), n=1)
+        f(np.zeros(8), n=1)
+        rows = deviceplane.export_signatures()
+        deviceplane.reset()
+        restored, dropped = deviceplane.import_signatures(rows)
+        assert restored == 2 and dropped == 0
+        # the restored signatures' first live calls are predicted
+        # replays — timed, but never compile events
+        f(np.zeros(4), n=1)
+        f(np.zeros(8), n=1)
+        assert deviceplane.compile_count() == 0
+        # a genuinely new shape still raises one
+        f(np.zeros(16), n=1)
+        assert deviceplane.compile_count() == 1
+        assert deviceplane.recent_compiles()[-1]["cause"] == "new_shape"
+
+    def test_witness_failures_drop_rows(self):
+        f = deviceplane.wrap("t.wit", lambda x, n=1: x, static_names=("n",))
+        f(np.zeros(4), n=1)
+        rows = deviceplane.export_signatures()
+        good = next(r for r in rows if r[0] == "t.wit")
+        deviceplane.reset()
+        restored, dropped = deviceplane.import_signatures(
+            [
+                ("t.renamed", good[1], good[2]),  # fn this process never registered
+                ("t.wit", ("other_static",), good[2]),  # static-argname contract changed
+                ("malformed",),  # not even a row
+                good,
+            ]
+        )
+        assert restored == 1
+        assert dropped == 3
+
+    def test_snapshot_restore_through_warmstore(self, tmp_path):
+        warmstore.simulate_process_death()
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog()
+        pods = _mk_pods(5)
+        solver = TPUScheduler([_nodepool()], provider)
+        solver.solve(pods)
+        solver.solve(pods)
+        assert deviceplane.compile_count() > 0, "warmup produced no registered compiles"
+        path = solver.snapshot(directory=str(tmp_path))
+        assert path is not None
+
+        warmstore.simulate_process_death()  # clears the signature roster too
+        assert deviceplane.compile_count() == 0
+        provider2 = FakeCloudProvider()
+        provider2.instance_types = _catalog()
+        solver2 = TPUScheduler([_nodepool()], provider2)
+        outcome = solver2.restore(path)
+        assert outcome["restored"].get("jitsig", 0) > 0, outcome
+        # the restored inventory predicts this process's compiles: the
+        # first solve replays them without raising recompile events
+        solver2.solve(_mk_pods(5))
+        assert solver2.last_device_stats["compiles"] == 0, (
+            solver2.last_device_stats["compile_events"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# metric surface + stats schema
+
+
+class TestMetricSurface:
+    def test_new_families_pass_exposition_lint(self):
+        m = Metrics()
+        m.xla_compiles.inc(1, fn="pack.ffd", cause="first")
+        m.xla_compiles.inc(1, fn="pack.ffd", cause="new_shape")
+        m.transfer_bytes.inc(4096, direction="h2d", phase="pack")
+        m.transfer_bytes.inc(128, direction="d2h", phase="lp")
+        m.hbm_high_water.set(2.5e9)
+        text = m.registry.expose()
+        assert check_exposition(text) == [], check_exposition(text)
+        assert "karpenter_tpu_xla_compiles_total" in text
+        assert "karpenter_tpu_solver_transfer_bytes_total" in text
+        assert "karpenter_tpu_hbm_high_water_bytes" in text
+
+    def test_solver_pushes_compile_events_and_stats_block(self):
+        from karpenter_core_tpu.solver import stats as solver_stats
+
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog()
+        metrics = Metrics()
+        solver = TPUScheduler([_nodepool()], provider, metrics=metrics)
+        solver.solve(_mk_pods(1))
+        doc = solver_stats.solve_stats(solver)
+        dev = doc["device"]
+        assert dev is not None and doc["schema"] == solver_stats.SCHEMA
+        assert dev["compiles"] == deviceplane.compile_count() > 0
+        for ev in dev["compile_events"]:
+            assert metrics.xla_compiles.get(fn=ev["fn"], cause=ev["cause"]) >= 1
+        fields = solver_stats.bench_fields(doc)
+        assert fields["device"]["compiles"] == dev["compiles"]
+        assert check_exposition(metrics.registry.expose()) == []
+
+    def test_debug_device_route_payload(self):
+        from karpenter_core_tpu.operator.server import _device
+
+        f = deviceplane.wrap("t.route", lambda x: x)
+        f(np.zeros(3))
+        status, ctype, body = _device({})
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert any(r["fn"] == "t.route" for r in payload["registry"])
+        assert payload["recent_compiles"][-1]["fn"] == "t.route"
+        assert _device({"tail": ["nope"]})[0] == 400
+
+
+# ---------------------------------------------------------------------------
+# overhead guard
+
+
+class TestOverheadGuard:
+    def test_observation_overhead_within_budget(self, monkeypatch):
+        """The wrapper's steady-state cost is one env read + a dict hit
+        per dispatch — budgeted at ~2% of a warm solve. CI wall clocks
+        are noisy, so the gate asserts the medians stay within 25%;
+        bench config 7's split owns the precise number."""
+        provider = FakeCloudProvider()
+        provider.instance_types = _catalog()
+        pods = _mk_pods(9)
+        solver = TPUScheduler([_nodepool()], provider)
+
+        def median_warm_ms(runs=5):
+            times = []
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                solver.solve(pods)
+                times.append((time.perf_counter() - t0) * 1e3)
+            return sorted(times)[len(times) // 2]
+
+        solver.solve(pods)  # compile + cache warmup, both modes share it
+        on = median_warm_ms()
+        monkeypatch.setenv("KARPENTER_TPU_DEVICEPLANE", "0")
+        off = median_warm_ms()
+        monkeypatch.delenv("KARPENTER_TPU_DEVICEPLANE")
+        assert on <= off * 1.25 + 2.0, f"deviceplane on {on:.2f}ms vs off {off:.2f}ms"

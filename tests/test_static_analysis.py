@@ -359,6 +359,118 @@ def test_jnp_host_only(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# jit-registry fixtures (ISSUE 16)
+
+JITREG_CONFIG = AnalysisConfig(jit_registry_modules=("snippet.py",))
+
+
+def test_jit_registry_naked_decorator(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            return x * n
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.rule == "jit-registry"
+    assert f.symbol == "kernel"
+    assert "observe_jit" in f.message
+
+
+def test_jit_registry_observed_decorator_clean(tmp_path):
+    code = """
+        import jax
+        from functools import partial
+        from karpenter_core_tpu.tracing import deviceplane
+
+        @deviceplane.observe_jit("mod.kernel", static_names=("n",))
+        @partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            return x * n
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert report.findings == []
+
+
+def test_jit_registry_bare_call(tmp_path):
+    code = """
+        import jax
+
+        def build(f):
+            return jax.jit(f)
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert len(report.findings) == 1
+    assert "deviceplane.wrap" in report.findings[0].message
+    assert report.findings[0].symbol == "build"
+
+
+def test_jit_registry_wrapped_call_clean(tmp_path):
+    code = """
+        import jax
+        from karpenter_core_tpu.tracing import deviceplane
+
+        def build(f):
+            return deviceplane.wrap("mod.f", jax.jit(f))
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert report.findings == []
+
+
+def test_jit_registry_shard_map_call(tmp_path):
+    code = """
+        from jax.experimental.shard_map import shard_map
+
+        def build(f, mesh, specs):
+            return shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert len(report.findings) == 1
+    assert "shard_map" in report.findings[0].message
+
+
+def test_jit_registry_vmap_exempt(tmp_path):
+    # vmap alone builds no executable — only jit triggers compiles
+    code = """
+        import jax
+
+        @jax.vmap
+        def rowwise(x):
+            return x + 1
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert report.findings == []
+
+
+def test_jit_registry_scoped_marker(tmp_path):
+    code = """
+        import jax
+
+        def build(f):
+            return jax.jit(f)  # analysis: allow-jit-registry(bench-only throwaway)
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"], config=JITREG_CONFIG)
+    assert report.findings == []
+
+
+def test_jit_registry_off_module_exempt(tmp_path):
+    # the rule only binds in the configured hot modules
+    code = """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+    """
+    report = run_snippet(tmp_path, code, rules=["jit-registry"])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # clock-discipline fixtures (ISSUE 15)
 
 CLOCK_CONFIG = AnalysisConfig(control_loop_modules=("snippet.py",))
