@@ -2223,14 +2223,22 @@ def config12() -> dict:
     }
 
 
-def _restart_measure(args: list) -> dict:
+def _restart_measure(args: list, env: dict = None) -> dict:
     """One restart-phase trafficgen invocation in its own subprocess
     (each phase IS a process — the kill is a real process exit, the
-    resume a real fresh interpreter)."""
+    resume a real fresh interpreter). ``env`` overlays the inherited
+    environment (config 14 points the kill + warm-resume pair at a
+    shared managed compile-cache dir, ISSUE 17)."""
     import subprocess
 
     cmd = [sys.executable, "-m", "karpenter_core_tpu.serving.trafficgen"] + args
-    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600, check=False)
+    run_env = None
+    if env:
+        run_env = dict(os.environ)
+        run_env.update(env)
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, check=False, env=run_env
+    )
     if proc.returncode != 0:
         return {"error": (proc.stderr or proc.stdout or "").strip()[-500:]}
     return json.loads(proc.stdout.strip().splitlines()[-1])
@@ -2251,13 +2259,20 @@ def config14() -> dict:
                   cold-restart baseline).
       reference — the same scenario unkilled, end to end.
 
-    Gates: warm first-solve host p50 >=3x faster than cold
+    Gates: warm first-solve host p50 >=7.2x faster than cold
     (first_solve_speedup, the config-7 cold/warm convention), the
     restored pipeline back at the killed process's steady p50 within
-    K=3 ticks (ticks_to_warm), and the concatenated killed-run plan
+    K=3 ticks (ticks_to_warm), the concatenated killed-run plan
     stream byte-identical (plan_sha256) to the unkilled reference —
     across the kill point, for BOTH resumes — identity 1.0 on every
-    cell."""
+    cell, and the compile-plane zero (ISSUE 17): the kill + warm-resume
+    pair share a managed XLA executable cache dir, the boot jitsig
+    replay re-traces every restored signature before tick 0, so the
+    restored path's first solve raises ZERO compile events
+    (first_solve_compiles, ledger ceiling 0). The cold lane gets its
+    own empty cache dir — the cold baseline pays its real compiles
+    (flattering it with the kill process's executables would be the
+    PR-13 non-flattery violation in executable form)."""
     import tempfile
 
     scale = _scale(int(os.environ.get("BENCH_RESTART_SCALE", "600")))
@@ -2270,14 +2285,28 @@ def config14() -> dict:
     }
     cold_first, warm_first, cold_host, warm_host = [], [], [], []
     restore_ms, ticks_to_warm = [], []
+    warm_compiles, cold_compiles, prewarm_ms = [], [], []
     identical = total = 0
     for seed in seeds:
         cell: dict = {}
         with tempfile.TemporaryDirectory(prefix="bench-warmstore-") as workdir:
             base = ["--scenario", "restart_wave", "--n-types", str(n_types)]
+            # the kill + warm-resume pair share one managed executable
+            # cache dir (that sharing IS the compile plane under test);
+            # cold gets a fresh dir so its first solve stays an honest
+            # cold baseline (executables included, not just planes)
+            warm_env = {
+                "KARPENTER_TPU_COMPILE_CACHE_DIR": os.path.join(workdir, "jax-cache"),
+                "KARPENTER_TPU_COMPILE_CACHE_CPU_OK": "1",
+            }
+            cold_env = {
+                "KARPENTER_TPU_COMPILE_CACHE_DIR": os.path.join(workdir, "jax-cache-cold"),
+                "KARPENTER_TPU_COMPILE_CACHE_CPU_OK": "1",
+            }
             kill = _restart_measure(
                 base + ["--scale", str(scale), "--seed", str(seed),
-                        "--restart-kill-at", str(kill_step), "--workdir", workdir]
+                        "--restart-kill-at", str(kill_step), "--workdir", workdir],
+                env=warm_env,
             )
             cell["kill"] = {k: kill.get(k) for k in ("plans_emitted", "steady_step_ms_p50", "error") if k in kill}
             handoff = kill.get("handoff_path")
@@ -2285,12 +2314,12 @@ def config14() -> dict:
                 base + ["--scale", str(scale), "--seed", str(seed), "--restart-reference"]
             )
             warm = (
-                _restart_measure(base + ["--restart-resume", handoff])
+                _restart_measure(base + ["--restart-resume", handoff], env=warm_env)
                 if handoff
                 else {"error": "kill phase failed"}
             )
             cold = (
-                _restart_measure(base + ["--restart-resume", handoff, "--cold"])
+                _restart_measure(base + ["--restart-resume", handoff, "--cold"], env=cold_env)
                 if handoff
                 else {"error": "kill phase failed"}
             )
@@ -2304,17 +2333,22 @@ def config14() -> dict:
                 "plan_identical": ident,
                 "first_solve_ms": doc.get("first_solve_ms"),
                 "first_solve_host_ms": doc.get("first_solve_host_ms"),
+                "first_solve_compiles": doc.get("first_solve_compiles"),
                 "ticks_to_warm": doc.get("ticks_to_warm"),
             }
             if "error" in doc:
                 cell[mode]["error"] = doc["error"]
         cell["warm"]["restore_ms"] = warm.get("restore_ms")
         cell["warm"]["warmstore"] = warm.get("warmstore")
+        cell["warm"]["prewarm_ms"] = warm.get("prewarm_ms")
         out["cells"][f"seed{seed}"] = cell
         if "error" not in warm and "error" not in cold:
             warm_first.append(warm["first_solve_ms"]); cold_first.append(cold["first_solve_ms"])
             warm_host.append(warm["first_solve_host_ms"]); cold_host.append(cold["first_solve_host_ms"])
             restore_ms.append(warm["restore_ms"]); ticks_to_warm.append(warm["ticks_to_warm"])
+            warm_compiles.append(int(warm.get("first_solve_compiles") or 0))
+            cold_compiles.append(int(cold.get("first_solve_compiles") or 0))
+            prewarm_ms.append(float(warm.get("prewarm_ms") or 0.0))
 
     def p50(a):
         return round(float(np.median(np.asarray(a))), 2) if a else 0.0
@@ -2332,6 +2366,14 @@ def config14() -> dict:
         else 0.0
     )
     out["ticks_to_warm"] = int(max(ticks_to_warm)) if ticks_to_warm else 0
+    # the compile-plane zero (ISSUE 17): worst warm-lane cell across
+    # seeds — the ledger gates this at ceiling 0 (restored path's first
+    # solve raises no compile events at all, not "few")
+    out["first_solve_compiles"] = int(max(warm_compiles)) if warm_compiles else 999
+    out["prewarm_ms"] = p50(prewarm_ms)
+    out["cold_vs_warm_compile_events"] = (
+        f"{p50(cold_compiles):g}/{p50(warm_compiles):g}" if cold_compiles else ""
+    )
     out["plan_identical_cells"] = identical
     out["plan_identity"] = round(identical / total, 4) if total else 0.0
     return out

@@ -8,19 +8,22 @@
 #
 # --telemetry (ISSUE 10): the decision-telemetry gate in one command —
 # the Prometheus exposition-format checker, the bench-ledger regression
-# check over the BENCH_r*.json trajectory, and the orphan-span /
-# flight-recorder meta-tests. Tier-1 runs the same tests via pytest;
-# this mode is the pre-push/CI shortcut alongside the analysis run.
+# check over the BENCH_r*.json trajectory (including the config-14
+# compile-event absolute gates, ISSUE 17), the orphan-span /
+# flight-recorder meta-tests, and the prewarm/compile-cache gate tests
+# (zero-compile restored first solve + the witness-failure matrix).
+# Tier-1 runs the same tests via pytest; this mode is the pre-push/CI
+# shortcut alongside the analysis run.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 if [[ "${1:-}" == "--telemetry" ]]; then
   shift
   echo "== bench ledger --check (BENCH_r*.json trajectory gates)"
   python hack/bench_ledger.py --check "$@"
-  echo "== prom-format + orphan-span + flight-recorder meta-tests"
+  echo "== prom-format + orphan-span + flight-recorder + prewarm gate tests"
   exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -q -p no:cacheprovider \
     tests/test_prom_format.py tests/test_bench_ledger.py tests/test_flightrec.py \
-    "tests/test_tracing.py::TestOrphanAccounting"
+    tests/test_prewarm.py "tests/test_tracing.py::TestOrphanAccounting"
 fi
 if [[ "${1:-}" == "--all" ]]; then
   shift

@@ -2176,7 +2176,7 @@ def check_cache_determinism(pctx: ProjectContext):
 #
 # solver/warmstore.py serializes the memo planes to disk and restores
 # them into a DIFFERENT process. The in-memory rules above prove keys
-# witness their read-sets; persistence adds three ways to break the
+# witness their read-sets; persistence adds four ways to break the
 # same invariant that no in-memory analysis can see:
 #
 # - trusting a PERSISTED generation counter: generation guards are
@@ -2188,7 +2188,11 @@ def check_cache_determinism(pctx: ProjectContext):
 #   onto another tenant's state;
 # - trusting a payload without verifying the writer's schema id and
 #   key-layout contract hash: a reader that re-anchors keys it would
-#   misparse restores garbage silently.
+#   misparse restores garbage silently;
+# - restoring the compile-cache plane (ISSUE 17) without comparing the
+#   stored jax/jaxlib/platform fingerprint against the live process —
+#   foreign XLA executables are the one payload whose digests cannot
+#   witness compatibility, only provenance.
 
 
 _PAYLOAD_PARAM_RE = re.compile(
@@ -2347,3 +2351,62 @@ def check_cache_persist(pctx: ProjectContext):
                     ),
                     severity=SEV_ERROR,
                 )
+
+        # (4) compile-cache fingerprint witnessing (ISSUE 17): a restore
+        # unit that handles the "compilecache" plane is trusting another
+        # process's XLA executables — it must take the live environment
+        # fingerprint (compile_cache_fingerprint) AND actually compare
+        # the jax/jaxlib/platform components against the stored ones. A
+        # restore that skips the comparison would replay executables
+        # compiled by a different jaxlib onto this process's runtime —
+        # the one corruption the byte-level digests cannot see, because
+        # the stored digests still match the stored bytes
+        for sym, fn_node in fns:
+            leaf = sym.split(".")[-1]
+            if not leaf.startswith(("restore", "_restore")):
+                continue
+            touches_plane = any(
+                isinstance(n, ast.Constant) and n.value == "compilecache"
+                for n in ast.walk(fn_node)
+            )
+            if not touches_plane:
+                continue
+            takes_fingerprint = any(
+                isinstance(n, ast.Call)
+                and (
+                    (isinstance(n.func, ast.Attribute) and n.func.attr == "compile_cache_fingerprint")
+                    or (isinstance(n.func, ast.Name) and n.func.id == "compile_cache_fingerprint")
+                )
+                for n in ast.walk(fn_node)
+            )
+            compares_env = any(
+                isinstance(node, ast.Compare)
+                and any(
+                    isinstance(n, ast.Constant) and n.value in ("jax", "jaxlib", "platform")
+                    for n in ast.walk(node)
+                )
+                for node in ast.walk(fn_node)
+            )
+            if takes_fingerprint and compares_env:
+                continue
+            missing_bits = []
+            if not takes_fingerprint:
+                missing_bits.append("never takes the live compile_cache_fingerprint")
+            if not compares_env:
+                missing_bits.append(
+                    "never compares the stored jax/jaxlib/platform against the live ones"
+                )
+            yield Finding(
+                rule="cache-persist",
+                path=f.relpath,
+                line=fn_node.lineno,
+                symbol=sym,
+                message=(
+                    "compile-cache plane restored blind: "
+                    + " and ".join(missing_bits)
+                    + " — a snapshot from a different jax/jaxlib/platform "
+                    "would replay foreign XLA executables (drop the plane "
+                    "counted on mismatch, never trust it)"
+                ),
+                severity=SEV_ERROR,
+            )

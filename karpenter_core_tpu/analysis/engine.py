@@ -112,9 +112,13 @@ class AnalysisConfig:
     # warm-state persistence modules (ISSUE 13): the snapshot/restore
     # seam whose restore paths the cache-persist rule holds to the
     # re-anchoring contract (live generations only, tenant scope
-    # preserved, schema/contract verified before trusting a payload)
+    # preserved, schema/contract verified before trusting a payload,
+    # and — ISSUE 17 — the compile-cache plane restored only behind a
+    # jax/jaxlib/platform fingerprint comparison); prewarm.py replays
+    # the restored jitsig rows and rides the same rule set
     warmstore_modules: Tuple[str, ...] = (
         "karpenter_core_tpu/solver/warmstore.py",
+        "karpenter_core_tpu/solver/prewarm.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)

@@ -24,6 +24,7 @@ tenants share one encoded catalog entry.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -31,6 +32,8 @@ from typing import Dict, List, Optional
 from ..serving.latency import DecisionLatencyTracker
 from ..solver import TPUScheduler
 from ..solver.incremental import WarmState
+
+log = logging.getLogger("karpenter.fleet")
 
 
 class TenantHandle:
@@ -61,6 +64,9 @@ class TenantHandle:
         self.solves = 0
         self.pods_solved = 0
         self.last_error: Optional[str] = None
+        # admission jitsig-replay outcome (ISSUE 17), when the tenant
+        # was admitted with restore_from
+        self.prewarm_replay: Optional[dict] = None
 
     def summary(self) -> dict:
         return {
@@ -71,6 +77,7 @@ class TenantHandle:
             "pending": self.latency.pending_count(),
             "decided": self.latency.decided_count(),
             "last_error": self.last_error,
+            "prewarm_replay": self.prewarm_replay,
         }
 
 
@@ -193,6 +200,20 @@ class FleetRegistry:
                 finally:
                     self.plane.activate(was_active)
                     solver.fleet_plane = None
+                # admission prewarm (ISSUE 17): replay the restored
+                # jitsig inventory now, on the admitting thread, so the
+                # migrated tenant's first round dispatches against warm
+                # executables — compiles land under cause=prewarm_replay
+                # (a cache hit when the compile-cache plane restored
+                # clean), never on the tenant's first solve
+                from ..solver import prewarm as prewarm_replay
+
+                try:
+                    handle.prewarm_replay = prewarm_replay.warmup_compile_only(solver)
+                except Exception:  # noqa: BLE001 — replay must never fail admission
+                    log.exception(
+                        "tenant %s admission jitsig replay failed", tenant_id
+                    )
             return handle
 
     def snapshot_tenant(self, tenant_id: str, directory: Optional[str] = None) -> Optional[str]:

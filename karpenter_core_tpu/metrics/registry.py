@@ -366,7 +366,7 @@ class Metrics:
         # the device-memory high-water mark of the last polled solve
         self.xla_compiles = r.counter(
             f"{ns}_tpu_xla_compiles_total",
-            "XLA compiles observed at registered jit entry points, by function and cause (first | new_shape | new_config); trace_id exemplars via /debug/device",
+            "XLA compiles observed at registered jit entry points, by function and cause (first | new_shape | new_config | prewarm_replay); trace_id exemplars via /debug/device",
             ["fn", "cause"],
         )
         self.transfer_bytes = r.counter(

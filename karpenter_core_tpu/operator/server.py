@@ -106,17 +106,23 @@ def _traces_last(_query) -> Tuple[int, str, str]:
 def _device(query) -> Tuple[int, str, str]:
     """Device-plane observatory (tracing/deviceplane.py, ISSUE 16): the
     jit-signature registry, process compile/transfer totals, and the
-    recent compile events carrying trace_id exemplars. ``?tail=N``
-    bounds the event list (default 32)."""
+    recent compile events carrying trace_id exemplars, plus the managed
+    compile-cache status and boot jitsig-replay outcome (ISSUE 17 — a
+    cacheless or replay-degraded process is visible here, never
+    silent). ``?tail=N`` bounds the event list (default 32)."""
     import json
 
+    from ..solver import backend, prewarm
     from ..tracing import deviceplane
 
     try:
         tail = int(query.get("tail", ["32"])[0])
     except ValueError:
         return 400, "text/plain", "bad tail parameter\n"
-    return 200, "application/json", json.dumps(deviceplane.debug_state(tail=tail), default=str)
+    state = deviceplane.debug_state(tail=tail)
+    state["compile_cache"] = backend.compile_cache_status()
+    state["prewarm"] = prewarm.last_result()
+    return 200, "application/json", json.dumps(state, default=str)
 
 
 def _decisions(query) -> Tuple[int, str, str]:

@@ -226,6 +226,14 @@ class _DecisionStep:
             "decision_trace_id": tr.trace_id if tr is not None else None,
             "solve_host_ms": round((timings or {}).get("host_ms", 0.0), 3),
             "solve_device_ms": round((timings or {}).get("device_ms", 0.0), 3),
+            # deviceplane compile events raised by this tick's solve
+            # (ISSUE 17: the restart lanes gate the restored first solve
+            # at zero); None when the device plane is off or no solver
+            "solve_compiles": (
+                (getattr(solver, "last_device_stats", None) or {}).get("compiles")
+                if solver is not None
+                else None
+            ),
         }
 
     def _flight_record(
@@ -364,6 +372,16 @@ class ServingPipeline:
         # warm-state restore outcome (ISSUE 13): per-plane restored/
         # dropped counts of the pre-first-tick restore, for /debug
         self._warmstore_outcome: Optional[dict] = None
+        # boot-order contract (ISSUE 17): restore → prewarm → tick 0.
+        # Cleared by start() when a restore landed (a jitsig replay is
+        # pending on the prewarm thread); the plan thread's first tick
+        # waits on it, bounded, so a restored process's first solve
+        # dispatches against warm executables and raises zero compile
+        # events. Set everywhere else — tick 0 must never deadlock on a
+        # replay that will not run.
+        self._boot_prewarm_done = threading.Event()
+        self._boot_prewarm_done.set()
+        self._boot_prewarm_result: Optional[dict] = None
         # chaos-plane degradation state (ISSUE 15): the stale-world
         # guard's freshness stamp (monotonic; any watch delivery is
         # evidence of liveness) + explicit staleness seam, the leader
@@ -520,6 +538,11 @@ class ServingPipeline:
     # -- plan stage (the authoritative thread) -------------------------------
 
     def _plan_loop(self) -> None:
+        # tick-0 gate (ISSUE 17): wait for the boot jitsig replay so the
+        # first authoritative solve dispatches against warm executables.
+        # Bounded — a wedged replay costs a cold first solve, not a dead
+        # pipeline.
+        self._boot_prewarm_done.wait(timeout=60.0)
         while True:
             try:
                 token = self.solve_q.get(timeout=0.2)
@@ -661,6 +684,7 @@ class ServingPipeline:
     # -- prewarm stage (the double buffer) -----------------------------------
 
     def _prewarm_loop(self) -> None:
+        self._boot_prewarm_replay()
         while not self._stop_evt.is_set():
             if not self._new_pods_evt.wait(timeout=0.25):
                 continue
@@ -692,6 +716,30 @@ class ServingPipeline:
                     self._prewarm_once()
             except Exception:  # noqa: BLE001 — speculation must never break serving
                 log.debug("serving prewarm failed", exc_info=True)
+
+    def _boot_prewarm_replay(self) -> None:
+        """The prewarm half of the boot-order contract (ISSUE 17,
+        restore → prewarm → tick 0): replay the restored jitsig
+        inventory through the live registered functions
+        (``solver/prewarm.py``) so every predicted compile is paid — a
+        persistent-cache hit when the compile-cache plane restored
+        clean — before the plan thread's first authoritative tick.
+        Runs once, on this thread, gated by the event ``start()`` armed;
+        a failed replay degrades to a cold first solve, never a dead
+        pipeline."""
+        if self._boot_prewarm_done.is_set():
+            return
+        try:
+            from ..solver import prewarm as prewarm_replay
+
+            solver = self._warmstore_solver()
+            result = prewarm_replay.warmup_compile_only(solver)
+            with self._mu:
+                self._boot_prewarm_result = result
+        except Exception:  # noqa: BLE001 — replay must never break serving boot
+            log.exception("boot jitsig replay failed; first solve runs cold")
+        finally:
+            self._boot_prewarm_done.set()
 
     def _prewarm_once(self) -> None:
         """Speculatively encode the newly arrived pods on a dedicated
@@ -834,6 +882,17 @@ class ServingPipeline:
                 self.restore_warm_state(self.config.warmstore_restore)
             except Exception:  # noqa: BLE001 — a bad snapshot degrades to a cold start
                 log.exception("warm-state restore failed; starting cold")
+        # arm the tick-0 prewarm gate only when a restore actually
+        # landed and the jitsig replay is enabled (ISSUE 17): the
+        # prewarm thread will replay and release it
+        from ..solver import prewarm as prewarm_replay
+
+        with self._mu:
+            restored = self._warmstore_outcome is not None
+        if restored and prewarm_replay.enabled():
+            self._boot_prewarm_done.clear()
+        else:
+            self._boot_prewarm_done.set()
         self._stop_evt.clear()
         self.solve_q.reopen()
         self.telemetry_q.reopen()
@@ -926,6 +985,7 @@ class ServingPipeline:
             prewarm = {
                 "runs": self._prewarm_runs,
                 "catalog_prewarms": self._catalog_prewarms,
+                "boot_replay": self._boot_prewarm_result,
                 **self._prewarm_stats,
             }
             disrupt_log = list(self._disrupt_log)[-4:]

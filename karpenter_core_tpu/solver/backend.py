@@ -29,47 +29,132 @@ _BACKEND: Optional[str] = None
 LAST_PROBE_ERROR: Optional[str] = None
 
 
-_CACHE_ENABLED = False
+#: resolved compile-cache state, set once by ``enable_compilation_cache``:
+#: {"status": "enabled"|"disabled"|"unavailable:<why>", "dir": path|None}
+_CACHE_STATUS: Optional[dict] = None
 
 
-def enable_compilation_cache() -> None:
-    """Point JAX's persistent compilation cache at an XDG cache dir so a
-    provisioner restart replays cached XLA binaries instead of paying
-    cold compiles (~7 s on the tunneled TPU in BENCH_r03). TPU-only: on
-    CPU the cache re-loads AOT results compiled for slightly different
-    host-feature sets (XLA warns of SIGILL risk) and measurably slows
-    the solve, while CPU compiles are cheap anyway. Idempotent; opt-out
-    with KARPENTER_TPU_COMPILE_CACHE=off."""
-    global _CACHE_ENABLED
-    if _CACHE_ENABLED:
-        return
-    path = os.environ.get("KARPENTER_TPU_COMPILE_CACHE")
-    if path == "off":
-        _CACHE_ENABLED = True
-        return
-    if not path:
-        # XDG cache location: valid for both pip-installed deployments and
-        # dev checkouts (a package-relative default would land the cache
-        # beside site-packages)
-        xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(
-            os.path.expanduser("~"), ".cache"
-        )
-        path = os.path.join(xdg, "karpenter-tpu", "jax-cache")
+def _default_cache_dir() -> str:
+    # XDG cache location: valid for both pip-installed deployments and
+    # dev checkouts (a package-relative default would land the cache
+    # beside site-packages)
+    xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(xdg, "karpenter-tpu", "jax-cache")
+
+
+def enable_compilation_cache(backend: Optional[str] = None) -> dict:
+    """Point JAX's persistent compilation cache at a managed directory so
+    a provisioner restart replays cached XLA binaries instead of paying
+    cold compiles (~7 s on the tunneled TPU in BENCH_r03). The directory
+    is ``KARPENTER_TPU_COMPILE_CACHE_DIR`` (XDG default); the warmstore
+    snapshot witnesses its content fingerprint like every other plane.
+
+    On CPU the cache re-loads AOT results compiled for slightly
+    different host-feature sets (XLA warns of SIGILL risk) and CPU
+    compiles are cheap anyway, so CPU stays opt-in:
+    ``KARPENTER_TPU_COMPILE_CACHE_CPU_OK=1`` (tests/bench — the tier-1
+    suite runs pinned to cpu and needs the cache path exercisable).
+    Idempotent; opt-out with ``KARPENTER_TPU_COMPILE_CACHE=off``. Returns
+    and records the status dict — a cacheless process is a counted
+    status, never a silent debug line."""
+    global _CACHE_STATUS
+    if _CACHE_STATUS is not None:
+        return _CACHE_STATUS
+    if os.environ.get("KARPENTER_TPU_COMPILE_CACHE") == "off":
+        _CACHE_STATUS = {"status": "disabled", "why": "opt-out", "dir": None}
+        return _CACHE_STATUS
+    if backend == "cpu" and os.environ.get(
+        "KARPENTER_TPU_COMPILE_CACHE_CPU_OK", "0"
+    ) != "1":
+        _CACHE_STATUS = {"status": "disabled", "why": "cpu-backend", "dir": None}
+        return _CACHE_STATUS
+    path = (
+        os.environ.get("KARPENTER_TPU_COMPILE_CACHE_DIR")
+        or os.environ.get("KARPENTER_TPU_COMPILE_CACHE")
+        or _default_cache_dir()
+    )
     try:
+        os.makedirs(path, exist_ok=True)
         import jax
 
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        _CACHE_STATUS = {"status": "enabled", "why": None, "dir": path}
+    except Exception as e:  # noqa: BLE001 — older jax / unwritable dir
         import logging
 
-        # older jax without these knobs: every solve pays cold compiles,
-        # which is worth one debug line instead of silence
-        logging.getLogger("karpenter.solver").debug(
-            "persistent compilation cache unavailable: %s", e
+        # every solve pays cold compiles from here on — surface it in
+        # the status (stats device block, /debug/device), not just a log
+        why = f"{type(e).__name__}: {e}"
+        logging.getLogger("karpenter.solver").warning(
+            "persistent compilation cache unavailable: %s", why
         )
-    _CACHE_ENABLED = True
+        _CACHE_STATUS = {"status": f"unavailable:{why[:160]}", "why": why, "dir": None}
+    return _CACHE_STATUS
+
+
+def compile_cache_status() -> dict:
+    """Live compile-cache status for /debug/device and the stats device
+    block: resolution outcome, managed dir, and current entry count."""
+    st = dict(_CACHE_STATUS or {"status": "disabled", "why": "not-initialized", "dir": None})
+    st["entries"] = len(_cache_entries(st.get("dir")))
+    return st
+
+
+def _cache_entries(path: Optional[str]) -> list:
+    if not path or not os.path.isdir(path):
+        return []
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            rel = os.path.relpath(os.path.join(root, f), path)
+            out.append(rel)
+    return sorted(out)
+
+
+def compile_cache_fingerprint() -> Optional[dict]:
+    """Content fingerprint of the managed executable cache, recorded in
+    the warmstore snapshot header the way every other plane is witnessed:
+    jax/jaxlib versions, resolved platform, and a digest manifest of the
+    cache entries. ``None`` when the cache is not enabled (the snapshot
+    then carries no compile-cache plane). Restore compares this against
+    the live process — a mismatched jax/platform means the cached
+    executables cannot be trusted and the plane is dropped counted."""
+    st = _CACHE_STATUS
+    if not st or st.get("status") != "enabled" or not st.get("dir"):
+        return None
+    import hashlib
+
+    try:
+        import jax
+
+        jax_v = getattr(jax, "__version__", "unknown")
+    except Exception:  # noqa: BLE001
+        jax_v = "unknown"
+    try:
+        import jaxlib.version
+
+        jaxlib_v = jaxlib.version.__version__
+    except Exception:  # noqa: BLE001
+        jaxlib_v = "unknown"
+    path = st["dir"]
+    entries = {}
+    for rel in _cache_entries(path):
+        try:
+            with open(os.path.join(path, rel), "rb") as fh:
+                entries[rel] = hashlib.sha256(fh.read()).hexdigest()[:16]
+        except OSError:
+            entries[rel] = "unreadable"
+    return {
+        "jax": jax_v,
+        "jaxlib": jaxlib_v,
+        "platform": _BACKEND or os.environ.get("JAX_PLATFORMS") or "unknown",
+        "dir": path,
+        "entries": entries,
+    }
 
 
 def pin_cpu() -> None:
@@ -176,13 +261,13 @@ def default_backend() -> str:
     import jax
 
     if forced:
-        if forced != "cpu":
-            enable_compilation_cache()
+        enable_compilation_cache(backend=forced)
         jax.config.update("jax_platforms", forced)
         _BACKEND = jax.default_backend()
         return _BACKEND
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # already pinned (tests, bench fallback) — CPU init can't hang
+        enable_compilation_cache(backend="cpu")
         jax.config.update("jax_platforms", "cpu")
         _BACKEND = jax.default_backend()
         return _BACKEND
@@ -196,12 +281,12 @@ def default_backend() -> str:
         LAST_PROBE_ERROR = probe.describe()
         _log_fallback(LAST_PROBE_ERROR)
         pin_cpu()
+        enable_compilation_cache(backend="cpu")
         _BACKEND = jax.default_backend()
         return _BACKEND
     try:
         _BACKEND = jax.default_backend()
-        if _BACKEND != "cpu":
-            enable_compilation_cache()
+        enable_compilation_cache(backend=_BACKEND)
     except RuntimeError as e:  # plugin raced from probe-ok to unreachable
         LAST_PROBE_ERROR = str(e)
         _log_fallback(str(e))
@@ -219,6 +304,7 @@ def _log_fallback(reason: str) -> None:
 
 
 def reset_for_tests() -> None:
-    global _BACKEND, LAST_PROBE_ERROR
+    global _BACKEND, LAST_PROBE_ERROR, _CACHE_STATUS
     _BACKEND = None
     LAST_PROBE_ERROR = None
+    _CACHE_STATUS = None

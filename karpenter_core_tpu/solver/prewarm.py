@@ -1,0 +1,169 @@
+"""Jitsig-replay prewarmer (ISSUE 17 tentpole b): replay the restored
+``jitsig`` inventory through the live registered functions at boot so a
+restored process's first solve raises zero compile events.
+
+PR 16's deviceplane persists every hot-path function's abstract call
+signatures through the warmstore as the ``jitsig`` plane — described
+there as "the ``warmup_compile_only`` prewarmer's exact shopping list."
+This module cashes that in. ``warmup_compile_only(scheduler)`` walks
+``deviceplane.replay_targets()`` (signature rows still flagged
+``restored`` — imported from a snapshot, not yet replayed by live
+traffic), synthesizes abstract-shaped dummy arguments per signature
+(``jnp.zeros`` for array leaves, pytree recursion for dict/tuple nodes,
+``ast.literal_eval`` of the bounded repr for static config), and calls
+each back through its observing wrapper under
+``deviceplane.prewarm_scope()``:
+
+- bookkeeping rides the same seam as live traffic — the replayed
+  signature's ``restored`` flag clears, so the first *solve* call is a
+  plain signature hit raising zero compile events;
+- the compiles paid here are attributed ``cause=prewarm_replay``, in
+  their own process total, never the solve-attributed counters — and
+  with the managed executable cache enabled (``solver.backend``) the
+  trace/lower/compile is a persistent-cache hit, not a cold build;
+- a row the replay cannot resynthesize (truncated repr, non-literal
+  static, sparse positional slots) is counted ``skipped``, and a replay
+  call that raises is counted ``errors`` — degraded coverage is a
+  number, never silence (PR-7 ``family_capped`` discipline).
+
+Boot order (serving pipeline): restore → prewarm → tick 0 — the prewarm
+thread runs this before the plan loop's first tick; fleet
+``add_tenant(restore_from=)`` replays on admission. Kill switch:
+``KARPENTER_TPU_PREWARM=0`` skips the replay (status ``disabled``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..tracing import deviceplane
+
+#: most recent replay outcome (stats device block, /debug/device)
+_LAST: Optional[dict] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("KARPENTER_TPU_PREWARM", "1") != "0"
+
+
+def last_result() -> Optional[dict]:
+    return dict(_LAST) if _LAST is not None else None
+
+
+def reset_for_tests() -> None:
+    global _LAST
+    _LAST = None
+
+
+class _Unreplayable(Exception):
+    """A signature row the replay cannot resynthesize — counted skipped."""
+
+
+def _synth(node: Any) -> Any:
+    """One abstract node back to a concrete dummy: ``("a", shape,
+    dtype)`` → zeros of that shape/dtype, dict/tuple nodes recurse,
+    static nodes re-literalize their bounded repr."""
+    kind = node[0]
+    if kind == "a":
+        import jax.numpy as jnp
+
+        _, shape, dtype = node
+        return jnp.zeros(tuple(shape), dtype=dtype)
+    if kind == "d":
+        return {k: _synth(v) for k, v in node[1:]}
+    if kind == "t":
+        return tuple(_synth(v) for v in node[1:])
+    if kind == "s":
+        r = node[1]
+        if r.endswith("..."):
+            raise _Unreplayable("truncated static repr")
+        try:
+            return ast.literal_eval(r)
+        except (ValueError, SyntaxError, MemoryError, RecursionError) as e:
+            raise _Unreplayable(f"non-literal static repr: {type(e).__name__}")
+    raise _Unreplayable(f"unknown node kind {kind!r}")
+
+
+def _synth_call(key: tuple) -> tuple:
+    """One signature key back to (args, kwargs). Positional slots must
+    be dense 0..n-1 (they always are for keys recorded by ``_sig_key``,
+    but a snapshot row is input, not truth)."""
+    arr_part, static_part = key
+    slots: Dict[Any, Any] = {}
+    for pos, node in list(arr_part) + list(static_part):
+        slots[pos] = _synth(node)
+    int_keys = sorted(k for k in slots if isinstance(k, int))
+    if int_keys != list(range(len(int_keys))):
+        raise _Unreplayable("sparse positional slots")
+    args = tuple(slots[i] for i in int_keys)
+    kwargs = {k: v for k, v in slots.items() if isinstance(k, str)}
+    return args, kwargs
+
+
+def warmup_compile_only(scheduler: Any = None, restored_only: bool = True) -> dict:
+    """Replay the jitsig inventory through the live wrappers; return the
+    counted outcome. ``scheduler`` (a TPUScheduler, optional) supplies
+    the metrics registry the ``prewarm_replay`` compile events are
+    pushed to — the solve's finally block never sees them.
+
+    The replay executes each synthesized signature once (results
+    discarded): trace + lower + compile land in jax's executable cache —
+    a persistent-cache hit when the managed compile-cache plane restored
+    clean, a counted cold compile otherwise. Either way the first
+    authoritative solve after boot dispatches against warm executables
+    and raises zero compile events.
+    """
+    global _LAST
+    t0 = time.perf_counter()
+    if not enabled():
+        _LAST = {
+            "status": "disabled",
+            "functions": 0,
+            "replayed": 0,
+            "skipped": 0,
+            "errors": 0,
+            "compile_events": 0,
+            "prewarm_ms": 0.0,
+        }
+        return dict(_LAST)
+    targets = deviceplane.replay_targets(restored_only=restored_only)
+    replayed = skipped = errors = 0
+    events: List[dict] = []
+    with deviceplane.prewarm_scope() as scope_events:
+        for target in targets:
+            wrapper = target["wrapper"]
+            for key in target["keys"]:
+                try:
+                    args, kwargs = _synth_call(key)
+                except _Unreplayable:
+                    skipped += 1
+                    continue
+                try:
+                    out = wrapper(*args, **kwargs)
+                    try:
+                        import jax
+
+                        jax.block_until_ready(out)
+                    except Exception:  # noqa: BLE001 — non-array returns
+                        pass
+                    replayed += 1
+                except Exception:  # noqa: BLE001 — replay must never fail boot
+                    errors += 1
+        events = list(scope_events)
+    metrics = getattr(scheduler, "metrics", None)
+    if metrics is not None and hasattr(metrics, "xla_compiles"):
+        for ev in events:
+            metrics.xla_compiles.inc(1, fn=ev["fn"], cause=ev["cause"])
+    _LAST = {
+        "status": "ok" if targets else "empty",
+        "functions": len(targets),
+        "replayed": replayed,
+        "skipped": skipped,
+        "errors": errors,
+        "compile_events": len(events),
+        "prewarm_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+    }
+    return dict(_LAST)

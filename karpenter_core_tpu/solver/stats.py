@@ -20,11 +20,15 @@ from __future__ import annotations
 
 from typing import Optional
 
-SCHEMA = 5  # 5: "device" block (compile/transfer/HBM attribution per
-# solve, ISSUE 16); 4: "warmstore" block (snapshot/restore outcome —
-# per-plane restored/dropped counts, ISSUE 13); 3: "route" block added
-# (tensor/parked/oracle pod split per solve + oracle share, ISSUE 12);
-# 2: "shard" block (mesh padding)
+SCHEMA = 6  # 6: device block carries "compile_cache" (managed XLA
+# executable cache status: enabled|disabled|unavailable:<why>, dir,
+# entry count — a cacheless process is visible, never silent) and
+# "prewarm" (the boot jitsig-replay outcome), ISSUE 17; 5: "device"
+# block (compile/transfer/HBM attribution per solve, ISSUE 16); 4:
+# "warmstore" block (snapshot/restore outcome — per-plane restored/
+# dropped counts, ISSUE 13); 3: "route" block added (tensor/parked/
+# oracle pod split per solve + oracle share, ISSUE 12); 2: "shard"
+# block (mesh padding)
 
 
 def _round3(v) -> float:
@@ -69,8 +73,24 @@ def solve_stats(solver, disruption=None) -> dict:
         "route": dict(rs) if (rs := getattr(solver, "last_route_stats", None)) else None,
         "disruption": dict(dstats) if dstats else None,
         "warmstore": _warmstore_block(solver),
-        "device": dict(ds) if (ds := getattr(solver, "last_device_stats", None)) else None,
+        "device": _device_block(solver),
     }
+
+
+def _device_block(solver) -> Optional[dict]:
+    """The per-solve device block plus the process-level compile-cache
+    status and boot prewarm-replay outcome (ISSUE 17): a solve that ran
+    cacheless — or a restore whose replay degraded — is a visible
+    status, never silence."""
+    ds = getattr(solver, "last_device_stats", None)
+    if not ds:
+        return None
+    from . import backend, prewarm
+
+    out = dict(ds)
+    out["compile_cache"] = backend.compile_cache_status()
+    out["prewarm"] = prewarm.last_result()
+    return out
 
 
 def _warmstore_block(solver) -> Optional[dict]:
@@ -118,11 +138,14 @@ def bench_fields(stats: dict) -> dict:
     dev = stats.get("device")
     if dev:
         # compact projection: the event list stays on the debug route
+        cc = dev.get("compile_cache") or {}
         out["device"] = {
             "compiles": dev.get("compiles", 0),
             "transfer_bytes": dict(dev.get("transfer_bytes", {})),
             "footprint_bytes": dev.get("footprint_bytes", 0),
             "tile_headroom_frac": dev.get("tile_headroom_frac"),
+            "compile_cache_status": cc.get("status"),
+            "compile_cache_entries": cc.get("entries"),
         }
     merge = stats.get("merge", {})
     out["merge_ms"] = round(merge.get("ms", 0.0), 2)

@@ -32,11 +32,19 @@ What persists, per plane (the "snapshot contents" table in README):
 - **intersects memo**: fingerprint-addressed, persisted as-is.
 - **jit-signature inventory** (``tracing/deviceplane.py``, ISSUE 16):
   the abstract call-signature population of every registered jit entry
-  point — what ROADMAP item 2's ``warmup_compile_only`` prewarmer will
-  replay. Witnessed on restore by the live registry: a row only lands
+  point — the ``solver/prewarm.py`` replay's shopping list (ISSUE 17).
+  Witnessed on restore by the live registry: a row only lands
   on a function this process registered through ``deviceplane.wrap()``
   with the same static-argname contract; everything else is dropped
   and counted like any other plane.
+- **compile-cache fingerprint** (``solver/backend.py``, ISSUE 17): the
+  managed XLA executable cache stays on disk, but the snapshot records
+  its content fingerprint — jax/jaxlib versions, resolved platform,
+  and a per-entry digest manifest. On restore the fingerprint is
+  compared against the live process in ``_restore_compile_cache``: a
+  mismatched jax/platform (or a corrupted/evicted cache dir) drops the
+  plane counted, and the jitsig prewarm replay degrades to counted
+  cold compiles instead of trusting stale executables blind.
 - **fleet content planes** (``fleetenv``/``fleetcanon``/``fleetjob``,
   fleet/megasolve.py): restored through the same job-key rebinding; the
   per-tenant variant (``FleetRegistry.snapshot_tenant``) gives tenant
@@ -70,12 +78,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..tracing import deviceplane, tracer
-from . import incremental, podcache
+from . import backend, incremental, podcache
 from .stablehash import stable_hash
 
 log = logging.getLogger("karpenter.warmstore")
 
-SCHEMA = 1
+SCHEMA = 2
 
 #: The writer's key-layout contract, one line per plane. Any change to
 #: how a plane's keys are built MUST edit the matching line (and thereby
@@ -92,7 +100,8 @@ _KEY_CONTRACT = (
     ("seeds", "(constraint key..., exclusion uids, sim_drained, tenant scope) -> domain counts; plane guard = cluster witness"),
     ("intersects", "(reqs fp, reqs fp) -> bool"),
     ("fleetjob", "tenant-free job-key content prefix -> JobSkeleton"),
-    ("jitsig", "(fn name, static-argname tuple) -> abstract signature keys (deviceplane inventory)"),
+    ("jitsig", "(fn name, static-argname tuple) -> abstract signature keys (deviceplane inventory; static reprs bounded at 512 for literal-eval replay)"),
+    ("compilecache", "jax/jaxlib/platform + per-entry digest manifest of the managed XLA executable cache (backend.compile_cache_fingerprint)"),
 )
 CONTRACT = stable_hash(_KEY_CONTRACT).hex()
 
@@ -104,7 +113,7 @@ _MAGIC = b"KTPU-WARMSTORE\n"
 # are the single biggest cold-solve cost)
 _TRIM_ORDER = ("jitsigs", "screen_rows", "emits", "merges", "intersects", "jobs", "routes", "seeds", "catalogs")
 
-_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob", "jitsig")
+_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob", "jitsig", "compilecache")
 
 # most recent snapshot/restore outcome (observability; guarded — the
 # serving pipeline snapshots from its plan thread while debug routes
@@ -293,6 +302,10 @@ def build_payload(solver) -> dict:
         # jit-signature inventory (ISSUE 16): keys only — counts and
         # compile history stay process-local
         "jitsigs": deviceplane.export_signatures(),
+        # compile-cache plane (ISSUE 17): the executable cache itself
+        # stays on disk — the snapshot witnesses its content fingerprint
+        # (None when the managed cache is not enabled)
+        "compilecache": backend.compile_cache_fingerprint(),
     }
     if ws is None:
         return payload
@@ -367,6 +380,11 @@ def _plane_counts(payload: dict) -> dict:
         "seeds": len((payload.get("seeds") or {}).get("entries", ())),
         "intersects": len(payload.get("intersects", ())),
         "jitsig": sum(len(r[2]) for r in payload.get("jitsigs", ()) if len(r) == 3),
+        "compilecache": (
+            1 + len(payload["compilecache"].get("entries") or {})
+            if isinstance(payload.get("compilecache"), dict)
+            else 0
+        ),
     }
 
 
@@ -597,6 +615,46 @@ def _restore_seeds(ws, plane: dict, live_witness: Optional[bytes], live_generati
     out.ok("seeds", len(entries))
 
 
+def _restore_compile_cache(payload: dict, out: "_Outcome") -> bool:
+    """Witness the snapshot's compile-cache plane against the LIVE
+    process (ISSUE 17). The executable cache is bytes XLA will map and
+    run — it is only trustworthy when the jax/jaxlib versions and the
+    resolved platform that produced it match this process exactly, and
+    the witnessed cache entries are still present byte-identical. Any
+    mismatch drops the plane counted (never trusted blind) and the
+    jitsig prewarm replay degrades to counted cold compiles. Returns
+    True iff the plane restored clean."""
+    stored = payload.get("compilecache")
+    if not isinstance(stored, dict):
+        return False  # writer had no managed cache: nothing to witness
+    n = 1 + len(stored.get("entries") or {})
+    live = backend.compile_cache_fingerprint()
+    if live is None:
+        out.drop("compilecache", n)
+        return False
+    if (
+        stored.get("jax") != live.get("jax")
+        or stored.get("jaxlib") != live.get("jaxlib")
+        or stored.get("platform") != live.get("platform")
+    ):
+        out.drop("compilecache", n)
+        return False
+    live_entries = live.get("entries") or {}
+    stale = sum(
+        1
+        for rel, digest in (stored.get("entries") or {}).items()
+        if live_entries.get(rel) != digest
+    )
+    if stale:
+        # corrupted or partially evicted cache dir: some witnessed
+        # executables are gone — their compiles come back cold, counted
+        out.drop("compilecache", stale)
+        out.ok("compilecache", n - stale)
+        return False
+    out.ok("compilecache", n)
+    return True
+
+
 def restore(solver, path: str, metrics=None, fleet_plane=None) -> dict:
     """Restore a snapshot into ``solver``'s warm world. Every plane
     re-anchors against the live world (catalog fingerprints, cluster
@@ -715,6 +773,11 @@ def _restore_under_root(solver, path: str, metrics, fleet_plane, out: "_Outcome"
             n_ok, n_drop = deviceplane.import_signatures(jitsig_rows)
             out.ok("jitsig", n_ok)
             out.drop("jitsig", n_drop)
+
+        # compile-cache plane (ISSUE 17): witnessed in its own unit so
+        # the jax/platform fingerprint comparison is a named, analyzable
+        # seam (the cache-persist rule holds this line)
+        _restore_compile_cache(payload, out)
     except Exception:  # noqa: BLE001 — a corrupt plane degrades to cold, never crashes the caller
         log.exception("warmstore restore failed; remaining planes dropped")
         out.reason = "restore error (see logs)"
@@ -784,11 +847,14 @@ def simulate_process_death() -> None:
     restart re-reads pods from the apiserver, memo-free."""
     from .solver import _CATALOG_CACHE, _CATALOG_LOCK
 
+    from . import prewarm
+
     with _CATALOG_LOCK:
         _CATALOG_CACHE.clear()
     incremental.reset()
     podcache.reset_process()
     deviceplane.reset()
+    prewarm.reset_for_tests()
     with _LAST_LOCK:
         _LAST["snapshot"] = None
         _LAST["restore"] = None

@@ -357,11 +357,16 @@ def _device_mode(solver, pods):
 
 def _restart_mode(args):
     """--restart [--snapshot PATH]: profile the snapshot → restore →
-    first-solve path (ISSUE 13). Builds a config-7-shaped workload,
-    warms a solver, snapshots, wipes every in-memory plane exactly as a
-    process exit would (warmstore.simulate_process_death), then profiles
-    restore + the first post-restart solve against fresh pod/catalog
-    objects — what a restarted provisioner actually executes."""
+    prewarm-replay → first-solve path (ISSUE 13 + 17). Builds a
+    config-7-shaped workload, warms a solver, snapshots, wipes every
+    in-memory plane exactly as a process exit would
+    (warmstore.simulate_process_death), then profiles restore, the boot
+    jitsig replay (solver/prewarm.py — the compile table is printed
+    before and after, so the zero-compile first solve is visible), and
+    the first post-restart solve against fresh pod/catalog objects —
+    what a restarted provisioner actually executes. Point
+    KARPENTER_TPU_COMPILE_CACHE_DIR at a persistent dir (+ _CPU_OK=1
+    off-TPU) to exercise the managed executable cache too."""
     import tempfile
     import time as _time
 
@@ -420,14 +425,37 @@ def _restart_mode(args):
     # apiserver/provider — nothing may carry the dead process's memos
     provider, np_, pods = build_world()
     solver = TPUScheduler([np_], provider)
+
+    from karpenter_core_tpu.solver import backend as solver_backend
+    from karpenter_core_tpu.solver import prewarm
+    from karpenter_core_tpu.tracing import deviceplane
+
+    def compile_table(header):
+        # fn x restored-signature count x live compile count — the
+        # before/after view of the boot jitsig replay (ISSUE 17)
+        print(f"\n{header}:", file=sys.stderr)
+        rows = [r for r in deviceplane.registry_state() if r["signatures"]]
+        if not rows:
+            print("  (no registered jit entry points)", file=sys.stderr)
+        for rec in rows:
+            restored = sum(1 for s in rec["signatures"] if s["restored"])
+            print(
+                f"  {rec['fn']}  sigs={len(rec['signatures'])} "
+                f"restored={restored} compiles={rec['compiles']}",
+                file=sys.stderr,
+            )
+        t = deviceplane.totals()
+        print(
+            f"  totals: compiles={t['compiles']} "
+            f"prewarm_compiles={t['prewarm_compiles']}",
+            file=sys.stderr,
+        )
+
     pr = cProfile.Profile()
     pr.enable()
     t0 = _time.perf_counter()
     outcome = solver.restore(path)
     restore_ms = (_time.perf_counter() - t0) * 1000.0
-    t0 = _time.perf_counter()
-    res = solver.solve(pods)
-    first_ms = (_time.perf_counter() - t0) * 1000.0
     pr.disable()
     print(
         f"restore: {restore_ms:.1f} ms  restored={outcome.get('restored')} "
@@ -435,9 +463,26 @@ def _restart_mode(args):
         file=sys.stderr,
     )
     print(
-        f"first solve after restore: {first_ms:.1f} ms "
+        f"compile cache: {solver_backend.compile_cache_status()}",
+        file=sys.stderr,
+    )
+    compile_table("compile table before prewarm (restored rows, no live code)")
+    pr.enable()
+    replay = prewarm.warmup_compile_only(solver)
+    pr.disable()
+    print(f"\nprewarm replay: {replay}", file=sys.stderr)
+    compile_table("compile table after prewarm (replayed under prewarm_replay)")
+    pr.enable()
+    t0 = _time.perf_counter()
+    res = solver.solve(pods)
+    first_ms = (_time.perf_counter() - t0) * 1000.0
+    pr.disable()
+    dev = solver.last_device_stats or {}
+    print(
+        f"\nfirst solve after restore: {first_ms:.1f} ms "
         f"(host {solver.last_timings['host_ms']:.1f} ms, "
         f"{res.pods_scheduled} pods, {res.node_count} nodes) "
+        f"compile_events={dev.get('compiles', 0)} "
         f"cache={solver.last_cache_stats}",
         file=sys.stderr,
     )

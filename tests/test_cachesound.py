@@ -485,6 +485,7 @@ _MUT_FILES = [
     "karpenter_core_tpu/solver/sharding.py",
     "karpenter_core_tpu/solver/constraint_tensors.py",
     "karpenter_core_tpu/solver/warmstore.py",
+    "karpenter_core_tpu/solver/prewarm.py",
 ]
 
 # (name, file, old, new, expected-rule). One dropped key component per
@@ -626,6 +627,15 @@ _MUTANTS = [
     ("restore-drop-tenant-scope", "karpenter_core_tpu/solver/warmstore.py",
      "return (head,) + stored[1:] + (tenant_scope,)",
      "return (head,) + stored[1:]", "cache-persist"),
+    # ISSUE 17: the compile-cache plane carries another process's XLA
+    # executables — a restore that stops comparing the stored
+    # jax/jaxlib/platform fingerprint against the live process would
+    # replay foreign executables blind (the digests still match the
+    # stored bytes, so only the environment comparison witnesses
+    # compatibility).
+    ("restore-drop-jaxversion-witness", "karpenter_core_tpu/solver/warmstore.py",
+     'if (\n        stored.get("jax") != live.get("jax")\n        or stored.get("jaxlib") != live.get("jaxlib")\n        or stored.get("platform") != live.get("platform")\n    ):',
+     "if False:", "cache-persist"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -647,6 +657,9 @@ _MANDATORY = {
     # ISSUE 13 acceptance: persisted keys re-anchor, never trust the
     # dead process's generation counters or drop the tenant scope
     "restore-drop-generation-reanchor", "restore-drop-tenant-scope",
+    # ISSUE 17 acceptance: the compile-cache plane restores only behind
+    # the live jax/jaxlib/platform fingerprint comparison
+    "restore-drop-jaxversion-witness",
 }
 
 
