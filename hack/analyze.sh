@@ -14,8 +14,26 @@
 # (zero-compile restored first solve + the witness-failure matrix).
 # Tier-1 runs the same tests via pytest; this mode is the pre-push/CI
 # shortcut alongside the analysis run.
+#
+# --concurrency (ISSUE 18): the concurrency-soundness gate in one
+# command — the lock-order / wait-under-lock / process-boundary rules
+# over the full repo, then the runtime lock-order witness tests and the
+# mutation-kill harness. The witness instruments every inventoried
+# coordination lock during the pytest session and fails teardown on any
+# observed acquisition order the static graph did not predict.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ "${1:-}" == "--concurrency" ]]; then
+  shift
+  echo "== concurrency rules (lock-order, wait-under-lock, process-boundary)"
+  # --no-baseline: the concurrency family ships with zero grandfathered
+  # findings, and a rule-scoped run must not judge other rules' entries
+  python -m karpenter_core_tpu.analysis --no-baseline \
+    --rules lock-order,wait-under-lock,process-boundary "$@"
+  echo "== lock-order witness + mutation-kill harness"
+  exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -q -p no:cacheprovider \
+    tests/test_lockwitness.py tests/test_concurrency.py
+fi
 if [[ "${1:-}" == "--telemetry" ]]; then
   shift
   echo "== bench ledger --check (BENCH_r*.json trajectory gates)"

@@ -186,7 +186,7 @@ def _index_module(ctx: FileContext) -> ModuleInfo:
             for t in targets:
                 if isinstance(t, ast.Name) and t.id.upper() == t.id:
                     mi.globals_caps.add(t.id)
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.Import):
             for a in node.names:
                 mi.imports[a.asname or a.name.split(".")[0]] = None
@@ -245,7 +245,7 @@ def _build_registry(files: Sequence[FileContext]) -> Registry:
         reg.globals[g] = ContainerSpec(g.strip("_").lower())
     # discovered: every `self.X = LRU("name")` is a cross-solve cache
     for f in files:
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if (
                 isinstance(node, ast.Assign)
                 and isinstance(node.value, ast.Call)
@@ -1832,7 +1832,7 @@ def check_cache_invalidation(pctx: ProjectContext):
     consumer_ctxs = pctx.matching(cfg.cluster_consumer_modules)
     api: Set[str] = set()
     for ctx in consumer_ctxs:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Attribute):
                 dn = dotted_name(node)
                 if dn:
@@ -2109,7 +2109,7 @@ def check_cache_determinism(pctx: ProjectContext):
                 sym_walk(child, nxt)
 
         sym_walk(f.tree, "")
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Name)
@@ -2332,7 +2332,7 @@ def check_cache_persist(pctx: ProjectContext):
             sym.split(".")[-1].startswith(("read_", "restore")) for sym, _ in fns
         ):
             compared: Set[str] = set()
-            for node in ast.walk(f.tree):
+            for node in f.walk():
                 if isinstance(node, ast.Compare):
                     for n in ast.walk(node):
                         if isinstance(n, ast.Name) and n.id in declared:

@@ -76,11 +76,12 @@ def _wall_calls_in(expr: ast.AST) -> Iterable[ast.Call]:
             yield node
 
 
-def _clock_default_sites(tree: ast.Module) -> Iterable[ast.AST]:
+def _clock_default_sites(nodes) -> Iterable[ast.AST]:
     """Expressions that install ``time.time`` (the function, not a call)
     as a stored/injectable clock: parameter defaults and
-    ``clock = time.time``-shaped assignments."""
-    for node in ast.walk(tree):
+    ``clock = time.time``-shaped assignments. ``nodes`` is the
+    file's cached preorder walk."""
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             args = node.args
             for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
@@ -130,7 +131,7 @@ def clock_discipline(ctx: FileContext) -> Iterable[Finding]:
         )
 
     # wall-clock reads participating in duration/expiry math
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Sub)):
             exprs: List[ast.AST] = [node.left, node.right]
         elif isinstance(node, ast.Compare):
@@ -149,7 +150,7 @@ def clock_discipline(ctx: FileContext) -> Iterable[Finding]:
                 )
 
     # wall clock installed as the injectable clock
-    for site in _clock_default_sites(ctx.tree):
+    for site in _clock_default_sites(ctx.walk()):
         emit(
             site,
             "'time.time' installed as an injectable clock default — every "

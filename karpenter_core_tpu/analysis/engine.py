@@ -157,6 +157,41 @@ class AnalysisConfig:
         "karpenter_core_tpu/kube/",
         "karpenter_core_tpu/state/",
     )
+    # every module that creates or acquires a threading primitive (ISSUE
+    # 18): the concurrency rule family discovers the lock inventory here,
+    # builds the global lock-order graph across the set, and scopes the
+    # wait-under-lock / process-boundary checks to it. Cross-file
+    # resolution loads the full set even on --changed-only runs.
+    concurrency_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/serving/pipeline.py",
+        "karpenter_core_tpu/serving/queues.py",
+        "karpenter_core_tpu/serving/latency.py",
+        "karpenter_core_tpu/provisioning/batcher.py",
+        "karpenter_core_tpu/provisioning/provisioner.py",
+        "karpenter_core_tpu/fleet/megasolve.py",
+        "karpenter_core_tpu/fleet/registry.py",
+        "karpenter_core_tpu/fleet/scheduler.py",
+        "karpenter_core_tpu/solver/solver.py",
+        "karpenter_core_tpu/solver/incremental.py",
+        "karpenter_core_tpu/solver/warmstore.py",
+        "karpenter_core_tpu/solver/prewarm.py",
+        "karpenter_core_tpu/solver/backends/__init__.py",
+        "karpenter_core_tpu/solver/podcache.py",
+        "karpenter_core_tpu/solver/oracle_bridge.py",
+        "karpenter_core_tpu/state/cluster.py",
+        "karpenter_core_tpu/kube/client.py",
+        "karpenter_core_tpu/kube/restclient.py",
+        "karpenter_core_tpu/kube/faults.py",
+        "karpenter_core_tpu/cloudprovider/fake.py",
+        "karpenter_core_tpu/operator/server.py",
+        "karpenter_core_tpu/metrics/registry.py",
+        "karpenter_core_tpu/events/recorder.py",
+        "karpenter_core_tpu/utils/atomic.py",
+        "karpenter_core_tpu/tracing/tracer.py",
+        "karpenter_core_tpu/tracing/flightrec.py",
+        "karpenter_core_tpu/tracing/deviceplane.py",
+        "karpenter_core_tpu/native/__init__.py",
+    )
 
 
 DEFAULT_CONFIG = AnalysisConfig()
@@ -198,6 +233,17 @@ class FileContext:
     lines: List[str]
     tree: ast.Module
     config: AnalysisConfig
+
+    def walk(self) -> List[ast.AST]:
+        """Memoized full-tree preorder walk. Every rule that scans the
+        whole module should iterate this instead of re-walking the tree
+        — with ~16 rule families the redundant traversals dominate the
+        CLI's wall time."""
+        nodes = getattr(self, "_walk_cache", None)
+        if nodes is None:
+            nodes = list(ast.walk(self.tree))
+            object.__setattr__(self, "_walk_cache", nodes)
+        return nodes
 
     def is_device_hot(self) -> bool:
         return any(self.relpath.endswith(m) for m in self.config.device_hot_modules)
@@ -308,6 +354,7 @@ def _load_rules() -> None:
         from . import (  # noqa: F401
             cachesound,
             clock,
+            concurrency,
             hygiene,
             hostsync,
             jitregistry,

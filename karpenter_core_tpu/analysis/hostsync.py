@@ -32,9 +32,9 @@ _NP_CONVERTERS = {"asarray", "array", "ascontiguousarray"}
 _SCALAR_CASTS = {"float", "int", "bool"}
 
 
-def _module_jit_functions(tree: ast.Module) -> Set[str]:
+def _module_jit_functions(nodes) -> Set[str]:
     out: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if jit_decoration(node) is not None:
                 out.add(node.name)
@@ -180,7 +180,7 @@ class _FunctionScan:
 def check_host_sync(ctx: FileContext):
     if not ctx.is_device_hot():
         return
-    producers = _module_jit_functions(ctx.tree) | set(ctx.config.device_producers)
+    producers = _module_jit_functions(ctx.walk()) | set(ctx.config.device_producers)
     symbols: Dict[ast.AST, str] = {}
 
     def visit(node: ast.AST, sym: str) -> None:

@@ -55,7 +55,7 @@ def check_broad_except(ctx: FileContext):
     from .engine import qualify
 
     qual = None
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.ExceptHandler):
             continue
         kind = _is_broad(node)
@@ -89,7 +89,7 @@ def check_mutable_default(ctx: FileContext):
     from .engine import qualify
 
     qual = None
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             continue
         a = node.args
@@ -129,7 +129,7 @@ def check_mutable_default(ctx: FileContext):
 def check_jnp_host_only(ctx: FileContext):
     if not ctx.is_host_only():
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         mods: List[str] = []
         if isinstance(node, ast.Import):
             mods = [a.name for a in node.names]

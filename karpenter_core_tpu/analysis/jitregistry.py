@@ -69,7 +69,7 @@ def check_jit_registry(ctx: FileContext):
     # decorator list (the decorator-form check owns those) and jit calls
     # passed directly to deviceplane.wrap(...)
     excused: Set[ast.AST] = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
                 excused.update(ast.walk(dec))
@@ -132,7 +132,7 @@ def check_jit_registry(ctx: FileContext):
 
     mark(ctx.tree, "")
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call) or node in excused:
             continue
         name = _jit_call_name(node)

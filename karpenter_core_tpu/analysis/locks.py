@@ -181,7 +181,7 @@ def _scan_method(fn: ast.AST, locks: Set[str]) -> _MethodInfo:
     "guarded self.* fields must be accessed under the owning class's lock",
 )
 def check_lock_discipline(ctx: FileContext):
-    for cls in ast.walk(ctx.tree):
+    for cls in ctx.walk():
         if not isinstance(cls, ast.ClassDef):
             continue
         locks = _lock_attrs(cls)

@@ -195,7 +195,7 @@ def _scan(fn: ast.AST, locks: Set[str]) -> Tuple[List[_Access], Set[str]]:
 def check_pipeline_safety(ctx: FileContext):
     if not _in_scope(ctx):
         return
-    for cls in ast.walk(ctx.tree):
+    for cls in ctx.walk():
         if not isinstance(cls, ast.ClassDef):
             continue
         entries = _thread_entries(cls)

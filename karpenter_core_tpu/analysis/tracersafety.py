@@ -242,12 +242,12 @@ def _used_static_only(fn: ast.AST, param: str) -> bool:
 )
 def check_tracer_safety(ctx: FileContext):
     method_ids: Set[int] = set()
-    for cls in ast.walk(ctx.tree):
+    for cls in ctx.walk():
         if isinstance(cls, ast.ClassDef):
             for item in cls.body:
                 if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     method_ids.add(id(item))
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         info = jit_decoration(node)

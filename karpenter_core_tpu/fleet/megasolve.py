@@ -392,6 +392,7 @@ class _MegaDispatcher:
                 # the real backend's lock spans the call and its per-call
                 # outputs (the PR-8 singleton discipline)
                 with self._backend.lock:
+                    # analysis: allow-wait-under-lock(device — backend.lock exists to serialize this dispatch and its output reads; the flusher holds nothing else, so the edge cannot deadlock)
                     packed = self._backend.pack_jobs(
                         all_jobs, all_metas, mesh=mesh, stats=self.stats
                     )
@@ -635,9 +636,25 @@ class FleetEngine:
         ]
         for t in threads:
             t.start()
+        # bounded join (wait-under-lock no-timeout sub-check): a wedged
+        # worker lane must surface as a counted timeout outcome, never a
+        # silent hang of the whole round
+        deadline = time.monotonic() + _env_int("KARPENTER_TPU_FLEET_JOIN_TIMEOUT_S", 300)
+        stragglers = 0
         for t in threads:
-            t.join()
-        return outcomes, dispatcher.summary()
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                stragglers += 1
+        summary = dispatcher.summary()
+        summary["join_timeouts"] = stragglers
+        if stragglers:
+            with out_mu:
+                for tid in order:
+                    if tid not in outcomes:
+                        outcomes[tid] = TenantOutcome(
+                            error="fleet worker join timed out", pods=len(work[tid])
+                        )
+        return outcomes, summary
 
     def debug_state(self) -> dict:
         with self._mu:

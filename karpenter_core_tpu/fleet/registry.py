@@ -95,6 +95,11 @@ class FleetRegistry:
         # object-identity ledgers backing the no-sharing invariant
         self._provider_owner: Dict[int, str] = {}
         self._cluster_owner: Dict[int, str] = {}
+        # tenants mid-admission: reserved under _mu in add_tenant's
+        # phase 1 so a concurrent duplicate add fails fast, while the
+        # expensive phase 2 (prewarm/restore/device replay) runs with
+        # the lock RELEASED (wait-under-lock rule)
+        self._admitting: set = set()
         self.plane = plane or CatalogPlane()
         self.metrics = metrics
         self.generation = 0  # bumped by add/remove (debug/round snapshots)
@@ -129,8 +134,13 @@ class FleetRegistry:
         from .megasolve import TenantCatalogView
 
         tenant_id = str(tenant_id)
+        # -- phase 1 (under _mu): validate + reserve. The identity
+        # ledgers and the _admitting set make the reservation visible to
+        # concurrent adds; nothing slow happens while the lock is held
+        # (the wait-under-lock rule flags prewarm/restore/device replay
+        # under _mu — they run in phase 2, unlocked).
         with self._mu:
-            if tenant_id in self._tenants:
+            if tenant_id in self._tenants or tenant_id in self._admitting:
                 raise ValueError(f"tenant {tenant_id!r} already registered")
             owner = self._provider_owner.get(id(provider))
             if owner is not None:
@@ -146,6 +156,19 @@ class FleetRegistry:
                         f"cluster already registered to tenant {c_owner!r} — "
                         "tenants must not share cluster state"
                     )
+            self._admitting.add(tenant_id)
+            self._provider_owner[id(provider)] = tenant_id
+            if cluster is not None:
+                self._cluster_owner[id(cluster)] = tenant_id
+            path = restore_from or self.evicted_snapshots.pop(tenant_id, None)
+            popped_eviction = path is not None and restore_from is None
+
+        # -- phase 2 (lock released): build the solver and pay the
+        # expensive admission work — catalog prewarm, warm-state restore
+        # (file I/O), jitsig device replay. Failures roll the
+        # reservation back.
+        published = False
+        try:
             view = TenantCatalogView(provider, self.plane, tenant_id)
             solver = TPUScheduler(
                 nodepools,
@@ -168,11 +191,6 @@ class FleetRegistry:
                 cluster=cluster,
                 kube_client=kube_client,
             )
-            self._tenants[tenant_id] = handle
-            self._provider_owner[id(provider)] = tenant_id
-            if cluster is not None:
-                self._cluster_owner[id(cluster)] = tenant_id
-            self.generation += 1
             # admission pays the tenant's catalog fingerprints (once per
             # catalog generation), keeping its first round's timeline
             # clean — see CatalogPlane.prewarm
@@ -182,7 +200,6 @@ class FleetRegistry:
             # (re-admission = migration back). Restored planes re-anchor
             # against the LIVE catalog/cluster world — content that no
             # longer matches is dropped, never trusted (warmstore.py)
-            path = restore_from or self.evicted_snapshots.pop(tenant_id, None)
             if path is not None:
                 from .megasolve import fleet_engine_name
 
@@ -214,7 +231,30 @@ class FleetRegistry:
                     log.exception(
                         "tenant %s admission jitsig replay failed", tenant_id
                     )
+
+            # -- phase 3 (under _mu): publish. The reservation made the
+            # tenant id and object identities ours, so this cannot race.
+            with self._mu:
+                self._tenants[tenant_id] = handle
+                self._admitting.discard(tenant_id)
+                self.generation += 1
+                published = True
             return handle
+        finally:
+            if not published:
+                with self._mu:
+                    self._admitting.discard(tenant_id)
+                    if self._provider_owner.get(id(provider)) == tenant_id:
+                        del self._provider_owner[id(provider)]
+                    if (
+                        cluster is not None
+                        and self._cluster_owner.get(id(cluster)) == tenant_id
+                    ):
+                        del self._cluster_owner[id(cluster)]
+                    # keep the migration path retryable: the snapshot
+                    # file still exists, so re-admission can restore it
+                    if popped_eviction:
+                        self.evicted_snapshots.setdefault(tenant_id, path)
 
     def snapshot_tenant(self, tenant_id: str, directory: Optional[str] = None) -> Optional[str]:
         """Snapshot one tenant's cache planes → path (or None when the

@@ -129,11 +129,24 @@ class KubeClient:
         return obj
 
     def apply(self, obj: KubeObject) -> KubeObject:
-        """Create-or-update convenience."""
-        with self._lock:
-            if self._key(obj) in self._objects[obj.kind]:
-                return self.update(obj)
-            return self.create(obj)
+        """Create-or-update convenience. The existence probe holds
+        ``_lock`` but the write itself must not: update/create deliver
+        watch callbacks through ``_notify``, and holding the lock across
+        them would invert the client/controller lock order (see
+        ``_notify``). A racing create or delete between probe and write
+        is absorbed by retrying in the other mode."""
+        for _ in range(3):
+            with self._lock:
+                exists = self._key(obj) in self._objects[obj.kind]
+            try:
+                return self.update(obj) if exists else self.create(obj)
+            except NotFound:
+                continue  # deleted between probe and update → retry as create
+            except Conflict:
+                if exists:
+                    raise  # genuine resourceVersion conflict
+                continue  # created between probe and create → retry as update
+        return self.update(obj)
 
     def retry_on_conflict(
         self,

@@ -178,3 +178,16 @@ class RestFaultInjector:
                 with self._mu:
                     self.injected.append((ordinal, ev.kind))
                 raise ConnectionResetError(f"injected: {self.schedule.name}")
+            elif ev.kind == "error_burst" and stream:
+                # the adapter-level face of an expired-rv burst: the
+                # stream request itself fails with a server error; the
+                # watch loop counts it (reason="http"), backs off
+                # without relisting, and resumes from the last rv. The
+                # in-stream ERROR-event face is driven by the harness
+                # (it owns the event channel; the injector owns the
+                # request choke point).
+                from .restclient import ApiError
+
+                with self._mu:
+                    self.injected.append((ordinal, ev.kind))
+                raise ApiError(500, f"injected: {self.schedule.name}")

@@ -1909,10 +1909,13 @@ class TPUScheduler:
         # zero)
         with tracer.trace_root("prewarm_catalog", buffer_if="never"):
             pools, pool_catalogs = self._build_pools()
+            # generation probes go through the cloud provider's own lock;
+            # hoisted before _CATALOG_LOCK so the global catalog lock
+            # never nests a foreign lock
+            gens = [cg(p.nodepool) if callable(cg) else None for p in pools]
             with _CATALOG_LOCK:
                 with tracer.span("encode.catalog"):
-                    for pool, cat in zip(pools, pool_catalogs):
-                        gen = cg(pool.nodepool) if callable(cg) else None
+                    for gen, cat in zip(gens, pool_catalogs):
                         _catalog_entry(cat, generation=gen, stats=self._cstats)
         stats = self._cstats.to_dict()
         stats["pools"] = len(pools)
@@ -2053,11 +2056,13 @@ class TPUScheduler:
         # (vocab interning, mask extension, device repack, compat rows)
         ws = self._warm
         cg = getattr(self.cloud_provider, "catalog_generation", None)
+        # provider generation probes take the provider's own lock —
+        # hoisted so _CATALOG_LOCK never nests a foreign lock
+        gens = [cg(p.nodepool) if callable(cg) else None for p in pools]
         with _CATALOG_LOCK:
             with tracer.span("encode.catalog"):
                 pool_entries = []
-                for pool, cat in zip(pools, pool_catalogs):
-                    gen = cg(pool.nodepool) if callable(cg) else None
+                for gen, cat in zip(gens, pool_catalogs):
                     pool_entries.append(
                         _catalog_entry(cat, generation=gen, stats=self._cstats)
                     )
@@ -4582,6 +4587,7 @@ class TPUScheduler:
         # not overwrite last_stats/last_job_flags between them
         with backend.lock:
             packed = (
+                # analysis: allow-wait-under-lock(device — backend.lock exists to serialize this dispatch and its output reads; the solver holds no other lock here, so the edge cannot deadlock)
                 backend.pack_jobs(
                     [jobs[i] for i in miss],
                     miss_metas,

@@ -270,8 +270,14 @@ def build_payload(solver) -> dict:
     sig_names = podcache.sig_for_id()
 
     catalogs: List[dict] = []
+    # the catalog fetch inside _collect_catalog_entries probes the cloud
+    # provider (its own lock; for fleet tenants also the canonical
+    # catalog plane) — it must run before _CATALOG_LOCK so the global
+    # catalog lock never nests a foreign lock. Only the shared-entry
+    # reads below hold it.
+    entries = _collect_catalog_entries(solver)
     with _CATALOG_LOCK:
-        for fp, entry in _collect_catalog_entries(solver):
+        for fp, entry in entries:
             rows = []
             for (pool_fp, sid), row in entry.sig_rows.items():
                 sig = sig_names.get(sid)
@@ -355,9 +361,12 @@ def build_payload(solver) -> dict:
         if stored is not None:
             payload["screen_rows"].append((stored, row))
 
+    # the witness digest reads the kube store (KubeClient._lock) — taken
+    # before ws.lock so the warm-state lock never nests the client's
+    witness = cluster_witness(solver.kube_client)
     with ws.lock:
         payload["seeds"] = {
-            "witness": cluster_witness(solver.kube_client),
+            "witness": witness,
             # snapshot-time counter value, recorded for debugging ONLY:
             # restore re-anchors to the live cluster's counter and must
             # never trust this one (cache-persist rule)

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..apis import labels as wk
 from ..apis.nodeclaim import NodeClaim
+from ..kube.client import KubeClient
 from ..kube.objects import DaemonSet, Node, Pod
 from ..scheduling import resources
 from ..utils import pod as podutils
@@ -24,7 +25,7 @@ from .statenode import StateNode
 
 class Cluster:
     # analysis: allow-clock(nomination/consolidation stamps are exchanged with kube-object wall-clock stamps)
-    def __init__(self, kube_client, cloud_provider=None, clock: Callable[[], float] = time.time):
+    def __init__(self, kube_client: KubeClient, cloud_provider=None, clock: Callable[[], float] = time.time):
         self.kube_client = kube_client
         self.cloud_provider = cloud_provider
         self.clock = clock

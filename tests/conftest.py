@@ -13,6 +13,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # test; production leaves them disabled. Must be set before the solver
 # modules import.
 os.environ.setdefault("KARPENTER_TPU_SHAPE_CONTRACTS", "1")
+# runtime lock-order witness (analysis/lockwitness.py, ISSUE 18): on for
+# every test, off in production — same discipline as shape contracts.
+# The install MUST precede the package imports below, because the
+# witness wraps threading constructors at lock CREATION sites.
+os.environ.setdefault("KARPENTER_TPU_LOCK_WITNESS", "1")
+if os.environ.get("KARPENTER_TPU_LOCK_WITNESS", "") == "1":
+    from karpenter_core_tpu.analysis import lockwitness
+
+    lockwitness.install()
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -30,6 +39,26 @@ import pytest
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: at-scale gates (parity at 5k+ pods); always run in CI"
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_witness_gate():
+    """Session-wide witness assertion (ISSUE 18): every lock-order edge
+    the tests actually exercised must be present in the static
+    lock-order graph — the dynamic and static analyses validate each
+    other. Runs at teardown so the whole tier-1 workload contributes."""
+    yield
+    from karpenter_core_tpu.analysis import lockwitness
+
+    if not lockwitness.installed():
+        return
+    observed, unexplained = lockwitness.verify_against_static()
+    assert not unexplained, (
+        "runtime lock-order witness observed acquisition edges missing "
+        f"from the static graph: {sorted(unexplained)} "
+        f"(observed {len(observed)} edges total — extend "
+        "analysis/concurrency.py resolution rather than weakening this gate)"
     )
 
 

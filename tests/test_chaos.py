@@ -129,6 +129,19 @@ class TestRestFaultInjector:
         inj("POST", "/api/v1/pods", False)  # ordinal 2: writes unaffected
         assert inj.injected == [(1, "watch_flap")]
 
+    def test_error_burst_is_stream_only_500(self):
+        inj = RestFaultInjector(
+            self._sched(
+                FaultEvent("error_burst", 1, duration=1),
+                FaultEvent("error_burst", 2, duration=1),
+            )
+        )
+        inj("GET", "/api/v1/pods", False)  # ordinal 1: plain GET untouched
+        with pytest.raises(ApiError) as err:
+            inj("GET", "/api/v1/pods?watch=1", True)  # ordinal 2
+        assert err.value.code == 500
+        assert inj.injected == [(2, "error_burst")]
+
 
 class _Counter:
     def __init__(self):
@@ -181,6 +194,159 @@ class TestWatchLoopUnderFaults:
             watcher.close()
             writer.close()
             stub.stop()
+
+
+class TestOrphanFaultKindSmoke:
+    """ISSUE 18 satellites: the three fault kinds that were declared in
+    FAULT_KINDS but exercised nowhere (relist_storm, error_burst,
+    heartbeat_loss), each promoted to a tier-1 smoke — the injector (or
+    schedule) engages, the degradation contract holds, the counters
+    move, and the system recovers."""
+
+    def _watching(self, monkeypatch, schedule):
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_BASE_MS", "5")
+        monkeypatch.setenv("KARPENTER_TPU_WATCH_BACKOFF_MAX_MS", "20")
+        stub = _StubApiServer()
+        watcher = RestKubeClient(stub.url)
+        writer = RestKubeClient(stub.url)
+        relists, errors, backoff = _Counter(), _Counter(), _Counter()
+        watcher.attach_watch_metrics(
+            relists=relists, errors=errors, backoff_seconds=backoff
+        )
+        if schedule is not None:
+            watcher.fault_injector = RestFaultInjector(schedule)
+        return stub, watcher, writer, relists, errors, backoff
+
+    def test_relist_storm_410_relists_and_recovers(self, monkeypatch):
+        """410 Gone on the first stream attempt: counted under
+        reason="410", forces a RE-LIST (the event cache window passed),
+        backs off, and the re-established stream still delivers."""
+        stub, watcher, writer, relists, errors, backoff = self._watching(
+            monkeypatch,
+            # ordinal 1 is the initial relist GET; ordinal 2 the first
+            # stream request — storm exactly that one
+            FaultSchedule("storm", 0, [FaultEvent("relist_storm", 2, duration=1)]),
+        )
+        seen = threading.Event()
+
+        def cb(etype, obj):
+            if obj.name == "storm-claim":
+                seen.set()
+
+        try:
+            watcher.watch("NodeClaim", cb)
+            time.sleep(0.4)  # 410 + relist + backoff + re-established stream
+            nc = NodeClaim()
+            nc.metadata.name = "storm-claim"
+            writer.create(nc)
+            assert seen.wait(5.0), "watch must recover after the 410 storm"
+            assert any(lb.get("reason") == "410" for lb in errors.labels)
+            assert relists.total >= 2, "initial list + post-410 relist"
+            assert backoff.total > 0.0
+            assert watcher.fault_injector.injected == [(2, "relist_storm")]
+        finally:
+            watcher.close()
+            writer.close()
+            stub.stop()
+
+    def test_error_burst_500_backs_off_and_recovers(self, monkeypatch):
+        """The adapter-level face of an error burst (injector arm): the
+        stream request fails with a 500, counted under reason="http" —
+        no relist (the rv is still good), one backoff step, resume."""
+        stub, watcher, writer, relists, errors, backoff = self._watching(
+            monkeypatch,
+            FaultSchedule("burst", 0, [FaultEvent("error_burst", 2, duration=1)]),
+        )
+        seen = threading.Event()
+
+        def cb(etype, obj):
+            if obj.name == "burst-claim":
+                seen.set()
+
+        try:
+            watcher.watch("NodeClaim", cb)
+            time.sleep(0.4)
+            nc = NodeClaim()
+            nc.metadata.name = "burst-claim"
+            writer.create(nc)
+            assert seen.wait(5.0), "watch must recover after the error burst"
+            assert any(lb.get("reason") == "http" for lb in errors.labels)
+            assert backoff.total > 0.0
+            assert watcher.fault_injector.injected == [(2, "error_burst")]
+        finally:
+            watcher.close()
+            writer.close()
+            stub.stop()
+
+    def test_error_burst_in_stream_error_event_relists(self, monkeypatch):
+        """The in-stream face of an error burst: an ERROR event on a
+        healthy stream (expired resourceVersion, apiserver-pushed) is
+        counted under reason="error_event", forces a relist, and the
+        re-established stream keeps delivering."""
+        stub, watcher, writer, relists, errors, _backoff = self._watching(
+            monkeypatch, None
+        )
+        seen = threading.Event()
+
+        def cb(etype, obj):
+            if obj.name == "burst-claim":
+                seen.set()
+
+        try:
+            watcher.watch("NodeClaim", cb)
+            assert _wait(lambda: len(stub.watchers) >= 1)
+            with stub.lock:
+                _, q = stub.watchers[0]
+            q.put({"type": "ERROR", "object": {"metadata": {"resourceVersion": "0"}}})
+            # ERROR → relist → a fresh stream registers a second watcher
+            assert _wait(lambda: len(stub.watchers) >= 2)
+            nc = NodeClaim()
+            nc.metadata.name = "burst-claim"
+            writer.create(nc)
+            assert seen.wait(5.0), "watch must keep delivering after the burst"
+            assert any(lb.get("reason") == "error_event" for lb in errors.labels)
+            assert relists.total >= 2, "initial list + post-ERROR relist"
+        finally:
+            watcher.close()
+            writer.close()
+            stub.stop()
+
+    def test_heartbeat_loss_window_holds_ticks_until_recovery(self):
+        """A heartbeat_loss schedule window drives the watch-health seam
+        (set_world_stale — node Ready heartbeats stopped): every tick
+        inside the window holds (counted, nothing planned), and the
+        first post-window heartbeat releases the held work."""
+        sched = FaultSchedule("hb", 3, [FaultEvent("heartbeat_loss", 1, duration=2)])
+        assert sched.first("heartbeat_loss") is not None
+        harness = tg.TrafficHarness(teams=2)
+        pipe = _pipe(harness)
+        pipe.start()
+        try:
+            # step 0: healthy, heartbeats arriving
+            assert sched.kinds_at(0) == ()
+            pipe.note_world_event()
+            assert not pipe.world_is_stale()
+            # steps 1-2: window active — the health monitor reports loss
+            assert "heartbeat_loss" in sched.kinds_at(1)
+            pipe.set_world_stale(True)
+            step = tg.Step(
+                creates=[tg.PodSpecLite(f"hb-{i}", "100m", "128Mi", None, 0) for i in range(3)]
+            )
+            harness.inject_step(step, 1)
+            assert _wait(lambda: pipe.held_ticks()["stale"] >= 1)
+            assert pipe.latency.decided_count() == 0, (
+                "no plan may be emitted against a heartbeat-less world"
+            )
+            # step 3: window over — heartbeats resume
+            assert sched.kinds_at(3) == ()
+            pipe.set_world_stale(False)
+            pipe.note_world_event()
+            assert pipe.quiesce(timeout=30.0)
+            assert pipe.latency.decided_count() == 3
+            assert pipe.debug_state()["chaos"]["held_ticks"]["stale"] >= 1
+        finally:
+            pipe.stop()
+            harness.close()
 
 
 class TestWatchBackoff:

@@ -1,0 +1,163 @@
+"""Runtime lock-order witness (ISSUE 18, analysis/lockwitness.py).
+
+The witness is installed by conftest.py before any package import, so
+every inventoried coordination lock created during the test session is
+a recording wrapper. These tests verify the instrumentation itself:
+wrapping, edge recording, condition-wait semantics, and that the
+verify gate actually detects an unpredicted ordering.
+"""
+
+import threading
+
+import pytest
+
+from karpenter_core_tpu.analysis import lockwitness
+from karpenter_core_tpu.analysis.concurrency import (
+    lock_inventory,
+    static_order_graph,
+    witness_inventory,
+)
+from karpenter_core_tpu.analysis.engine import repo_root
+from karpenter_core_tpu.kube.client import KubeClient
+from karpenter_core_tpu.state.cluster import Cluster
+
+from helpers import make_node
+
+pytestmark = pytest.mark.skipif(
+    not lockwitness.installed(), reason="lock witness not installed"
+)
+
+
+def _preserving_edges():
+    """Snapshot/restore of the global edge set so white-box tests can
+    inject synthetic edges without polluting the session gate."""
+    with lockwitness._edges_mu:
+        return set(lockwitness._edges)
+
+
+def _restore_edges(saved):
+    with lockwitness._edges_mu:
+        lockwitness._edges.clear()
+        lockwitness._edges.update(saved)
+
+
+def test_witness_installed_and_instrumented():
+    assert lockwitness.installed()
+    # the inventory is non-trivial: the package has dozens of
+    # coordination locks and a decent fraction are non-sink
+    assert lockwitness.instrumented_count() >= 10
+
+
+def test_inventoried_locks_are_wrapped():
+    client = KubeClient()
+    cluster = Cluster(client)
+    assert isinstance(client._lock, lockwitness._WitnessLock)
+    assert isinstance(cluster._mu, lockwitness._WitnessLock)
+    assert cluster._mu.lock_id == "karpenter_core_tpu/state/cluster.py::Cluster._mu"
+
+
+def test_sink_locks_not_instrumented():
+    root = repo_root()
+    sinks = {d.lock_id for d in lock_inventory(root) if d.sink}
+    instrumented = {lock_id for lock_id, _kind in witness_inventory(root).values()}
+    assert instrumented, "witness inventory is empty"
+    assert not (instrumented & sinks), (
+        "sink locks must not be instrumented: " + str(instrumented & sinks)
+    )
+
+
+def test_nested_acquisition_records_predicted_edge():
+    """Cluster.update_node reads the kube store under ``_mu`` — the
+    witness must record the Cluster._mu → KubeClient._lock edge and the
+    static graph must already predict it."""
+    cluster = Cluster(KubeClient())
+    cluster.update_node(make_node(name="witness-n1"))
+    edge = (
+        "karpenter_core_tpu/state/cluster.py::Cluster._mu",
+        "karpenter_core_tpu/kube/client.py::KubeClient._lock",
+    )
+    assert edge in lockwitness.observed_edges()
+    assert edge in static_order_graph(repo_root())
+
+
+def test_reentrant_acquisition_records_no_self_edge():
+    client = KubeClient()
+    with client._lock:
+        with client._lock:
+            pass
+    lock_id = "karpenter_core_tpu/kube/client.py::KubeClient._lock"
+    assert (lock_id, lock_id) not in lockwitness.observed_edges()
+
+
+def test_verify_gate_flags_unpredicted_edge():
+    """Negative control: an edge the static graph never predicted must
+    surface as unexplained — this is the property the session-scoped
+    conftest gate relies on."""
+    saved = _preserving_edges()
+    try:
+        bogus = (
+            "karpenter_core_tpu/kube/client.py::KubeClient._lock",
+            "karpenter_core_tpu/state/cluster.py::Cluster._mu",
+        )
+        with lockwitness._edges_mu:
+            lockwitness._edges.add(bogus)
+        observed, unexplained = lockwitness.verify_against_static()
+        assert bogus in observed
+        assert bogus in unexplained
+    finally:
+        _restore_edges(saved)
+
+
+def test_condition_wait_does_not_invent_edges():
+    """A Condition.wait wakeup re-pushes without recording: waiting on
+    an inventoried condition while holding another lock must not create
+    a reversed or wakeup-ordered edge. Exercised white-box with
+    synthetic ids, restored afterwards so the session gate never sees
+    them."""
+    saved = _preserving_edges()
+    try:
+        outer = lockwitness._WitnessLock(threading.Lock(), "test::outer")
+        cond = lockwitness._WitnessCondition(
+            lockwitness._REAL_CONDITION(), "test::cond"
+        )
+        with outer:
+            with cond:
+                cond.wait(timeout=0.01)
+        observed = lockwitness.observed_edges()
+        assert ("test::outer", "test::cond") in observed
+        # wakeup re-push must NOT record cond→outer or a second edge
+        assert ("test::cond", "test::outer") not in observed
+    finally:
+        _restore_edges(saved)
+
+
+def test_witness_lock_protocol_delegates():
+    lock = lockwitness._WitnessLock(threading.Lock(), "test::proto")
+    assert lock.acquire(blocking=False)
+    assert lock.locked()
+    lock.release()
+    assert not lock.locked()
+    assert "test::proto" in repr(lock)
+
+
+def test_static_graph_is_acyclic():
+    """The lock-order rule reports cycles as findings (currently zero),
+    so the shipped static graph must be a DAG."""
+    graph = static_order_graph(repo_root())
+    adj = {}
+    for src, dst in graph:
+        adj.setdefault(src, set()).add(dst)
+    state = {}  # 1 = visiting, 2 = done
+
+    def visit(node, stack):
+        state[node] = 1
+        for nxt in adj.get(node, ()):
+            if state.get(nxt) == 1:
+                raise AssertionError(f"lock-order cycle: {stack + [nxt]}")
+            if state.get(nxt) != 2:
+                visit(nxt, stack + [nxt])
+        state[node] = 2
+
+    for node in list(adj):
+        if state.get(node) != 2:
+            visit(node, [node])
