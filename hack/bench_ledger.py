@@ -63,6 +63,14 @@ SCHEMA = 1
 #   reproducible run to run. Free-run serving latencies and speedup
 #   ratios swing ±20% with machine load (observed across r06→r07 on
 #   unchanged code), so gating them relatively would cry wolf.
+#   Host lanes (r10): wall-clock/throughput lanes additionally compare
+#   only rounds measured on the same host class (the bench artifact's
+#   ``host.cpus`` fingerprint) — r10 ran on a 1-core container and
+#   measured the threaded serving paths ~2x slower than r09's box ON
+#   UNCHANGED CODE (verified by an A/B at the r09 commit), which no
+#   threshold can absorb. Quality lanes (HOST_NEUTRAL_GATES: LP gaps,
+#   savings, tick counts) stay comparable across every host. Rounds
+#   predating the fingerprint lane as host class "unknown".
 # - ABSOLUTE gates mirror each config's published bench target (the
 #   gate bench.py itself enforces): a floor for wins (pipeline speedup
 #   ≥1.5x, fleet ratio ≥3x, LP saving ≥5%), a ceiling for budgets
@@ -108,7 +116,34 @@ RELATIVE_GATES: List[Tuple[str, str, str]] = [
     ("config15", "clean.steady_p99_ms", "down"),
     ("config15", "worst_steady_p99_ms", "down"),
     ("config15", "worst_slo_burn", "down"),
+    # ISSUE 19: the optimality tier's per-shape LP gap lanes — each
+    # adversarial price shape's certified gap (cost vs dual bound) on
+    # its own trajectory. The gap is a pure plan-quality number (no
+    # wall-clock in it), so it reproduces run to run; a widening gap
+    # means refinement/branching stopped closing it. Retro-safe: the
+    # metric first appears in r10, so prior rounds have no lane.
+    ("config10", "per_shape_gap.bignode-trap", "down"),
+    ("config10", "per_shape_gap.midsize-sweetspot", "down"),
+    ("config10", "per_shape_gap.podcap-trap", "down"),
+    ("config10", "per_shape_gap.hetero-split", "down"),
+    ("config10", "per_shape_gap.hetero-split-narrow", "down"),
+    ("config10", "per_shape_gap.hetero-split-wide", "down"),
+    ("config10", "per_shape_gap.spot-cliff-steep", "down"),
+    ("config10", "per_shape_gap.spot-cliff-shallow", "down"),
+    ("config10", "per_shape_gap.capacity-drought", "down"),
+    ("config10", "per_shape_gap.superlinear-ladder", "down"),
 ]
+# relative gates whose numbers carry NO wall-clock: plan-quality and
+# count lanes, comparable across host classes. Every other relative
+# gate is host-sensitive and only compares same-host-class rounds.
+HOST_NEUTRAL_GATES: frozenset = frozenset(
+    [
+        ("config10", "adversarial_saving_pct"),
+        ("config14", "ticks_to_warm"),
+    ]
+    + [(cfg, m) for cfg, m, _d in RELATIVE_GATES if m.startswith("per_shape_gap.")]
+)
+
 ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     # (config, metric, "floor"|"ceiling", bound)
     ("config8", "steady_p99_speedup_vs_sequential", "floor", 1.5),
@@ -117,6 +152,11 @@ ABSOLUTE_GATES: List[Tuple[str, str, str, float]] = [
     ("config9", "plan_identical_all", "floor", 1.0),
     ("config10", "adversarial_saving_pct", "floor", 5.0),
     ("config10", "lp_not_worse_all", "floor", 1.0),
+    # ISSUE 19: the worst adversarial shape's certified LP gap must
+    # stay under the published ceiling — the optimality tier's
+    # headline promise, and an absolute bound so a future round can
+    # never trade gap for speed silently
+    ("config10", "opt_gap_pct_worst", "ceiling", 50.0),
     # floor re-calibrated 3.0 → 2.5 in PR 11: the solo denominator got
     # ~50% faster (streamed catalog fingerprint) with batched absolute
     # throughput unchanged — the batched lane's own trajectory is now
@@ -236,12 +276,16 @@ def parse_round(path: str) -> dict:
         "rc": rc,
         "status": "error",
         "backend": None,
+        "host_cpus": None,
         "headline": {},
         "configs": [],
     }
     if isinstance(parsed, dict):
         out["status"] = "ok"
         out["backend"] = parsed.get("backend")
+        host = parsed.get("host")
+        if isinstance(host, dict) and isinstance(host.get("cpus"), int):
+            out["host_cpus"] = host["cpus"]
         out["headline"] = {k: v for k, v in parsed.items() if k != "configs"}
         out["configs"] = [c for c in parsed.get("configs", []) if isinstance(c, dict)]
     if rc not in (0, None) and not out["configs"] and not out["headline"]:
@@ -361,12 +405,21 @@ def absolute_gate(config: str, metric: str) -> Optional[Tuple[str, float]]:
 
 
 def check_regressions(
-    traj: Dict[Tuple[str, str, str], Dict[int, float]], threshold: float
+    traj: Dict[Tuple[str, str, str], Dict[int, float]],
+    threshold: float,
+    hosts: Optional[Dict[int, Optional[int]]] = None,
 ) -> List[dict]:
     """Gate pass over the trajectory table: relative gates compare the
     latest round against the best prior same-backend round; absolute
     gates hold the latest round to each config's published bench
-    target. Returns the list of failures (empty = pass)."""
+    target. Returns the list of failures (empty = pass).
+
+    ``hosts`` maps round → host cpu count (None = predates the
+    fingerprint). When given, host-sensitive relative gates (everything
+    outside HOST_NEUTRAL_GATES) only compare rounds of the same host
+    class — wall-clock on a 1-core container vs a multi-core box is a
+    hardware delta, not a code regression. Omitted (tests, old
+    ledgers): every round is one class, prior behavior exactly."""
     failures: List[dict] = []
     for (backend, config, metric), series in sorted(traj.items()):
         latest_round = max(series)
@@ -392,6 +445,9 @@ def check_regressions(
         if direction is None or len(series) < 2:
             continue
         prior = {r: v for r, v in series.items() if r != latest_round}
+        if hosts is not None and (config, metric) not in HOST_NEUTRAL_GATES:
+            latest_host = hosts.get(latest_round)
+            prior = {r: v for r, v in prior.items() if hosts.get(r) == latest_host}
         if not prior:
             continue
         best = min(prior.values()) if direction == "down" else max(prior.values())
@@ -471,17 +527,22 @@ def write_markdown(
         "",
         "Generated by `hack/bench_ledger.py` from the `BENCH_r*.json` round",
         "artifacts. Gate metrics compare the latest round against the best",
-        f"prior same-backend round at a {threshold:.0%} threshold.",
+        f"prior same-backend round at a {threshold:.0%} threshold; wall-clock",
+        "lanes additionally compare only same-host-class rounds (`host cpus`",
+        "below — hardware deltas are not code regressions; quality lanes",
+        "like the LP gaps stay comparable everywhere).",
         "",
         "## Rounds",
         "",
-        "| round | file | status | backend | configs |",
-        "|---|---|---|---|---|",
+        "| round | file | status | backend | host cpus | configs |",
+        "|---|---|---|---|---|---|",
     ]
     for rd in rounds:
+        cpus = rd.get("host_cpus")
         lines.append(
             f"| r{rd['round']:02d} | {rd['file']} | {rd['status']} "
-            f"| {rd.get('backend') or '-'} | {len(rd['configs'])} |"
+            f"| {rd.get('backend') or '-'} | {cpus if cpus else '?'} "
+            f"| {len(rd['configs'])} |"
         )
     lane_rounds: Dict[str, set] = {}
     for (backend, _config, _metric), series in traj.items():
@@ -553,12 +614,13 @@ def build_ledger(bench_dir: str, threshold: float) -> dict:
     rounds = [parse_round(p) for p in paths]
     rows = build_table(rounds)
     traj = trajectories(rows)
-    failures = check_regressions(traj, threshold)
+    hosts = {rd["round"]: rd.get("host_cpus") for rd in rounds}
+    failures = check_regressions(traj, threshold, hosts=hosts)
     return {
         "schema": SCHEMA,
         "threshold": threshold,
         "rounds": [
-            {k: rd[k] for k in ("round", "file", "rc", "status", "backend")}
+            {k: rd[k] for k in ("round", "file", "rc", "status", "backend", "host_cpus")}
             | {"configs": len(rd["configs"]), "headline_metrics": len(rd["headline"])}
             for rd in rounds
         ],

@@ -2176,7 +2176,7 @@ def check_cache_determinism(pctx: ProjectContext):
 #
 # solver/warmstore.py serializes the memo planes to disk and restores
 # them into a DIFFERENT process. The in-memory rules above prove keys
-# witness their read-sets; persistence adds four ways to break the
+# witness their read-sets; persistence adds five ways to break the
 # same invariant that no in-memory analysis can see:
 #
 # - trusting a PERSISTED generation counter: generation guards are
@@ -2192,7 +2192,12 @@ def check_cache_determinism(pctx: ProjectContext):
 # - restoring the compile-cache plane (ISSUE 17) without comparing the
 #   stored jax/jaxlib/platform fingerprint against the live process —
 #   foreign XLA executables are the one payload whose digests cannot
-#   witness compatibility, only provenance.
+#   witness compatibility, only provenance;
+# - restoring the warm-dual plane (ISSUE 19) without parsing its key
+#   components as what the writer's contract claims — a price-table
+#   fingerprint that isn't a finite float table, or an iteration
+#   budget that isn't a sane int, lands duals under keys a live solve
+#   could alias after a budget or price-model change.
 
 
 _PAYLOAD_PARAM_RE = re.compile(
@@ -2407,6 +2412,76 @@ def check_cache_persist(pctx: ProjectContext):
                     + " — a snapshot from a different jax/jaxlib/platform "
                     "would replay foreign XLA executables (drop the plane "
                     "counted on mismatch, never trust it)"
+                ),
+                severity=SEV_ERROR,
+            )
+
+        # (5) warm-dual plane witnessing (ISSUE 19): a restore unit
+        # that handles the "lprelax" plane installs another process's
+        # converged dual weights as memo values keyed by a price-table
+        # fingerprint and an iteration budget. Both key components must
+        # be witnessed before a row lands: the price bytes must parse
+        # as a FINITE float table (a non-finite price in the key means
+        # the stored bound certifies a price model the live guard never
+        # prices with), and the iteration budget must survive a sanity
+        # comparison (the budget is a first-class key/job-token
+        # component — restoring rows with a bogus budget would let a
+        # future budget change alias a foreign solve's duals)
+        for sym, fn_node in fns:
+            leaf = sym.split(".")[-1]
+            if not leaf.startswith(("restore", "_restore")):
+                continue
+            touches_plane = any(
+                isinstance(n, ast.Constant) and n.value == "lprelax"
+                for n in ast.walk(fn_node)
+            )
+            if not touches_plane:
+                continue
+            witnesses_prices = any(
+                isinstance(n, ast.Call)
+                and (
+                    (isinstance(n.func, ast.Attribute) and n.func.attr == "isfinite")
+                    or (isinstance(n.func, ast.Name) and n.func.id == "isfinite")
+                )
+                # the finiteness witness must hold the PRICE table —
+                # an isfinite on some other field doesn't witness it
+                and any(
+                    isinstance(a, ast.Name) and "price" in a.id
+                    for arg in n.args
+                    for a in ast.walk(arg)
+                )
+                for n in ast.walk(fn_node)
+            )
+            checks_budget = any(
+                isinstance(node, ast.Compare)
+                and any(
+                    isinstance(n, ast.Name) and "iters" in n.id
+                    for n in ast.walk(node)
+                )
+                for node in ast.walk(fn_node)
+            )
+            if witnesses_prices and checks_budget:
+                continue
+            missing_bits = []
+            if not witnesses_prices:
+                missing_bits.append(
+                    "never witnesses the stored price-table fingerprint as finite"
+                )
+            if not checks_budget:
+                missing_bits.append(
+                    "never sanity-compares the stored iteration budget"
+                )
+            yield Finding(
+                rule="cache-persist",
+                path=f.relpath,
+                line=fn_node.lineno,
+                symbol=sym,
+                message=(
+                    "warm-dual plane restored blind: "
+                    + " and ".join(missing_bits)
+                    + " — restored duals would ride keys whose components "
+                    "were never parsed as what the writer's contract "
+                    "claims (drop the row counted, never trust it)"
                 ),
                 severity=SEV_ERROR,
             )

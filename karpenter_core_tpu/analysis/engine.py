@@ -113,12 +113,16 @@ class AnalysisConfig:
     # seam whose restore paths the cache-persist rule holds to the
     # re-anchoring contract (live generations only, tenant scope
     # preserved, schema/contract verified before trusting a payload,
-    # and — ISSUE 17 — the compile-cache plane restored only behind a
-    # jax/jaxlib/platform fingerprint comparison); prewarm.py replays
-    # the restored jitsig rows and rides the same rule set
+    # ISSUE 17 — the compile-cache plane restored only behind a
+    # jax/jaxlib/platform fingerprint comparison, and ISSUE 19 — the
+    # lprelax warm-dual plane restored only behind finite-price and
+    # iteration-budget witnesses); prewarm.py replays the restored
+    # jitsig rows and backends/lp.py owns the persisted lprelax plane —
+    # both ride the same rule set
     warmstore_modules: Tuple[str, ...] = (
         "karpenter_core_tpu/solver/warmstore.py",
         "karpenter_core_tpu/solver/prewarm.py",
+        "karpenter_core_tpu/solver/backends/lp.py",
     )
     # informer-state modules whose mutators must bump Cluster.generation()
     state_modules: Tuple[str, ...] = ("karpenter_core_tpu/state/cluster.py",)

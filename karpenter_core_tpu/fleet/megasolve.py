@@ -321,6 +321,11 @@ class _MegaDispatcher:
         self.max_occupancy = 0
         self.pad_real = 0
         self.pad_slots = 0
+        # accumulated LP-backend outcome across flushes (ISSUE 19: the
+        # branch frontier coalesces through this dispatcher — its
+        # pruning/refinement counters must stay visible at fleet scale,
+        # never vanish into the shared dispatch)
+        self.lp_totals: dict = {}
 
     def target_token(self) -> tuple:
         """The REAL backend's job token: fleet job-memo keys must equal
@@ -397,11 +402,22 @@ class _MegaDispatcher:
                         all_jobs, all_metas, mesh=mesh, stats=self.stats
                     )
                     flags = list(getattr(self._backend, "last_job_flags", ()) or ())
+                    # per-call outputs read under the same lock that
+                    # serialized the dispatch (the PR-8 discipline)
+                    bstats = dict(getattr(self._backend, "last_stats", {}) or {})
             if len(flags) != len(all_jobs):
                 flags = [False] * len(all_jobs)
             with self._cv:
                 self.flushes += 1
                 self.max_occupancy = max(self.max_occupancy, len(batch))
+                for k, v in bstats.items():
+                    # batch-level accumulation (guard wins, refinement
+                    # rounds, branch outcomes, ascent iterations): the
+                    # stats are batch-global — per-tenant attribution
+                    # does not exist at this seam, so they surface via
+                    # summary()/debug, never double-counted per tenant
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        self.lp_totals[k] = round(self.lp_totals.get(k, 0) + v, 6)
                 for j in all_jobs:
                     p = int(j[0].shape[0])
                     self.pad_real += p
@@ -426,13 +442,18 @@ class _MegaDispatcher:
             waste = (
                 round(1.0 - self.pad_real / self.pad_slots, 4) if self.pad_slots else 0.0
             )
-            return {
+            out = {
                 "flushes": self.flushes,
                 "pack_calls": self.calls,
                 "jobs": self.jobs_in,
                 "max_occupancy": self.max_occupancy,
                 "padding_waste": waste,
             }
+            if self.lp_totals:
+                # fleet-level LP outcome (ISSUE 19): guard wins and the
+                # refinement/branch counters of every coalesced dispatch
+                out["lp"] = dict(self.lp_totals)
+            return out
 
 
 class _CoalescingBackend(PackBackend):

@@ -321,10 +321,24 @@ class Metrics:
         )
         # plan-quality pack backends (solver/backends/): per pack job,
         # whether the LP-relaxation candidate beat FFD on plan cost
-        # (lp_won) or the guard kept the FFD partition (ffd_kept)
+        # (lp_won) or the guard kept the FFD partition — split by
+        # whether the optimality tier ran before the rejection (ISSUE
+        # 19): ffd_kept_cold = no refinement/branching attempted,
+        # ffd_kept_refined = FFD still won after the tier spent its
+        # budget (legacy rounds without the split report ffd_kept)
         self.solver_lp_jobs = r.counter(
             f"{ns}_tpu_solver_lp_jobs",
-            "Pack jobs through the LP-relaxation backend, by guard outcome (lp_won | ffd_kept)",
+            "Pack jobs through the LP-relaxation backend, by guard outcome "
+            "(lp_won | ffd_kept_cold | ffd_kept_refined)",
+            ["outcome"],
+        )
+        # restricted branch-and-bound (ISSUE 19): every considered
+        # branch is accounted — pruned by its dual bound without
+        # packing, explored (packed, did not beat the incumbent), or
+        # won (became the incumbent). Pruning is never silent.
+        self.solver_lp_branches = r.counter(
+            f"{ns}_tpu_solver_lp_branches",
+            "LP branch-and-bound branches, by outcome (pruned | explored | won)",
             ["outcome"],
         )
         # constraint tensorization (ISSUE 12): per-solve pod routing

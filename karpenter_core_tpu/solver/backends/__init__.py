@@ -22,6 +22,10 @@ Backends:
   pod-signature × instance-offering assignment LP solved as a batched
   dual ascent in pure JAX, rounded through an FFD-kernel repair pass,
   cost-guarded so its plan never prices above FFD's on the same job.
+  The optimality tier (ISSUE 19) layers warm-started primal-dual
+  refinement and restricted branch-and-bound on top — same guard, same
+  invariants, tighter plans — with converged duals persisted as the
+  warmstore's ``lprelax`` plane.
 - ``auto`` — size-calibrated routing (solver/calibrate.py
   ``lp_min_job_work``): jobs big enough to amortize the LP dispatch
   route to ``lp``, the rest stay on ``ffd``.
@@ -132,10 +136,11 @@ class AutoBackend(PackBackend):
     def job_token(self) -> tuple:
         # covers BOTH lanes' configuration: the routing threshold decides
         # which lane a job takes (a pure function of job shape, already
-        # keyed), and the lp iteration budget decides the lp lane's output
+        # keyed), and the lp lane's full token (iterations, refinement
+        # rounds, branch width, Pareto weights) decides that lane's output
         from ..calibrate import lp_min_job_work
 
-        return ("auto", int(lp_min_job_work()), int(self._lp.iterations))
+        return ("auto", int(lp_min_job_work())) + self._lp.job_token()
 
     def pack_jobs(
         self, jobs: List[tuple], metas: List[dict], mesh=None, stats=None
@@ -213,5 +218,12 @@ def active_backend() -> PackBackend:
 
 
 def reset_for_tests() -> None:
-    """Drop backend singletons (and with them the LP relax memo)."""
+    """Drop backend singletons AND the shared warm-dual plane (ISSUE
+    19: the lprelax memo is process-shared across LPBackend instances,
+    so clearing the singletons alone would leak it into the next test's
+    "cold" process — warmstore.simulate_process_death relies on this
+    dropping everything a fresh process would not have)."""
     _BACKENDS.clear()
+    from . import lp as _lp
+
+    _lp.reset_for_tests()

@@ -125,6 +125,9 @@ def lp_calibration(force: bool = False) -> dict:
 
     - ``lp_relax_floor_ms``  — round-trip of a tiny dual-ascent dispatch
       (backends/lp.py), the LP's fixed per-job overhead
+    - ``lp_refine_floor_ms`` — round-trip of a warm-started re-ascent at
+      the refinement budget (ISSUE 19): what each extra
+      KARPENTER_TPU_LP_REFINE_ROUNDS round costs in dispatch floor
     - ``pack_ns_per_unit``   — the FFD engine's cost per pod×frontier
       work unit on a bench-shaped micro-run
 
@@ -165,6 +168,18 @@ def lp_calibration(force: bool = False) -> dict:
         roundtrip()  # compile
         floor = min(_timed(roundtrip) for _ in range(5))
         out["lp_relax_floor_ms"] = round(floor * 1000.0, 3)
+
+        # warm re-ascent floor (ISSUE 19): same shapes, a converged w0,
+        # an 8-iteration budget — the marginal cost of one refinement
+        # round's dispatch (its own compile: scan length is static)
+        _, _, _, w_conv = lp_mod.relax(reqs, counts, alloc, prices, iters=32)
+
+        def refine_roundtrip():
+            lp_mod.relax(reqs, counts, alloc, prices, iters=8, w0=w_conv)
+
+        refine_roundtrip()  # compile
+        rfloor = min(_timed(refine_roundtrip) for _ in range(5))
+        out["lp_refine_floor_ms"] = round(rfloor * 1000.0, 3)
         threshold = int(floor / max(pack_s / units, 1e-12))
         out["lp_min_job_work"] = max(
             _LP_MIN_CLAMP[0], min(_LP_MIN_CLAMP[1], threshold)

@@ -394,21 +394,25 @@ class WarmState:
 def _clone_plan(p):
     """Fresh NodePlan with copied containers (instance_type /
     requirements are shared immutably; post-solve consumers set fields
-    like ``pods`` on their own clone, never on the stored one)."""
-    from .solver import NodePlan
+    like ``pods`` on their own clone, never on the stored one).
 
-    return NodePlan(
-        nodepool_name=p.nodepool_name,
-        instance_type=p.instance_type,
-        zone=p.zone,
-        capacity_type=p.capacity_type,
-        price=p.price,
-        pod_indices=list(p.pod_indices),
-        requirements=p.requirements,
-        max_pods_per_node=p.max_pods_per_node,
-        node_limits=list(p.node_limits),
-        _pod_requests=list(p._pod_requests) if p._pod_requests is not None else None,
-    )
+    Built via ``__new__`` + dict copy rather than the dataclass
+    constructor: replay clones every stored plan per served tick, and
+    large LP fleets (config-10 runs 60–90 plans/solve) made the
+    keyword-arg ``__init__`` the dominant warm-path cost. Presentation
+    (``pods``) and lazily-merged (``_requests``) fields reset to their
+    constructor defaults — the stored plan may carry consumer-set
+    values the clone must not inherit."""
+    q = object.__new__(type(p))
+    d = q.__dict__
+    d.update(p.__dict__)
+    d["pods"] = None
+    d["_requests"] = None
+    d["pod_indices"] = list(p.pod_indices)
+    d["node_limits"] = list(p.node_limits)
+    reqs = p._pod_requests
+    d["_pod_requests"] = list(reqs) if reqs is not None else None
+    return q
 
 
 # -- per-provider state registry --------------------------------------------

@@ -23,13 +23,68 @@ can possibly be from optimal:
   The gap conflates true suboptimality with bound looseness (integer
   slack the relaxation cannot see), so it is an upper bound on the
   recoverable dollars.
+
+The optimality tier (ISSUE 19) generalizes the objective beyond $/hr:
+``cost_weights()`` parses ``KARPENTER_TPU_COST_WEIGHTS`` into weighted
+terms — offering price, disruption cost (the PR-7 ``pod_eviction_cost``
+memo), topology-spread slack, consolidation headroom — and
+``pareto_report(plans)`` evaluates every term per solve regardless of
+weights, so the trade-off surface is visible even when only price is
+optimized. Price stays the DOMINANT objective everywhere plans are
+chosen: the LP guard admits a candidate on strict price improvement
+only, and the non-price weights act as tie-breaks (headroom) and
+reporting weights, never as license to emit a costlier plan. The
+weights ride the LP backend's ``job_token`` so two weight settings can
+never alias one memoized skeleton stream.
 """
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+#: fixed weight order — weights_token() must be stable across processes
+_WEIGHT_NAMES = ("price", "disruption", "spread", "headroom")
+
+
+def cost_weights() -> dict:
+    """The multi-objective weight vector, parsed fresh per read (the
+    PR-2 env-switch pattern): ``KARPENTER_TPU_COST_WEIGHTS`` as
+    ``"price=1,disruption=0.5,spread=0.1,headroom=0.2"``. Defaults to
+    price-only (1, 0, 0, 0) — the pre-ISSUE-19 objective exactly.
+    Unknown names and malformed entries are ignored, negatives clamp to
+    0: a typo must degrade to the default, never fail a solve."""
+    weights = {name: 0.0 for name in _WEIGHT_NAMES}
+    weights["price"] = 1.0
+    raw = os.environ.get("KARPENTER_TPU_COST_WEIGHTS", "")
+    for part in raw.split(","):
+        if "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        name = name.strip().lower()
+        if name not in weights:
+            continue
+        try:
+            weights[name] = max(0.0, float(val))
+        except ValueError:
+            continue
+    return weights
+
+
+def weights_token() -> tuple:
+    """The weights as a deterministic tuple in ``_WEIGHT_NAMES`` order —
+    the component the LP backend folds into ``job_token`` so a weight
+    change is a different memo stream, never an aliased one."""
+    w = cost_weights()
+    return tuple(round(w[name], 9) for name in _WEIGHT_NAMES)
+
+
+def weights_active() -> bool:
+    """True when any non-price objective carries weight."""
+    w = cost_weights()
+    return any(w[name] > 0.0 for name in _WEIGHT_NAMES if name != "price")
 
 
 def fleet_cost(plans: Sequence) -> float:
@@ -99,4 +154,92 @@ def cost_block(result, instance_types: Sequence, iters: int = 256) -> dict:
         "plan_cost_per_hr": round(cost, 4),
         "lp_bound_per_hr": round(bound, 4),
         "opt_gap_pct": round(gap * 100.0, 2) if gap is not None else None,
+    }
+
+
+def pareto_report(plans: Sequence) -> Optional[dict]:
+    """Per-solve multi-objective report (ISSUE 19): every objective
+    evaluated on the emitted plans, plus the active weights and the
+    weighted scalarization. Reporting only — plan choice happens in the
+    backends under the price-dominant guard; this surfaces what that
+    choice cost along the other axes (stats.py ``pareto`` block, flight
+    recorder, bench ``_split``).
+
+    Objectives (all "smaller is better" except headroom):
+
+    - ``price`` — fleet_cost, $/hr.
+    - ``disruption`` — Σ pod_eviction_cost over the plans' pods (the
+      PR-7 memo): what consolidating these placements away would cost
+      later. Falls back to pod count where pod objects aren't resolved.
+    - ``spread_slack`` — max−min of the per-zone new-node counts: how
+      unbalanced the plan leaves the zone topology (0 = perfectly
+      spread or single-zone).
+    - ``headroom`` — mean free-capacity fraction across opened nodes
+      (dominant resource axis): consolidation room the plan keeps.
+
+    ``weighted_total`` folds them with cost_weights(), headroom entering
+    as its complement (1 − headroom) so every term is a cost."""
+    plans = list(plans)
+    if not plans:
+        return None
+    from ..disruption.types import pod_eviction_cost
+
+    weights = cost_weights()
+    price = fleet_cost(plans)
+    disruption = 0.0
+    zone_counts: dict = {}
+    headroom_fracs: List[float] = []
+    # plans repeat a handful of types — resolve each type's allocatable
+    # dict once per report, not once per opened node (this runs on the
+    # warm solve path, where per-plan Python work is the latency)
+    alloc_memo: dict = {}
+    for plan in plans:
+        pods = getattr(plan, "pods", None)
+        if pods:
+            disruption += float(sum(pod_eviction_cost(p) for p in pods))
+        else:
+            disruption += float(len(getattr(plan, "pod_indices", ()) or ()))
+        zone = getattr(plan, "zone", None) or ""
+        zone_counts[zone] = zone_counts.get(zone, 0) + 1
+        it = getattr(plan, "instance_type", None)
+        reqs = getattr(plan, "requests", None)
+        if it is None or not reqs:
+            continue
+        try:
+            alloc = alloc_memo.get(id(it))
+            if alloc is None:
+                alloc = [
+                    (res, float(cap))
+                    for res, cap in it.allocatable().items()
+                    if float(cap) > 0
+                ]
+                alloc_memo[id(it)] = alloc
+            used = max(
+                (float(reqs.get(res, 0.0)) / cap for res, cap in alloc),
+                default=0.0,
+            )
+        except (TypeError, ValueError):
+            continue
+        headroom_fracs.append(min(max(1.0 - used, 0.0), 1.0))
+    spread_slack = (
+        float(max(zone_counts.values()) - min(zone_counts.values()))
+        if len(zone_counts) > 1
+        else 0.0
+    )
+    headroom = (
+        sum(headroom_fracs) / len(headroom_fracs) if headroom_fracs else None
+    )
+    weighted = (
+        weights["price"] * price
+        + weights["disruption"] * disruption
+        + weights["spread"] * spread_slack
+        + weights["headroom"] * (1.0 - (headroom if headroom is not None else 1.0))
+    )
+    return {
+        "price_per_hr": round(price, 4),
+        "disruption_cost": round(disruption, 4),
+        "spread_slack": round(spread_slack, 4),
+        "headroom": round(headroom, 4) if headroom is not None else None,
+        "weights": {name: weights[name] for name in _WEIGHT_NAMES},
+        "weighted_total": round(weighted, 4),
     }

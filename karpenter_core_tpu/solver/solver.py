@@ -657,6 +657,9 @@ class TPUScheduler:
         # + the karpenter_tpu_shard_padding_waste gauge); None when the
         # solve never touched a mesh
         self.last_shard_stats: Optional[dict] = None
+        # multi-objective report of the most recent solve (plancost
+        # pareto_report, ISSUE 19); None when no new plans were emitted
+        self.last_pareto: Optional[dict] = None
         # prep-time topology ledger state (rebuilt per tensor pass;
         # empty defaults keep direct sub-method calls in tests working)
         self._batch_pods: List[Pod] = []
@@ -772,6 +775,10 @@ class TPUScheduler:
                     "ffd",
                 ):
                     tr.args["pack_backend"] = self.last_pack_stats
+                if tr is not None and getattr(self, "last_pareto", None):
+                    # the per-solve multi-objective report rides the
+                    # solve trace → flight recorder / /debug/traces
+                    tr.args["pareto"] = self.last_pareto
                 self.last_cache_stats = self._cstats.to_dict()
                 if tr is not None and (self._cstats.hits or self._cstats.misses):
                     # hit rates ride on the solve trace → /debug/traces
@@ -862,6 +869,9 @@ class TPUScheduler:
         # pack-backend outcome for this solve (solver/backends/): which
         # engine partitioned the jobs, LP guard wins, bound sums
         self._pack_backend_stats = {}
+        # per-solve Pareto report (plancost, ISSUE 19); replayed ticks
+        # emit no new plans, so they keep None
+        self.last_pareto = None
         # fresh per-solve shard-padding accumulator (solver/sharding.py)
         from .sharding import reset_shard_stats
 
@@ -969,6 +979,13 @@ class TPUScheduler:
             ws.record(
                 self, pods, state_nodes, daemonset_pods, result, self._replay_ctx
             )
+        if result.node_plans:
+            # the multi-objective report (ISSUE 19): reporting only —
+            # computed AFTER the plans are final, so it can never feed
+            # back into this solve's choices
+            from . import plancost
+
+            self.last_pareto = plancost.pareto_report(result.node_plans)
         return result
 
     @property
@@ -4658,7 +4675,20 @@ class TPUScheduler:
         acc["backend"] = backend.name
         if not stats:
             return
-        for k in ("jobs", "lp_won", "ffd_kept"):
+        for k in (
+            "jobs",
+            "lp_won",
+            "ffd_kept",
+            "ffd_kept_cold",
+            "ffd_kept_refined",
+            "refine_rounds",
+            "refine_accepted",
+            "branches_considered",
+            "branches_pruned",
+            "branches_explored",
+            "branches_won",
+            "ascent_iters",
+        ):
             if k in stats:
                 acc[k] = acc.get(k, 0) + int(stats[k])
         for k in ("lp_bound_sum", "lp_saved_per_hr"):
@@ -4667,8 +4697,23 @@ class TPUScheduler:
         if self.metrics is not None and hasattr(self.metrics, "solver_lp_jobs"):
             if stats.get("lp_won"):
                 self.metrics.solver_lp_jobs.inc(stats["lp_won"], outcome="lp_won")
-            if stats.get("ffd_kept"):
+            # the ISSUE-19 outcome split: a job FFD kept because the
+            # optimality tier never ran (cold) is a different signal
+            # from one it kept AFTER refinement/branching spent their
+            # budgets (refined). Legacy backends report only the total.
+            cold = int(stats.get("ffd_kept_cold", 0))
+            refined = int(stats.get("ffd_kept_refined", 0))
+            if cold:
+                self.metrics.solver_lp_jobs.inc(cold, outcome="ffd_kept_cold")
+            if refined:
+                self.metrics.solver_lp_jobs.inc(refined, outcome="ffd_kept_refined")
+            if stats.get("ffd_kept") and not (cold or refined):
                 self.metrics.solver_lp_jobs.inc(stats["ffd_kept"], outcome="ffd_kept")
+        if self.metrics is not None and hasattr(self.metrics, "solver_lp_branches"):
+            for outcome in ("pruned", "explored", "won"):
+                v = int(stats.get(f"branches_{outcome}", 0))
+                if v:
+                    self.metrics.solver_lp_branches.inc(v, outcome=outcome)
 
     def _job_key(self, job: tuple, meta: dict, mesh, backend=None) -> Optional[tuple]:
         """Content address of one pack job: every input the pack AND the

@@ -20,7 +20,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-SCHEMA = 6  # 6: device block carries "compile_cache" (managed XLA
+SCHEMA = 7  # 7: "pareto" block (plancost.pareto_report — per-solve
+# multi-objective report: price, disruption cost, spread slack,
+# consolidation headroom, active weights; None when no plans were
+# emitted), ISSUE 19; 6: device block carries "compile_cache" (managed XLA
 # executable cache status: enabled|disabled|unavailable:<why>, dir,
 # entry count — a cacheless process is visible, never silent) and
 # "prewarm" (the boot jitsig-replay outcome), ISSUE 17; 5: "device"
@@ -69,6 +72,7 @@ def solve_stats(solver, disruption=None) -> dict:
             "pairs_applied": int(ms.get("merge_pairs_applied", 0) or 0),
         },
         "pack_backend": dict(ps),
+        "pareto": dict(pp) if (pp := getattr(solver, "last_pareto", None)) else None,
         "shard": dict(ss) if (ss := getattr(solver, "last_shard_stats", None)) else None,
         "route": dict(rs) if (rs := getattr(solver, "last_route_stats", None)) else None,
         "disruption": dict(dstats) if dstats else None,
@@ -126,6 +130,9 @@ def bench_fields(stats: dict) -> dict:
     ps = stats.get("pack_backend", {})
     if ps and ps.get("backend") not in (None, "ffd"):
         out["pack_backend"] = dict(ps)
+    pp = stats.get("pareto")
+    if pp:
+        out["pareto"] = dict(pp)
     sh = stats.get("shard")
     if sh:
         out["shard"] = dict(sh)

@@ -102,6 +102,7 @@ _KEY_CONTRACT = (
     ("fleetjob", "tenant-free job-key content prefix -> JobSkeleton"),
     ("jitsig", "(fn name, static-argname tuple) -> abstract signature keys (deviceplane inventory; static reprs bounded at 512 for literal-eval replay)"),
     ("compilecache", "jax/jaxlib/platform + per-entry digest manifest of the managed XLA executable cache (backend.compile_cache_fingerprint)"),
+    ("lprelax", "(reqs digest, capacity bytes, price-table float64 bytes, iteration budget int, refine-stage tag...) -> (t_star, has_fit, float64 bound, dual weights); restore witnesses a finite price table and a sane budget, then REBUILDS the live key"),
 )
 CONTRACT = stable_hash(_KEY_CONTRACT).hex()
 
@@ -111,9 +112,9 @@ _MAGIC = b"KTPU-WARMSTORE\n"
 # KARPENTER_TPU_WARMSTORE_MAX_MB the cheapest-to-recompute planes drop
 # first (screen rows re-derive from the merge pass; catalogs last — they
 # are the single biggest cold-solve cost)
-_TRIM_ORDER = ("jitsigs", "screen_rows", "emits", "merges", "intersects", "jobs", "routes", "seeds", "catalogs")
+_TRIM_ORDER = ("jitsigs", "lprelax", "screen_rows", "emits", "merges", "intersects", "jobs", "routes", "seeds", "catalogs")
 
-_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob", "jitsig", "compilecache")
+_PLANES = ("catalog", "compat", "route", "job", "merge", "emit", "mergerow", "seeds", "intersects", "fleetjob", "jitsig", "compilecache", "lprelax")
 
 # most recent snapshot/restore outcome (observability; guarded — the
 # serving pipeline snapshots from its plan thread while debug routes
@@ -260,6 +261,15 @@ def _collect_catalog_entries(solver) -> List[tuple]:
     return out
 
 
+def _export_lprelax() -> list:
+    """Persistable rows of the warm-dual plane (empty until an
+    LPBackend has run). Lazy import: importing warmstore must not drag
+    in the lp module's jax surface at module-load time."""
+    from .backends import lp as lp_backend
+
+    return lp_backend.export_relax_plane()
+
+
 def build_payload(solver) -> dict:
     """Assemble the (pre-pickle) snapshot payload from the solver's warm
     state and its catalog entries. Pure read — never mutates the planes."""
@@ -312,6 +322,11 @@ def build_payload(solver) -> dict:
         # stays on disk — the snapshot witnesses its content fingerprint
         # (None when the managed cache is not enabled)
         "compilecache": backend.compile_cache_fingerprint(),
+        # warm-dual plane (ISSUE 19): the LP backend's converged dual
+        # weights, content-keyed (keys are digests/bytes/ints only —
+        # nothing process-private crosses the boundary); a restored
+        # tick's relax hits the memo and re-ascends nothing
+        "lprelax": _export_lprelax(),
     }
     if ws is None:
         return payload
@@ -394,6 +409,7 @@ def _plane_counts(payload: dict) -> dict:
             if isinstance(payload.get("compilecache"), dict)
             else 0
         ),
+        "lprelax": len(payload.get("lprelax", ())),
     }
 
 
@@ -664,6 +680,75 @@ def _restore_compile_cache(payload: dict, out: "_Outcome") -> bool:
     return True
 
 
+def _restore_lprelax(payload: dict, out: "_Outcome") -> None:
+    """Re-anchor the warm-dual plane (ISSUE 19). The keys are pure
+    content — reqs digest, capacity bytes, price-table fingerprint,
+    iteration budget, refine-stage tag — but NOTHING is trusted blind:
+    each row must parse exactly as the writer's contract line says, and
+    the live key is REBUILT by threading the parsed components, so a
+    malformed or contract-skewed row drops counted instead of landing
+    as an unreachable (or aliasing) memo key. Values seed warm dual
+    ascents; a wrong value could mis-route a primal but can never break
+    soundness (the bound is host-recertified and the cost guard reprices
+    every candidate) — the witnesses below still reject anything that
+    fails to parse as what the writer claims to have stored."""
+    rows = payload.get("lprelax", ())
+    if not rows:
+        return
+    from .backends import lp as lp_backend
+    from .backends import get_backend
+
+    get_backend("lp")  # materialize the shared plane before adopting it
+    cache = lp_backend.shared_relax_cache()
+    if cache is None:
+        out.drop("lprelax", len(rows))
+        return
+    for row in rows:
+        try:
+            key, value = row
+            digest, alloc_b, prices_b, iters = key[0], key[1], key[2], key[3]
+            stage = tuple(key[4:])
+            if not (
+                isinstance(digest, bytes)
+                and isinstance(alloc_b, bytes)
+                and isinstance(prices_b, bytes)
+            ):
+                out.drop("lprelax")
+                continue
+            # iteration-budget witness: the budget is a first-class key
+            # component (job_token and the memo key both thread it) — a
+            # row whose budget is not a sane int must not land, or a
+            # future budget change could alias a foreign solve's duals
+            if not isinstance(iters, int) or iters < 8:
+                out.drop("lprelax")
+                continue
+            # price-table witness: the stored fingerprint must parse as
+            # the finite float64 table the dual solve actually read —
+            # a non-finite price in the key would mean the stored bound
+            # certifies a price model the live guard never prices with
+            prices = np.frombuffer(prices_b, dtype=np.float64)
+            if prices.size == 0 or not np.isfinite(prices).all():
+                out.drop("lprelax")
+                continue
+            t_star, has_fit, bound, w = value
+            if not (np.isfinite(float(bound)) and float(bound) >= 0.0):
+                out.drop("lprelax")
+                continue
+            live_key = (digest, alloc_b, prices_b, int(iters)) + stage
+            cache.put(
+                live_key,
+                (
+                    np.asarray(t_star, dtype=np.int32),
+                    np.asarray(has_fit, dtype=bool),
+                    float(bound),
+                    np.asarray(w),
+                ),
+            )
+            out.ok("lprelax")
+        except (TypeError, ValueError, IndexError):
+            out.drop("lprelax")
+
+
 def restore(solver, path: str, metrics=None, fleet_plane=None) -> dict:
     """Restore a snapshot into ``solver``'s warm world. Every plane
     re-anchors against the live world (catalog fingerprints, cluster
@@ -787,6 +872,11 @@ def _restore_under_root(solver, path: str, metrics, fleet_plane, out: "_Outcome"
         # the jax/platform fingerprint comparison is a named, analyzable
         # seam (the cache-persist rule holds this line)
         _restore_compile_cache(payload, out)
+
+        # warm-dual plane (ISSUE 19): same discipline — its own named
+        # unit so the price-table and iteration-budget witnesses are
+        # analyzable seams (cache-persist rule, check 5)
+        _restore_lprelax(payload, out)
     except Exception:  # noqa: BLE001 — a corrupt plane degrades to cold, never crashes the caller
         log.exception("warmstore restore failed; remaining planes dropped")
         out.reason = "restore error (see logs)"
@@ -864,6 +954,12 @@ def simulate_process_death() -> None:
     podcache.reset_process()
     deviceplane.reset()
     prewarm.reset_for_tests()
+    # backend singletons AND the process-shared warm-dual plane (ISSUE
+    # 19): a fresh interpreter has neither — leaving them would let
+    # "restored" ticks read duals that never crossed the snapshot
+    from . import backends
+
+    backends.reset_for_tests()
     with _LAST_LOCK:
         _LAST["snapshot"] = None
         _LAST["restore"] = None

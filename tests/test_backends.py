@@ -269,7 +269,7 @@ class TestLPQuality:
         counts = np.array([3.0, 1.0])
         alloc = np.array([[100.0]])
         prices = np.array([1.0])
-        t_star, has_fit, bound = lp_mod.relax(reqs, counts, alloc, prices, 32)
+        t_star, has_fit, bound, _w = lp_mod.relax(reqs, counts, alloc, prices, 32)
         assert bool(has_fit[0]) and not bool(has_fit[1])
         assert bound <= 3 * (10.0 / 100.0) + 1e-9
 

@@ -157,6 +157,65 @@ class TestCheck:
         assert ledger.main(["--dir", str(tmp_path), "--check"]) == 2
 
 
+class TestHostClassLanes:
+    """r10: wall-clock relative gates only compare same-host-class
+    rounds (bench ``host.cpus`` fingerprint); quality lanes
+    (HOST_NEUTRAL_GATES) compare across every host."""
+
+    def _run(self, tmp_path, mutate_r08, host_r08=None):
+        d = tmp_path / "bench"
+        d.mkdir()
+        for n in (6, 7):
+            shutil.copy(os.path.join(REPO, f"BENCH_r0{n}.json"), d / f"BENCH_r0{n}.json")
+        with open(os.path.join(REPO, "BENCH_r07.json")) as f:
+            doc = json.load(f)
+        mutate_r08(doc["parsed"])
+        if host_r08 is not None:
+            doc["parsed"]["host"] = {"cpus": host_r08}
+        (d / "BENCH_r08.json").write_text(json.dumps(doc))
+        rc = ledger.main(
+            ["--dir", str(d), "--out", str(tmp_path / "L.json"),
+             "--md", str(tmp_path / "L.md"), "--check"]
+        )
+        failed = {
+            (f["config"], f["metric"])
+            for f in json.loads((tmp_path / "L.json").read_text())["failures"]
+        }
+        return rc, failed
+
+    def test_wall_clock_regression_on_new_host_class_is_not_flagged(self, tmp_path):
+        def slow_down(parsed):
+            parsed["warm_ms"] = round(parsed["warm_ms"] * 2.0, 1)
+
+        # r08 carries a host fingerprint, r06/r07 predate it → no
+        # comparable prior for the wall-clock lane, gate skips
+        rc, failed = self._run(tmp_path, slow_down, host_r08=1)
+        assert ("headline", "warm_ms") not in failed
+        assert rc == 0
+
+    def test_same_host_class_unknown_still_flags(self, tmp_path):
+        def slow_down(parsed):
+            parsed["warm_ms"] = round(parsed["warm_ms"] * 2.0, 1)
+
+        # no fingerprint anywhere: every round is class "unknown" and
+        # the gate behaves exactly as before the host lanes existed
+        rc, failed = self._run(tmp_path, slow_down, host_r08=None)
+        assert ("headline", "warm_ms") in failed
+        assert rc == 1
+
+    def test_quality_lane_compares_across_host_classes(self, tmp_path):
+        def lose_saving(parsed):
+            for cfg in parsed["configs"]:
+                if str(cfg.get("config", "")).startswith("10:"):
+                    cfg["adversarial_saving_pct"] = round(
+                        cfg["adversarial_saving_pct"] * 0.5, 2
+                    )
+
+        rc, failed = self._run(tmp_path, lose_saving, host_r08=1)
+        assert ("config10", "adversarial_saving_pct") in failed
+        assert rc == 1
+
+
 class TestCommittedLedger:
     def test_committed_ledger_is_current(self):
         """BENCH_LEDGER.json in the repo matches a fresh build over the

@@ -398,6 +398,36 @@ def test_cache_persist_unverified_contract(tmp_path):
     assert run_snippet(tmp_path, good, rules=["cache-persist"]).findings == []
 
 
+def test_cache_persist_lprelax_restored_blind(tmp_path):
+    # ISSUE 19: the warm-dual plane must witness BOTH key components —
+    # finite price table and sane iteration budget — before a row lands
+    bad = """
+        import numpy as np
+
+        def _restore_lprelax(payload, out):
+            for key, value in payload.get("lprelax", ()):
+                digest, alloc_b, prices_b, iters = key[0], key[1], key[2], key[3]
+                out.put((digest, alloc_b, prices_b, iters), value)
+    """
+    report = run_snippet(tmp_path, bad, rules=["cache-persist"])
+    hits = [f for f in report.findings if "warm-dual plane restored blind" in f.message]
+    assert hits and "price-table" in hits[0].message and "iteration budget" in hits[0].message
+    good = """
+        import numpy as np
+
+        def _restore_lprelax(payload, out):
+            for key, value in payload.get("lprelax", ()):
+                digest, alloc_b, prices_b, iters = key[0], key[1], key[2], key[3]
+                if not isinstance(iters, int) or iters < 8:
+                    continue
+                prices = np.frombuffer(prices_b, dtype=np.float64)
+                if prices.size == 0 or not np.isfinite(prices).all():
+                    continue
+                out.put((digest, alloc_b, prices_b, int(iters)), value)
+    """
+    assert run_snippet(tmp_path, good, rules=["cache-persist"]).findings == []
+
+
 def test_scoped_marker_not_blanket_suppression():
     lines = ["x = f()  # analysis: allow-cache-key(b, meta.alloc) — why"]
     assert "cache-key" not in allowed_rules_for_line(lines, 1)
@@ -636,6 +666,19 @@ _MUTANTS = [
     ("restore-drop-jaxversion-witness", "karpenter_core_tpu/solver/warmstore.py",
      'if (\n        stored.get("jax") != live.get("jax")\n        or stored.get("jaxlib") != live.get("jaxlib")\n        or stored.get("platform") != live.get("platform")\n    ):',
      "if False:", "cache-persist"),
+    # ISSUE 19: the warm-dual (lprelax) plane restores another process's
+    # converged duals — the price-table fingerprint must parse as a
+    # finite float table (a non-finite price in a key would certify a
+    # bound against a price model the live guard never prices with) and
+    # the iteration budget must survive its sanity comparison (budget is
+    # a first-class key/job-token component; a bogus one could alias a
+    # foreign solve's duals after a budget change).
+    ("persist-drop-pricefp-witness", "karpenter_core_tpu/solver/warmstore.py",
+     "            if prices.size == 0 or not np.isfinite(prices).all():",
+     "            if prices.size == 0:", "cache-persist"),
+    ("restore-drop-iteration-budget", "karpenter_core_tpu/solver/warmstore.py",
+     "            if not isinstance(iters, int) or iters < 8:",
+     "            if not isinstance(iters, int):", "cache-persist"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -660,6 +703,9 @@ _MANDATORY = {
     # ISSUE 17 acceptance: the compile-cache plane restores only behind
     # the live jax/jaxlib/platform fingerprint comparison
     "restore-drop-jaxversion-witness",
+    # ISSUE 19 acceptance: the warm-dual plane restores only behind the
+    # finite-price-table and iteration-budget witnesses
+    "persist-drop-pricefp-witness", "restore-drop-iteration-budget",
 }
 
 

@@ -247,7 +247,7 @@ class TestDebugRoutes:
             assert set(doc) == {
                 "schema", "trace_id", "timings", "cache", "merge",
                 "pack_backend", "shard", "route", "disruption", "warmstore",
-                "device",
+                "device", "pareto",
             }
             # ISSUE 12: the route block carries the per-solve pod split
             assert doc["route"]["tensor"] == 8
