@@ -97,6 +97,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument(
+        "--knobs",
+        action="store_true",
+        help="print the KARPENTER_TPU_* knob registry (the README "
+        "Configuration table; --format json for per-site detail) and exit",
+    )
+    parser.add_argument(
         "--contracts",
         action="store_true",
         help="also verify @contract shape declarations via jax.eval_shape",
@@ -106,6 +112,18 @@ def main(argv=None) -> int:
     if args.list_rules:
         for name, desc in registered_rules().items():
             print(f"{name}: {desc}")
+        return 0
+
+    if args.knobs:
+        from .configprov import knob_rows, knob_table_lines, repo_registry
+
+        registry = repo_registry()
+        if args.format == "json":
+            json.dump(knob_rows(registry), sys.stdout, indent=2)
+            print()
+        else:
+            for line in knob_table_lines(registry):
+                print(line)
         return 0
 
     root = repo_root()

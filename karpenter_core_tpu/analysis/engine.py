@@ -196,6 +196,35 @@ class AnalysisConfig:
         "karpenter_core_tpu/tracing/deviceplane.py",
         "karpenter_core_tpu/native/__init__.py",
     )
+    # modules a warmstore restore re-animates (ISSUE 20): an import-time
+    # KARPENTER_TPU_* read here is frozen before restore() can run, so a
+    # restored process can never re-decide it — the knob-inventory rule
+    # forces such reads behind functions (or a scoped marker stating why
+    # the freeze is deliberate, e.g. a static kernel shape)
+    restorable_modules: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/warmstore.py",
+        "karpenter_core_tpu/solver/prewarm.py",
+        "karpenter_core_tpu/solver/backends/lp.py",
+        "karpenter_core_tpu/solver/backends/__init__.py",
+        "karpenter_core_tpu/solver/solver.py",
+        "karpenter_core_tpu/solver/incremental.py",
+        "karpenter_core_tpu/solver/pack.py",
+        "karpenter_core_tpu/solver/sharding.py",
+        "karpenter_core_tpu/solver/backend.py",
+        "karpenter_core_tpu/fleet/registry.py",
+        "karpenter_core_tpu/fleet/megasolve.py",
+    )
+    # modules whose outputs must be iteration-order deterministic
+    # (ISSUE 20): plan emission, fingerprints/stable hashes, and
+    # warmstore payloads all cross a process boundary, so unordered
+    # producers (unsorted listdir/glob, bare popitem, set iteration)
+    # are findings here — scoped `# analysis: allow-determinism(why)`
+    determinism_prefixes: Tuple[str, ...] = (
+        "karpenter_core_tpu/solver/",
+        "karpenter_core_tpu/fleet/",
+        "karpenter_core_tpu/native/",
+        "karpenter_core_tpu/tracing/capture.py",
+    )
 
 
 DEFAULT_CONFIG = AnalysisConfig()
@@ -359,6 +388,8 @@ def _load_rules() -> None:
             cachesound,
             clock,
             concurrency,
+            configprov,
+            determinism,
             hygiene,
             hostsync,
             jitregistry,

@@ -53,7 +53,7 @@ def _build(lib_path: str) -> bool:
     # they'd otherwise accumulate invisibly across source edits)
     import glob
 
-    for stale in glob.glob(os.path.join(os.path.dirname(__file__), "_libpack-*.so")):
+    for stale in sorted(glob.glob(os.path.join(os.path.dirname(__file__), "_libpack-*.so"))):
         if stale != lib_path:
             try:
                 os.unlink(stale)

@@ -271,7 +271,10 @@ class Provisioner:
     # every Nth tensor solve shadows a pod subsample through the oracle
     # and records node-count parity — the live analogue of the bench's
     # parity gate; 0 disables
-    PARITY_SAMPLE_EVERY = int(os.environ.get("KARPENTER_TPU_PARITY_SAMPLE", "16"))
+    try:
+        PARITY_SAMPLE_EVERY = max(0, int(os.environ.get("KARPENTER_TPU_PARITY_SAMPLE", "16")))
+    except ValueError:
+        PARITY_SAMPLE_EVERY = 16
     PARITY_SUBSAMPLE = 500
 
     def _maybe_observe_parity(self, pods: List[Pod], nodepools) -> None:

@@ -275,7 +275,10 @@ def default_backend() -> str:
     # probe out-of-process first so the hang mode costs a timeout, not
     # a stuck provisioning loop
     global LAST_PROBE_ERROR
-    timeout = float(os.environ.get("KARPENTER_TPU_PROBE_TIMEOUT", "60"))
+    try:
+        timeout = float(os.environ.get("KARPENTER_TPU_PROBE_TIMEOUT", "60"))
+    except ValueError:
+        timeout = 60.0
     probe = probe_backend(timeout)
     if not probe.ok:
         LAST_PROBE_ERROR = probe.describe()

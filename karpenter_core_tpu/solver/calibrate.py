@@ -195,7 +195,10 @@ def lp_min_job_work(fallback: Optional[int] = None) -> int:
     override > on-process calibration > the static default."""
     env = os.environ.get("KARPENTER_TPU_LP_MIN_WORK")
     if env:
-        return int(env)
+        try:
+            return int(env)
+        except ValueError:
+            pass  # a typo'd override falls through to calibration
     cal = lp_calibration()
     return cal.get(
         "lp_min_job_work", fallback if fallback is not None else _LP_MIN_DEFAULT
@@ -209,7 +212,10 @@ def compat_min_device_work(fallback: Optional[int] = None) -> int:
     to preserve a monkeypatchable module attribute."""
     env = os.environ.get("KARPENTER_TPU_COMPAT_MIN_WORK")
     if env:
-        return int(env)
+        try:
+            return int(env)
+        except ValueError:
+            pass  # a typo'd override falls through to calibration
     cal = calibration()
     return cal.get(
         "compat_min_device_work", fallback if fallback is not None else _STATIC_DEFAULT

@@ -540,8 +540,10 @@ def pack_engine_token(mesh) -> tuple:
         # job at/past the shard threshold is chunk-packed, and (engine,
         # threshold, mesh size) decide that partition — so the chunk
         # config is key material. Its env reads happen inside the pack
-        # dispatch, invisible to the cachesound read-set slice (the
-        # PR-7 sim_drained precedent); the no-alias invariant is held
-        # by tests/test_sharding.py::TestShardEngineMemoKeys instead.
+        # dispatch, invisible to the cachesound read-set slice, but the
+        # config-provenance rule (ISSUE 20) machine-checks that this
+        # token carries pod_shard_token();
+        # tests/test_sharding.py::TestShardEngineMemoKeys holds the
+        # behavioral side.
         pod_shard_token(mesh),
     )

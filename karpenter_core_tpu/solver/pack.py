@@ -37,7 +37,13 @@ INT_INF = np.int32(2**31 - 1)
 # cost — the fit scan early-exits). The DEVICE scan keeps K=16: its
 # compiled (K,F,R) per-step state is what a TPU scan can afford, and it
 # is the fallback engine only.
-NATIVE_K_OPEN = int(os.environ.get("KARPENTER_TPU_K_OPEN", "1024"))
+# import-time by design: K is a compiled kernel shape (the scan's (K,F,R)
+# state), and it rides pack_engine_token so every job-memo key — including
+# restored ones — witnesses the boot-time value.
+try:  # analysis: allow-knob-inventory(KARPENTER_TPU_K_OPEN — static kernel shape; rides pack_engine_token so memo keys witness it)
+    NATIVE_K_OPEN = max(1, int(os.environ.get("KARPENTER_TPU_K_OPEN", "1024")))
+except ValueError:
+    NATIVE_K_OPEN = 1024
 
 
 @contract("T R", out="F R", eval_shape=False)

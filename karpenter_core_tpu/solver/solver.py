@@ -424,11 +424,28 @@ def _catalog_entry(
 #   only goes to the device when S·T ≥ COMPAT_MIN_DEVICE_WORK
 #   (default 2^24 ≈ S=8192 × T=2048, where host numpy crosses ~200 ms
 #   and the chip's fixed dispatch cost is finally amortized).
-_PALLAS_MIN_S = int(os.environ.get("KARPENTER_TPU_PALLAS_MIN_S", str(1 << 30)))
-_PALLAS_INTERPRET_OK = os.environ.get("KARPENTER_TPU_PALLAS_INTERPRET", "0") == "1"
-COMPAT_MIN_DEVICE_WORK = int(
-    os.environ.get("KARPENTER_TPU_COMPAT_MIN_WORK", str(1 << 24))
-)
+def _pallas_min_s() -> int:
+    """The pallas routing threshold, read at call time so a warmstore-
+    restored process (and the pallas-parity tests) can still flip it."""
+    try:
+        return int(os.environ.get("KARPENTER_TPU_PALLAS_MIN_S", str(1 << 30)))
+    except ValueError:
+        return 1 << 30
+
+
+def _pallas_interpret_ok() -> bool:
+    """Interpret-mode escape hatch for the pallas route off-TPU, read at
+    call time (the parity tests drive the pallas path on CPU with it)."""
+    return os.environ.get("KARPENTER_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+# import-time fallback only, kept as a module attribute so tests can
+# monkeypatch it: the LIVE routing threshold re-reads the env (then the
+# on-chip calibration) at call time via calibrate.compat_min_device_work.
+try:  # analysis: allow-knob-inventory(KARPENTER_TPU_COMPAT_MIN_WORK — monkeypatchable fallback; the live threshold re-reads the env at call time)
+    COMPAT_MIN_DEVICE_WORK = int(os.environ.get("KARPENTER_TPU_COMPAT_MIN_WORK", str(1 << 24)))
+except ValueError:
+    COMPAT_MIN_DEVICE_WORK = 1 << 24
 
 
 def _compat_threshold() -> int:
@@ -1059,10 +1076,12 @@ class TPUScheduler:
         set (signatures embed every label key any selector in the batch
         can match) AND the constraint-engine switch, so it is memoized
         across solves on the interned signature-id tuple plus the
-        engine token (solver/incremental.py). The env read itself is
-        read-set-invisible to the cachesound slice (the PR-7/PR-11
-        precedent); the no-alias invariant is held by
-        tests/test_constraint_tensors.py::TestRouteCacheEngineToken."""
+        engine token (solver/incremental.py). The env read rides the
+        explicit ("ce", constraint_engine()) component, and dropping it
+        is an analyzer kill: the config-provenance rule (ISSUE 20)
+        requires the route key slice to witness constraint_engine();
+        tests/test_constraint_tensors.py::TestRouteCacheEngineToken
+        holds the behavioral side."""
         ws = self._warm
         key = incremental.route_key(groups) if ws is not None else None
         if key is not None:
@@ -2200,7 +2219,7 @@ class TPUScheduler:
                     elif (
                         backend == "tpu"
                         and S_ * T_ < compat_threshold
-                        and S_ < _PALLAS_MIN_S
+                        and S_ < _pallas_min_s()
                     ):
                         # small-S regime: the tunneled chip's dispatch floor
                         # (~65 ms, BENCH_r03) dwarfs this host matmul — keep
@@ -2220,9 +2239,9 @@ class TPUScheduler:
                             keys,
                         )
                     elif (
-                        len(compats) >= _PALLAS_MIN_S
+                        len(compats) >= _pallas_min_s()
                         and keys
-                        and (backend == "tpu" or _PALLAS_INTERPRET_OK)
+                        and (backend == "tpu" or _pallas_interpret_ok())
                     ):
                         # large-S regime: fused pallas kernel against the
                         # device-resident packed catalog (sig side is the only
@@ -2322,6 +2341,7 @@ class TPUScheduler:
                         # the full arrays (the pure cold path, zero copies)
                         allowed_per_pool.append((sub_allowed, sub_zone, sub_ct))
                         if ws is not None:
+                            # analysis: allow-config-provenance(KARPENTER_TPU_SHARDED — compat masks are engine-exact (the pallas/shard parity gates assert bitwise equality), so the mode only selects the compute route, never the cached content)
                             self._cache_compat_rows(
                                 e, pool_fps[pi], groups, missing,
                                 sig_compats[pi], sub_allowed, sub_zone, sub_ct,
@@ -3276,7 +3296,7 @@ class TPUScheduler:
         existing_zones: set = set()
         if can_use_existing:
             row = self._existing_compat_row(group, ctx).astype(bool)
-            for z in set(ctx["node_zones"][row].tolist()):
+            for z in sorted(set(ctx["node_zones"][row].tolist())):
                 if z and allowed(z):
                     existing_zones.add(z)
                     if z not in place:

@@ -282,7 +282,11 @@ class TraceRing:
             return len(self._items)
 
 
-RING = TraceRing(int(os.environ.get("KARPENTER_TPU_TRACE_BUFFER", "32")))
+try:
+    _RING_CAP = max(1, int(os.environ.get("KARPENTER_TPU_TRACE_BUFFER", "32")))
+except ValueError:
+    _RING_CAP = 32
+RING = TraceRing(_RING_CAP)
 
 _tls = threading.local()
 

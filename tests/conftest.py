@@ -22,6 +22,17 @@ if os.environ.get("KARPENTER_TPU_LOCK_WITNESS", "") == "1":
     from karpenter_core_tpu.analysis import lockwitness
 
     lockwitness.install()
+# runtime knob witness (analysis/knobwitness.py, ISSUE 20): record every
+# KARPENTER_TPU_* env read so the session gate can assert the static knob
+# inventory (configprov) accounts for each one. Install BEFORE the jax /
+# package imports below so import-time reads are witnessed too. The
+# switch itself is probed before install() and is deliberately unrecorded
+# (same convention as the lock witness above).
+_KNOB_WITNESS_ON = os.environ.setdefault("KARPENTER_TPU_KNOB_WITNESS", "1") == "1"
+if _KNOB_WITNESS_ON:
+    from karpenter_core_tpu.analysis import knobwitness
+
+    knobwitness.install()
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -59,6 +70,28 @@ def _lock_order_witness_gate():
         f"from the static graph: {sorted(unexplained)} "
         f"(observed {len(observed)} edges total — extend "
         "analysis/concurrency.py resolution rather than weakening this gate)"
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _knob_witness_gate():
+    """Session-wide knob witness (ISSUE 20): every KARPENTER_TPU_* env
+    name the tests actually read must be present in the static knob
+    inventory (observed ⊆ static) — an env read the analyzer cannot see
+    fails tier-1. Runs at teardown so the whole workload contributes."""
+    yield
+    from karpenter_core_tpu.analysis import knobwitness
+
+    if not knobwitness.installed():
+        return
+    observed, unexplained = knobwitness.verify_against_static()
+    assert not unexplained, (
+        "runtime knob witness observed KARPENTER_TPU_* reads missing from "
+        f"the static knob inventory: {unexplained} "
+        f"(observed {len(observed)} names total — extend "
+        "analysis/configprov.py name resolution rather than weakening this "
+        "gate; python -m karpenter_core_tpu.analysis --knobs shows the "
+        "static side)"
     )
 
 

@@ -532,9 +532,9 @@ _MUTANTS = [
     # (zone_ok also carries the anti-affinity domain-exclusion
     # narrowing, so losing it aliases excluded and unexcluded solves).
     # The port_features component and the route key's constraint-engine
-    # token are read-set-invisible (emit-side/env reads — the PR-7/
-    # PR-11 precedent) and are held by tests/test_constraint_tensors.py
-    # TestJobMemoPortKeys / TestRouteTelemetry instead.
+    # token used to be read-set-invisible (emit-side/env reads) and held
+    # only by behavior tests; since ISSUE 20 the config-provenance rule
+    # machine-checks both — see the *-token-drop mutants below.
     ("job-key-drop-zonemask", "karpenter_core_tpu/solver/solver.py",
      '            np.asarray(meta["zone_ok"]).tobytes(),\n', "", "cache-key"),
     ("merge-key-drop-stream", "karpenter_core_tpu/solver/solver.py",
@@ -637,10 +637,11 @@ _MUTANTS = [
     # ISSUE 11: the pod-shard chunk config (engine, threshold, mesh size)
     # is job-memo key material via incremental.pack_engine_token
     # (sharding.pod_shard_token). Its env reads happen inside the pack
-    # dispatch, invisible to the read-set slice (the PR-7 sim_drained
-    # precedent), so the no-alias invariant is held by
-    # tests/test_sharding.py::TestShardEngineMemoKeys instead of a
-    # mutant here.
+    # dispatch, invisible to the read-set slice — since ISSUE 20 the
+    # config-provenance token contract makes dropping it an analyzer
+    # kill (pack-token-drop-shardcfg below);
+    # tests/test_sharding.py::TestShardEngineMemoKeys holds the
+    # behavioral side.
     ("seed-key-drop-tenantscope", "karpenter_core_tpu/solver/solver.py",
      "skey = key + (\n                    self._seed_exclusion_key(), self._sim_drained, self._tenant_scope\n                )",
      "skey = key + (self._seed_exclusion_key(), self._sim_drained)", "cache-key"),
@@ -679,6 +680,19 @@ _MUTANTS = [
     ("restore-drop-iteration-budget", "karpenter_core_tpu/solver/warmstore.py",
      "            if not isinstance(iters, int) or iters < 8:",
      "            if not isinstance(iters, int):", "cache-persist"),
+    # ISSUE 20: the formerly read-set-invisible key tokens, now held by
+    # the config-provenance token contracts instead of behavior tests
+    # alone. Dropping the pod-shard chunk config from the pack-engine
+    # token, the constraint-engine token from the route key, or the
+    # port_features component from the job key is an analyzer kill.
+    ("pack-token-drop-shardcfg", "karpenter_core_tpu/solver/incremental.py",
+     "        pod_shard_token(mesh),\n", "", "config-provenance"),
+    ("route-key-drop-enginetoken", "karpenter_core_tpu/solver/solver.py",
+     '            key = key + (("ce", constraint_engine()),)\n', "",
+     "config-provenance"),
+    ("job-key-drop-portfeatures", "karpenter_core_tpu/solver/solver.py",
+     '            tuple(meta["port_features"] or ()),\n', "",
+     "config-provenance"),
 ]
 
 #: acceptance-critical mutant classes: each must be killed individually
@@ -706,6 +720,10 @@ _MANDATORY = {
     # ISSUE 19 acceptance: the warm-dual plane restores only behind the
     # finite-price-table and iteration-budget witnesses
     "persist-drop-pricefp-witness", "restore-drop-iteration-budget",
+    # ISSUE 20 acceptance: the three formerly read-set-invisible key
+    # tokens are now config-provenance contract kills
+    "pack-token-drop-shardcfg", "route-key-drop-enginetoken",
+    "job-key-drop-portfeatures",
 }
 
 
@@ -717,8 +735,13 @@ def _build_tree(root):
 
 
 def _analyze_tree(root):
+    # config-provenance (ISSUE 20) joins the mutation harness but NOT the
+    # snippet default: snippets declare LRU("route") sites without the
+    # constraint-engine token on purpose
     return analyze_paths(
-        [os.path.join(root, "karpenter_core_tpu")], root=str(root), rules=CACHESOUND
+        [os.path.join(root, "karpenter_core_tpu")],
+        root=str(root),
+        rules=CACHESOUND + ["config-provenance"],
     )
 
 

@@ -1,0 +1,790 @@
+"""Tier-1 gate for the config-provenance & determinism family (ISSUE 20).
+
+Four layers, mirroring test_cachesound's shape:
+
+- per-rule fixture tests: positive snippet -> finding, negative ->
+  clean, scoped ``allow-knob-inventory(NAME)`` /
+  ``allow-config-provenance(TOKEN)`` / ``allow-determinism(<why>)``
+  markers suppress exactly the declared token, not the whole rule;
+- the runtime knob witness: observed ``KARPENTER_TPU_*`` reads are a
+  subset of the static inventory, and a name the analyzer cannot see is
+  reported as unexplained;
+- the MUTATION-KILL meta-test: mutants seeded into copies of the real
+  solver/native sources (the three formerly read-set-invisible key
+  tokens, an unclamped numeric parse, an import-time hoist into a
+  restorable module, unsorted filesystem/set iteration, a bare
+  popitem) must each be detected with the correct rule id;
+- CLI/perf meta-tests: ``--knobs`` output equals the README block byte
+  for byte, ``--changed-only`` runs stay sound on a scoped file set
+  because the registry and the cachesound index load cross-file, and a
+  warm full-repo analysis fits the 3 s budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from karpenter_core_tpu.analysis import analyze_paths, analyze_repo
+from karpenter_core_tpu.analysis import knobwitness
+from karpenter_core_tpu.analysis.configprov import (
+    KNOBS_BEGIN,
+    KNOBS_END,
+    SEMANTIC_KNOBS,
+    knob_rows,
+    knob_table_lines,
+    repo_registry,
+    static_knob_names,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG_RULES = ["knob-inventory", "knob-docs", "config-provenance", "determinism"]
+
+
+def run_snippet(tmp_path, code, rules, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return analyze_paths([str(p)], root=str(tmp_path), rules=list(rules))
+
+
+def rules_hit(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# ---------------------------------------------------------------------------
+# knob-inventory fixtures
+
+
+class TestKnobInventoryFixtures:
+    def test_unguarded_int_parse_flagged(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            def budget():
+                return int(os.environ.get("KARPENTER_TPU_FIXTURE_N", "4"))
+            """,
+            ["knob-inventory"],
+        )
+        assert rules_hit(report) == ["knob-inventory"]
+        assert "unguarded" in report.findings[0].message
+
+    def test_clamped_parse_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            def budget():
+                return max(1, int(os.environ.get("KARPENTER_TPU_FIXTURE_N", "4")))
+            """,
+            ["knob-inventory"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_guarded_parse_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            def budget():
+                try:
+                    return int(os.environ.get("KARPENTER_TPU_FIXTURE_N", "4"))
+                except ValueError:
+                    return 4
+            """,
+            ["knob-inventory"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_scoped_marker_suppresses_exactly_the_named_knob(self, tmp_path):
+        code = """
+        import os
+
+        def budget():
+            # analysis: allow-knob-inventory({name} — fixture rationale)
+            return int(os.environ.get("KARPENTER_TPU_FIXTURE_N", "4"))
+        """
+        clean = run_snippet(
+            tmp_path,
+            code.format(name="KARPENTER_TPU_FIXTURE_N"),
+            ["knob-inventory"],
+            name="ok.py",
+        )
+        assert clean.findings == [], [f.format() for f in clean.findings]
+        # a marker naming a DIFFERENT knob does not suppress this one
+        wrong = run_snippet(
+            tmp_path,
+            code.format(name="KARPENTER_TPU_OTHER"),
+            ["knob-inventory"],
+            name="wrong.py",
+        )
+        assert rules_hit(wrong) == ["knob-inventory"]
+
+    def test_import_time_read_in_restorable_module_flagged(self, tmp_path):
+        # restorable_modules matches full package relpaths, so the
+        # fixture lives at the real warmstore path inside a tmp tree
+        pkg = tmp_path / "karpenter_core_tpu" / "solver"
+        pkg.mkdir(parents=True)
+        (pkg / "warmstore.py").write_text(
+            textwrap.dedent(
+                """
+                import os
+
+                EAGER = os.environ.get("KARPENTER_TPU_FIXTURE_EAGER", "0")
+                """
+            )
+        )
+        report = analyze_paths(
+            [str(tmp_path / "karpenter_core_tpu")],
+            root=str(tmp_path),
+            rules=["knob-inventory"],
+        )
+        assert rules_hit(report) == ["knob-inventory"]
+        assert "import-time" in report.findings[0].message
+
+    def test_call_time_read_in_restorable_module_clean(self, tmp_path):
+        pkg = tmp_path / "karpenter_core_tpu" / "solver"
+        pkg.mkdir(parents=True)
+        (pkg / "warmstore.py").write_text(
+            textwrap.dedent(
+                """
+                import os
+
+                def eager():
+                    return os.environ.get("KARPENTER_TPU_FIXTURE_EAGER", "0")
+                """
+            )
+        )
+        report = analyze_paths(
+            [str(tmp_path / "karpenter_core_tpu")],
+            root=str(tmp_path),
+            rules=["knob-inventory"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# knob-docs fixtures
+
+
+def _docs_tree(tmp_path, readme_text):
+    pkg = tmp_path / "karpenter_core_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import os
+
+            def engine():
+                return os.environ.get("KARPENTER_TPU_FIXTURE_ENGINE", "host")
+            """
+        )
+    )
+    if readme_text is not None:
+        (tmp_path / "README.md").write_text(readme_text)
+    return analyze_paths([str(pkg)], root=str(tmp_path), rules=["knob-docs"])
+
+
+class TestKnobDocsFixtures:
+    def test_readme_without_markers_flagged(self, tmp_path):
+        report = _docs_tree(tmp_path, "# fixture\nno knob table here\n")
+        assert rules_hit(report) == ["knob-docs"]
+        assert "no generated knob table" in report.findings[0].message
+
+    def test_readme_matching_registry_clean(self, tmp_path):
+        pkg = tmp_path / "karpenter_core_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'import os\n\n\ndef engine():\n'
+            '    return os.environ.get("KARPENTER_TPU_FIXTURE_ENGINE", "host")\n'
+        )
+        lines = knob_table_lines(repo_registry(str(tmp_path)))
+        (tmp_path / "README.md").write_text(
+            "# fixture\n\n" + KNOBS_BEGIN + "\n" + "\n".join(lines) + "\n" + KNOBS_END + "\n"
+        )
+        report = analyze_paths([str(pkg)], root=str(tmp_path), rules=["knob-docs"])
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_drifted_row_flagged(self, tmp_path):
+        pkg = tmp_path / "karpenter_core_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'import os\n\n\ndef engine():\n'
+            '    return os.environ.get("KARPENTER_TPU_FIXTURE_ENGINE", "host")\n'
+        )
+        lines = knob_table_lines(repo_registry(str(tmp_path)))
+        stale = [ln.replace("FIXTURE_ENGINE", "RENAMED_ENGINE") for ln in lines]
+        (tmp_path / "README.md").write_text(
+            KNOBS_BEGIN + "\n" + "\n".join(stale) + "\n" + KNOBS_END + "\n"
+        )
+        report = analyze_paths([str(pkg)], root=str(tmp_path), rules=["knob-docs"])
+        assert rules_hit(report) == ["knob-docs"]
+        msg = report.findings[0].message
+        assert "drifted" in msg and "KARPENTER_TPU_FIXTURE_ENGINE" in msg
+
+
+# ---------------------------------------------------------------------------
+# config-provenance fixtures
+
+
+class TestConfigProvenanceFixtures:
+    def test_token_contract_kill(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            def pack_engine_token(mesh):
+                return (int(mesh.devices.size) if mesh is not None else 0,)
+            """,
+            ["config-provenance"],
+        )
+        assert rules_hit(report) == ["config-provenance"]
+        assert "pod_shard_token" in report.findings[0].message
+
+    def test_token_contract_satisfied_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            from .sharding import pod_shard_token
+
+            def pack_engine_token(mesh):
+                return (
+                    int(mesh.devices.size) if mesh is not None else 0,
+                    pod_shard_token(mesh),
+                )
+            """,
+            ["config-provenance"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_token_contract_scoped_marker(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            # analysis: allow-config-provenance(pod_shard_token — fixture: meshless build)
+            def pack_engine_token(mesh):
+                return (0,)
+            """,
+            ["config-provenance"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_route_key_without_engine_token_flagged(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            from .incremental import LRU
+
+            class Solver:
+                def __init__(self):
+                    self.routes = LRU("route")
+
+                def split(self, groups):
+                    key = tuple(groups)
+                    hit = self.routes.get(key)
+                    if hit is not None:
+                        return hit
+                    out = [g for g in groups]
+                    self.routes.put(key, out)
+                    return out
+            """,
+            ["config-provenance"],
+        )
+        assert rules_hit(report) == ["config-provenance"]
+        assert "constraint-engine" in report.findings[0].message
+
+    def test_route_key_with_engine_token_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            from .incremental import LRU
+            from .solver import constraint_engine
+
+            class Solver:
+                def __init__(self):
+                    self.routes = LRU("route")
+
+                def split(self, groups):
+                    key = tuple(groups) + (("ce", constraint_engine()),)
+                    hit = self.routes.get(key)
+                    if hit is not None:
+                        return hit
+                    out = [g for g in groups]
+                    self.routes.put(key, out)
+                    return out
+            """,
+            ["config-provenance"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_semantic_knob_in_body_not_in_key_flagged(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            from .incremental import LRU
+
+            def merge_engine():
+                return os.environ.get("KARPENTER_TPU_MERGE_ENGINE", "host")
+
+            class Solver:
+                def __init__(self):
+                    self.plans = LRU("plans")
+
+                def solve(self, groups):
+                    key = tuple(groups)
+                    hit = self.plans.get(key)
+                    if hit is not None:
+                        return hit
+                    out = (merge_engine(), tuple(groups))
+                    self.plans.put(key, out)
+                    return out
+            """,
+            ["config-provenance"],
+        )
+        assert rules_hit(report) == ["config-provenance"]
+        assert "KARPENTER_TPU_MERGE_ENGINE" in report.findings[0].message
+
+    def test_semantic_knob_witnessed_in_key_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            from .incremental import LRU
+
+            def merge_engine():
+                return os.environ.get("KARPENTER_TPU_MERGE_ENGINE", "host")
+
+            class Solver:
+                def __init__(self):
+                    self.plans = LRU("plans")
+
+                def solve(self, groups):
+                    key = tuple(groups) + (merge_engine(),)
+                    hit = self.plans.get(key)
+                    if hit is not None:
+                        return hit
+                    out = (merge_engine(), tuple(groups))
+                    self.plans.put(key, out)
+                    return out
+            """,
+            ["config-provenance"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_semantic_knob_scoped_marker(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            from .incremental import LRU
+
+            def merge_engine():
+                return os.environ.get("KARPENTER_TPU_MERGE_ENGINE", "host")
+
+            class Solver:
+                def __init__(self):
+                    self.plans = LRU("plans")
+
+                def solve(self, groups):
+                    key = tuple(groups)
+                    hit = self.plans.get(key)
+                    if hit is not None:
+                        return hit
+                    out = (merge_engine(), tuple(groups))
+                    # analysis: allow-config-provenance(KARPENTER_TPU_MERGE_ENGINE — fixture: engines are bit-identical here)
+                    self.plans.put(key, out)
+                    return out
+            """,
+            ["config-provenance"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# determinism fixtures
+
+
+class TestDeterminismFixtures:
+    def test_unsorted_listdir_flagged(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            def shards(d):
+                return [os.path.join(d, n) for n in os.listdir(d)]
+            """,
+            ["determinism"],
+        )
+        assert rules_hit(report) == ["determinism"]
+        assert "filesystem-arbitrary" in report.findings[0].message
+
+    def test_sorted_listdir_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            def shards(d):
+                return [os.path.join(d, n) for n in sorted(os.listdir(d))]
+            """,
+            ["determinism"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_scoped_marker_with_rationale_suppresses(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import os
+
+            def shards(d):
+                # analysis: allow-determinism(order feeds a set — fixture)
+                return {n for n in os.listdir(d)}
+            """,
+            ["determinism"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_unsorted_glob_flagged(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            import glob
+
+            def stale(d):
+                return [p for p in glob.glob(d + "/*.so")]
+            """,
+            ["determinism"],
+        )
+        assert rules_hit(report) == ["determinism"]
+
+    def test_bare_popitem_flagged_fifo_clean(self, tmp_path):
+        bare = run_snippet(
+            tmp_path,
+            """
+            def evict(d):
+                d.popitem()
+            """,
+            ["determinism"],
+            name="bare.py",
+        )
+        assert rules_hit(bare) == ["determinism"]
+        fifo = run_snippet(
+            tmp_path,
+            """
+            def evict(d):
+                d.popitem(last=False)
+            """,
+            ["determinism"],
+            name="fifo.py",
+        )
+        assert fifo.findings == [], [f.format() for f in fifo.findings]
+
+    def test_set_iteration_flagged_sorted_clean(self, tmp_path):
+        loop = run_snippet(
+            tmp_path,
+            """
+            def zones(rows):
+                out = []
+                for z in set(rows):
+                    out.append(z)
+                return out
+            """,
+            ["determinism"],
+            name="loop.py",
+        )
+        assert rules_hit(loop) == ["determinism"]
+        ok = run_snippet(
+            tmp_path,
+            """
+            def zones(rows):
+                out = []
+                for z in sorted(set(rows)):
+                    out.append(z)
+                return out
+            """,
+            ["determinism"],
+            name="ok.py",
+        )
+        assert ok.findings == [], [f.format() for f in ok.findings]
+
+    def test_dict_items_reaching_hash_sink_flagged(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            from .util import stable_hash
+
+            def fingerprint(labels):
+                rows = tuple(labels.items())
+                return stable_hash(rows)
+            """,
+            ["determinism"],
+        )
+        assert rules_hit(report) == ["determinism"]
+        assert "digest" in report.findings[0].message
+
+    def test_sorted_items_into_hash_sink_clean(self, tmp_path):
+        report = run_snippet(
+            tmp_path,
+            """
+            from .util import stable_hash
+
+            def fingerprint(labels):
+                rows = tuple(sorted(labels.items()))
+                return stable_hash(rows)
+            """,
+            ["determinism"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_dict_iteration_outside_hash_sinks_not_flagged(self, tmp_path):
+        # insertion order is deterministic in-process; only digests and
+        # unordered producers are order hazards
+        report = run_snippet(
+            tmp_path,
+            """
+            def render(d):
+                return [f"{k}={v}" for k, v in d.items()]
+            """,
+            ["determinism"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+    def test_out_of_scope_package_module_not_flagged(self, tmp_path):
+        # determinism scope is solver/fleet/native/capture: a package
+        # module outside those prefixes does not opt in
+        pkg = tmp_path / "karpenter_core_tpu" / "controller"
+        pkg.mkdir(parents=True)
+        (pkg / "loop.py").write_text(
+            "import os\n\n\ndef walk(d):\n    return list(os.listdir(d))\n"
+        )
+        report = analyze_paths(
+            [str(tmp_path / "karpenter_core_tpu")],
+            root=str(tmp_path),
+            rules=["determinism"],
+        )
+        assert report.findings == [], [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# runtime knob witness
+
+
+class TestKnobWitness:
+    def test_observed_reads_are_subset_of_static_inventory(self):
+        knobwitness.install()
+        assert knobwitness.installed()
+        os.environ.get("KARPENTER_TPU_CONSTRAINT_ENGINE")
+        "KARPENTER_TPU_SHARDED" in os.environ  # noqa: B015 — probe records
+        observed, unexplained = knobwitness.verify_against_static()
+        assert "KARPENTER_TPU_CONSTRAINT_ENGINE" in observed
+        assert "KARPENTER_TPU_SHARDED" in observed
+        assert unexplained == [], unexplained
+
+    def test_unknown_name_is_unexplained(self):
+        knobwitness.install()
+        phantom = "KARPENTER_TPU_PHANTOM_FIXTURE_KNOB"
+        try:
+            os.environ.get(phantom)
+            _observed, unexplained = knobwitness.verify_against_static()
+            assert phantom in unexplained
+        finally:
+            # scrub only the phantom so the session-teardown gate in
+            # conftest keeps witnessing the real workload's reads
+            with knobwitness._mu:
+                knobwitness._observed.discard(phantom)
+
+    def test_bulk_snapshots_do_not_pollute(self):
+        knobwitness.install()
+        phantom = "KARPENTER_TPU_SNAPSHOT_ONLY_KNOB"
+        os.environ[phantom] = "1"
+        try:
+            dict(os.environ)
+            os.environ.copy()
+            assert phantom not in knobwitness.observed_names()
+        finally:
+            del os.environ[phantom]
+
+    def test_static_inventory_covers_core_knobs(self):
+        names, _patterns = static_knob_names(REPO)
+        for required in (
+            "KARPENTER_TPU_CONSTRAINT_ENGINE",
+            "KARPENTER_TPU_SHARD_ENGINE",
+            "KARPENTER_TPU_K_OPEN",
+            "KARPENTER_TPU_LP_ITERS",
+        ):
+            assert required in names, required
+        assert names == {n for n in names if n.startswith("KARPENTER_TPU_")}
+
+
+# ---------------------------------------------------------------------------
+# mutation-kill meta-test: copies of the real sources
+
+
+_MUT_FILES = [
+    "karpenter_core_tpu/solver/incremental.py",
+    "karpenter_core_tpu/solver/solver.py",
+    "karpenter_core_tpu/solver/podcache.py",
+    "karpenter_core_tpu/solver/warmstore.py",
+    "karpenter_core_tpu/solver/pack.py",
+    "karpenter_core_tpu/solver/sharding.py",
+    "karpenter_core_tpu/native/__init__.py",
+]
+
+#: (name, file, old, new, expected-rule) — the three formerly
+#: read-set-invisible key tokens (RULES.md residual entry, retired by
+#: ISSUE 20) plus one representative per knob-inventory/determinism
+#: finding class.
+_MUTANTS = [
+    ("pack-token-drop-shardcfg", "karpenter_core_tpu/solver/incremental.py",
+     "        pod_shard_token(mesh),\n", "", "config-provenance"),
+    ("route-key-drop-enginetoken", "karpenter_core_tpu/solver/solver.py",
+     '            key = key + (("ce", constraint_engine()),)\n', "",
+     "config-provenance"),
+    ("job-key-drop-portfeatures", "karpenter_core_tpu/solver/solver.py",
+     '            tuple(meta["port_features"] or ()),\n', "",
+     "config-provenance"),
+    ("job-key-drop-backendtoken", "karpenter_core_tpu/solver/solver.py",
+     '            backend.job_token() if backend is not None else ("ffd",),\n',
+     "", "config-provenance"),
+    ("cachecap-unguard", "karpenter_core_tpu/solver/incremental.py",
+     "    try:\n"
+     "        return max(1, int(os.environ.get(env, default)))\n"
+     "    except ValueError:\n"
+     "        return default\n",
+     "    return int(os.environ.get(env, default))\n", "knob-inventory"),
+    ("importtime-hoist-restorable", "karpenter_core_tpu/solver/warmstore.py",
+     "import pickle\n",
+     'import pickle\n\nWARMSTORE_EAGER = os.environ.get("KARPENTER_TPU_WARMSTORE_EAGER", "0")\n',
+     "knob-inventory"),
+    ("native-unsorted-glob", "karpenter_core_tpu/native/__init__.py",
+     'for stale in sorted(glob.glob(os.path.join(os.path.dirname(__file__), "_libpack-*.so"))):',
+     'for stale in glob.glob(os.path.join(os.path.dirname(__file__), "_libpack-*.so")):',
+     "determinism"),
+    ("spread-unsorted-zoneset", "karpenter_core_tpu/solver/solver.py",
+     'for z in sorted(set(ctx["node_zones"][row].tolist())):',
+     'for z in set(ctx["node_zones"][row].tolist()):', "determinism"),
+    ("lru-bare-popitem", "karpenter_core_tpu/solver/incremental.py",
+     "self._d.popitem(last=False)", "self._d.popitem()", "determinism"),
+]
+
+_HARNESS_RULES = ["knob-inventory", "config-provenance", "determinism"]
+
+
+def _build_tree(root):
+    for rel in _MUT_FILES:
+        dst = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copyfile(os.path.join(REPO, rel), dst)
+
+
+def _analyze_tree(root):
+    return analyze_paths(
+        [os.path.join(root, "karpenter_core_tpu")], root=str(root),
+        rules=_HARNESS_RULES,
+    )
+
+
+def test_unmutated_sources_are_clean(tmp_path):
+    _build_tree(str(tmp_path))
+    report = _analyze_tree(str(tmp_path))
+    assert report.findings == [], [f.format() for f in report.findings]
+
+
+def test_mutation_kill_rate(tmp_path):
+    killed, missed = [], []
+    for i, (name, rel, old, new, rule) in enumerate(_MUTANTS):
+        root = str(tmp_path / f"m{i}")
+        _build_tree(root)
+        p = os.path.join(root, rel)
+        with open(p, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert old in src, f"mutant {name}: anchor drifted — update the harness"
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(src.replace(old, new, 1))
+        report = _analyze_tree(root)
+        # a NEW finding with the expected rule id (the clean tree has none)
+        if any(f.rule == rule for f in report.findings):
+            killed.append(name)
+        else:
+            missed.append(name)
+    # every mutant is acceptance-critical: the token drops are the
+    # retired RULES.md residual entry, the rest pin one finding class each
+    assert not missed, f"mutants survived: {missed}"
+    assert len(killed) / len(_MUTANTS) >= 0.95
+
+
+# ---------------------------------------------------------------------------
+# full-repo, CLI, and soundness meta-tests
+
+
+def test_repo_is_config_clean():
+    report = analyze_repo(rules=CONFIG_RULES, use_baseline=False)
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.parse_errors == []
+
+
+def test_changed_only_scoped_scan_stays_sound():
+    # a scoped scan (one file, as --changed-only produces) must not
+    # fabricate findings: knob-docs compares the README against the FULL
+    # package registry and config-provenance loads its cross-file module
+    # set regardless of the scanned paths
+    one = os.path.join(REPO, "karpenter_core_tpu", "solver", "pack.py")
+    report = analyze_paths([one], root=REPO, rules=CONFIG_RULES)
+    assert report.findings == [], [f.format() for f in report.findings]
+
+
+def test_knobs_cli_matches_readme_block():
+    out = subprocess.run(
+        [sys.executable, "-m", "karpenter_core_tpu.analysis", "--knobs"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    cli_lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        text = f.read()
+    assert KNOBS_BEGIN in text and KNOBS_END in text
+    block = text.split(KNOBS_BEGIN, 1)[1].split(KNOBS_END, 1)[0]
+    doc_lines = [ln for ln in block.splitlines() if ln.strip()]
+    assert cli_lines == doc_lines, "README knob table drifted from --knobs"
+
+
+def test_knobs_json_is_machine_readable():
+    rows = knob_rows(repo_registry(REPO))
+    payload = json.loads(json.dumps(rows))
+    assert payload, "empty knob registry"
+    for row in payload:
+        assert row["name"].startswith("KARPENTER_TPU_")
+        assert row["read"] in ("import", "call")
+        assert row["sites"], row["name"]
+    # the semantic knobs the provenance rule keys on all exist
+    names = {r["name"] for r in payload}
+    missing = {k for k in SEMANTIC_KNOBS if k not in names}
+    assert not missing, f"SEMANTIC_KNOBS not in registry: {sorted(missing)}"
+
+
+def test_warm_full_analysis_fits_budget():
+    # the ISSUE 20 perf budget: a full analysis with every rule family
+    # active completes in <= 3 s once parse caches are warm (the cold
+    # CLI adds interpreter+parse startup on top; the warm number is what
+    # the walk-memo sharing buys)
+    analyze_repo(use_baseline=False)  # warm the shared parse cache
+    t0 = time.monotonic()
+    analyze_repo(use_baseline=False)
+    dt = time.monotonic() - t0
+    assert dt <= 3.0, f"warm full analysis took {dt:.2f}s (budget 3s)"

@@ -77,7 +77,6 @@ class TestSolverPallasPath:
         )
         from karpenter_core_tpu.kube.client import KubeClient
         from karpenter_core_tpu.solver import TPUScheduler
-        from karpenter_core_tpu.solver import solver as solver_mod
 
         provider = FakeCloudProvider()
         provider.instance_types = instance_types(30)
@@ -96,10 +95,10 @@ class TestSolverPallasPath:
                 )
             )
 
-        monkeypatch.setattr(solver_mod, "_PALLAS_INTERPRET_OK", True)
+        monkeypatch.setenv("KARPENTER_TPU_PALLAS_INTERPRET", "1")
 
         def solve(threshold):
-            monkeypatch.setattr(solver_mod, "_PALLAS_MIN_S", threshold)
+            monkeypatch.setenv("KARPENTER_TPU_PALLAS_MIN_S", str(threshold))
             res = TPUScheduler([pool], provider, kube_client=KubeClient()).solve(pods)
             return res
 
